@@ -1,0 +1,305 @@
+"""Autograd: tape-based reverse-mode differentiation for the eager API.
+
+Re-expression of the reference's imperative autograd
+(`src/imperative/imperative.cc` — RecordOp, Backward:270; python surface
+`python/mxnet/autograd.py`).  The tape records (op, params, inputs, outputs)
+per eager call under `record()`; `backward()` walks it in reverse and gets
+each op's input gradients from `jax.vjp` of the registered compute function
+(the `FGradient` walk at `imperative.cc:142-162`, with XLA-compiled vjps
+instead of hand-written backward kernels).
+
+Under `jit`-compiled paths (CachedOp / symbolic executor) gradients are taken
+over the whole compiled graph instead — this tape only serves true eager code.
+"""
+from __future__ import annotations
+
+import threading
+
+from .base import MXNetError
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "mark_variables",
+           "backward", "grad", "is_recording", "is_training", "set_recording",
+           "set_training", "get_symbol", "Function"]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+        _state.tape = []
+    return _state
+
+
+def is_recording():
+    """Reference `autograd.is_recording` (`python/mxnet/autograd.py:32`)."""
+    return _st().recording
+
+
+def is_training():
+    return _st().training
+
+
+def set_recording(is_record):
+    st = _st()
+    prev, st.recording = st.recording, bool(is_record)
+    return prev
+
+
+def set_training(train_mode_):
+    st = _st()
+    prev, st.training = st.training, bool(train_mode_)
+    return prev
+
+
+class _RecordingStateScope:
+    """Scope guard (reference `autograd.py:_RecordingStateScope`)."""
+
+    def __init__(self, is_record, train_mode_):
+        self._enter_is_record = is_record
+        self._enter_train_mode = train_mode_
+        self._prev_is_record = None
+        self._prev_train_mode = None
+
+    def __enter__(self):
+        if self._enter_is_record is not None:
+            self._prev_is_record = set_recording(self._enter_is_record)
+        if self._enter_train_mode is not None:
+            self._prev_train_mode = set_training(self._enter_train_mode)
+
+    def __exit__(self, ptype, value, trace):
+        if self._enter_is_record is not None:
+            set_recording(self._prev_is_record)
+        if self._enter_train_mode is not None:
+            set_training(self._prev_train_mode)
+
+
+def record(train_mode=True):
+    """Start recording ops for backward (reference `autograd.py:122 record`)."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    """Stop recording inside an outer `record` scope (reference `autograd.py:146`)."""
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    """Force train-mode op behavior without recording (reference `autograd.py:166`)."""
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    """Force predict-mode op behavior (reference `autograd.py:181`)."""
+    return _RecordingStateScope(None, False)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers (reference `autograd.py:197 mark_variables`)."""
+    if not isinstance(variables, (list, tuple)):
+        variables = [variables]
+        gradients = [gradients]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._mark_variable(g, req)
+
+
+class TapeEntry:
+    __slots__ = ("op", "params", "inputs", "input_values", "outputs", "n_vis")
+
+    def __init__(self, op, params, inputs, input_values, outputs, n_vis):
+        self.op = op
+        self.params = params
+        self.inputs = inputs          # list[NDArray] (weakly held via the entry)
+        self.input_values = input_values  # list[jax.Array] snapshot at call time
+        self.outputs = outputs        # list[NDArray]
+        self.n_vis = n_vis            # visible outputs (excludes aux updates)
+
+
+def _record_op(op, params, inputs, input_values, outputs, n_vis):
+    """Called by dispatch after an eager op executes under record()."""
+    _st().tape.append(TapeEntry(op, params, list(inputs), list(input_values),
+                                list(outputs), n_vis))
+
+
+def _compute_gradients(heads, head_grads, retain_graph=False):
+    """Reverse tape walk; returns dict id(NDArray) -> jax grad array."""
+    import jax.numpy as jnp
+
+    st = _st()
+    tape = st.tape
+    grad_map = {}
+    for h, hg in zip(heads, head_grads):
+        g = hg._data if hg is not None else jnp.ones(h.shape, dtype=h._data.dtype)
+        key = id(h)
+        grad_map[key] = grad_map[key] + g if key in grad_map else g
+
+    for entry in reversed(tape):
+        out_ids = [id(o) for o in entry.outputs]
+        if not any(oid in grad_map for oid in out_ids):
+            continue
+        cotangents = []
+        for o, oid in zip(entry.outputs, out_ids):
+            g = grad_map.get(oid)
+            cotangents.append(g if g is not None
+                              else jnp.zeros(o.shape, dtype=o._data.dtype))
+        # aux outputs (e.g. BatchNorm running stats) carry no gradient
+        igrads = _function_aware_vjp(entry.op, entry.params, entry.input_values,
+                                     cotangents)
+        for inp, ig in zip(entry.inputs, igrads):
+            if inp is None or ig is None:
+                continue
+            if not getattr(inp, "_requires_grad", False):
+                continue
+            key = id(inp)
+            grad_map[key] = grad_map[key] + ig if key in grad_map else ig
+    if not retain_graph:
+        st.tape = []
+    return grad_map
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of heads w.r.t. marked variables
+    (reference `autograd.py:243 backward` → `Imperative::Backward`)."""
+    if not isinstance(heads, (list, tuple)):
+        heads = [heads]
+        head_grads = [head_grads] if head_grads is not None else None
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+
+    # collect marked variables reachable on the tape
+    st = _st()
+    marked = []
+    seen = set()
+    for entry in st.tape:
+        for inp in entry.inputs:
+            if inp is not None and getattr(inp, "_grad_req", None) not in (None, "null") \
+                    and id(inp) not in seen:
+                seen.add(id(inp))
+                marked.append(inp)
+    for h in heads:
+        if getattr(h, "_grad_req", None) not in (None, "null") and id(h) not in seen:
+            seen.add(id(h))
+            marked.append(h)
+
+    grad_map = _compute_gradients(heads, head_grads, retain_graph)
+
+    for v in marked:
+        g = grad_map.get(id(v))
+        if g is None:
+            continue
+        if v._grad is None:
+            continue
+        if v._grad_req == "add":
+            v._grad._data = v._grad._data + g
+        else:  # write
+            v._grad._data = g.astype(v._grad._data.dtype)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """Return gradients as new arrays instead of writing `.grad`
+    (reference `autograd.py:270 grad`)."""
+    if create_graph:
+        raise MXNetError("create_graph=True (higher-order eager grad) is not yet "
+                         "supported; use hybridized blocks + symbolic grad instead")
+    if not isinstance(heads, (list, tuple)):
+        heads = [heads]
+    single = not isinstance(variables, (list, tuple))
+    if single:
+        variables = [variables]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
+    retain = bool(retain_graph) if retain_graph is not None else create_graph
+    grad_map = _compute_gradients(heads, head_grads, retain)
+    from .ndarray.ndarray import NDArray
+    out = []
+    for v in variables:
+        g = grad_map.get(id(v))
+        if g is None:
+            raise MXNetError("Some variables are not used by or not reachable "
+                             "from the heads")
+        out.append(NDArray(g, ctx=v.context))
+    return out[0] if single else out
+
+
+def get_symbol(x):
+    """Trace the recorded computation of x into a Symbol.
+
+    The reference rebuilds a Symbol from tape nodes
+    (`MXAutogradGetSymbol`).  Supported for tape-recorded arrays.
+    """
+    raise MXNetError("autograd.get_symbol: use hybridize()/CachedOp tracing instead")
+
+
+class Function:
+    """Customizable differentiable function (reference `autograd.py:363 Function`).
+
+    Subclass and override ``forward`` and ``backward``.  The pair is recorded
+    on the tape as a single op whose vjp calls the user's ``backward``.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+        if is_recording() and any(getattr(i, "_requires_grad", False) for i in inputs):
+            entry = _FunctionTapeEntry(self, list(inputs), outs)
+            _st().tape.append(entry)
+            for o in outs:
+                o._requires_grad = True
+        return outputs
+
+
+class _FunctionTapeEntry(TapeEntry):
+    """Tape entry whose vjp is the user Function.backward."""
+
+    def __init__(self, func, inputs, outputs):
+        self.func = func
+        self.inputs = inputs
+        self.input_values = [i._data for i in inputs]
+        self.outputs = outputs
+        self.n_vis = len(outputs)
+        self.params = {}
+
+    @property
+    def op(self):
+        return self  # duck-type: registry.vjp_call is bypassed via _FunctionOp
+
+# patch _compute_gradients to understand Function entries
+_orig_vjp_call = None
+
+
+def _function_aware_vjp(op, params, input_values, cotangents):
+    from .ops import registry as _reg
+    if isinstance(op, _FunctionTapeEntry):
+        from .ndarray.ndarray import NDArray
+        cts = [NDArray(c) for c in cotangents]
+        with pause():
+            igrads = op.func.backward(*cts)
+        if not isinstance(igrads, (list, tuple)):
+            igrads = [igrads]
+        return [g._data if g is not None else None for g in igrads]
+    return _reg.vjp_call(op, params, input_values, cotangents)

@@ -1,0 +1,101 @@
+"""Base utilities: error type, dtype tables, param coercion.
+
+TPU-native re-expression of the reference's `python/mxnet/base.py` (ctypes plumbing,
+`MXNetError`) and the dmlc parameter coercion rules (`dmlc::Parameter`,
+reference `include/mxnet/op_attr_types.h`).  There is no C ABI boundary here: the
+"backend" is JAX/XLA, so `base` only carries the pieces that are API surface —
+the exception type, dtype name tables, and string->python coercion used for
+MXNet-style stringly-typed op parameters.
+"""
+from __future__ import annotations
+
+import ast
+import numpy as _np
+
+__all__ = ["MXNetError", "string_types", "numeric_types", "integer_types",
+           "dtype_np_to_mx", "dtype_mx_to_np", "_Null"]
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (reference: `python/mxnet/base.py` MXNetError)."""
+
+
+class _NullType:
+    """Placeholder for missing optional op arguments (reference `base.py _NullType`)."""
+
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "_Null"
+
+    def __bool__(self):
+        return False
+
+
+_Null = _NullType()
+
+string_types = (str,)
+integer_types = (int, _np.integer)
+numeric_types = (float, int, _np.generic)
+
+# dtype code table mirrors reference `python/mxnet/base.py` / mshadow type codes,
+# extended with bfloat16 which is the TPU-native compute dtype.
+_DTYPE_NAMES = [
+    "float32", "float64", "float16", "uint8", "int32", "int8", "int64",
+    "bool", "uint16", "uint32", "uint64", "bfloat16",
+]
+
+
+def _np_dtype(name):
+    if name == "bfloat16":
+        import ml_dtypes
+        return _np.dtype(ml_dtypes.bfloat16)
+    return _np.dtype(name)
+
+
+dtype_mx_to_np = {i: _np_dtype(n) for i, n in enumerate(_DTYPE_NAMES)}
+dtype_np_to_mx = {v: k for k, v in dtype_mx_to_np.items()}
+
+
+def np_dtype(dtype):
+    """Normalize a dtype-ish (str, np.dtype, python type) to np.dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        return _np_dtype(dtype)
+    return _np.dtype(dtype)
+
+
+def dtype_name(dtype):
+    """Canonical string name for a dtype."""
+    d = np_dtype(dtype)
+    name = d.name
+    if name == "void16":  # ml_dtypes.bfloat16 on some numpy versions
+        return "bfloat16"
+    return name
+
+
+def py_literal(value):
+    """Coerce an MXNet stringly-typed parameter value to a Python value.
+
+    The reference reflects `dmlc::Parameter` structs into Python with string
+    round-tripping ("(2, 2)", "True", "1e-3"); we accept both real Python
+    values and their string forms.
+    """
+    if not isinstance(value, str):
+        return value
+    s = value.strip()
+    low = s.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    if low == "none":
+        return None
+    try:
+        return ast.literal_eval(s)
+    except (ValueError, SyntaxError):
+        return s

@@ -1,0 +1,99 @@
+"""Execution-engine semantics over JAX's async dispatch.
+
+The reference's dependency engine (`src/engine/threaded_engine.cc`,
+`include/mxnet/engine.h:116-315`) provides: (1) async op execution with
+sequential consistency per variable, (2) `WaitForVar` / `WaitForAll` sync
+points, (3) a serializing `NaiveEngine` debug mode, (4) bulk-execution fusion.
+
+On TPU, XLA/PJRT already gives (1): `jax` dispatch is asynchronous and PJRT
+buffer semantics preserve per-buffer ordering (read-after-write etc.), so we
+do not rebuild a threaded scheduler for device compute.  What remains host-side
+is bookkeeping for the sync points and the debug mode:
+
+* every eagerly-dispatched output array is registered in a weak set so
+  `waitall()` (reference `MXNDArrayWaitAll`) can block on everything in flight;
+* ``MXNET_ENGINE_TYPE=NaiveEngine`` forces a block after every op, matching
+  the reference's serializing debug engine (`src/engine/naive_engine.cc:50`);
+* `bulk(size)` is kept as an API no-op: whole-graph XLA compilation is the
+  TPU-native generalization of bulk mode (`SURVEY.md` §7).
+"""
+from __future__ import annotations
+
+import os
+import weakref
+import threading
+
+__all__ = ["waitall", "wait_to_read", "bulk", "set_bulk_size", "engine_type"]
+
+_lock = threading.Lock()
+_in_flight = weakref.WeakSet()
+
+
+def engine_type():
+    return os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+
+
+def _naive():
+    return engine_type() == "NaiveEngine"
+
+
+def track(jarr):
+    """Register a dispatched jax.Array; block immediately under NaiveEngine."""
+    if _naive():
+        try:
+            jarr.block_until_ready()
+        except Exception:  # deferred errors surface at wait points, like the reference
+            raise
+        return jarr
+    try:
+        with _lock:
+            _in_flight.add(jarr)
+    except TypeError:
+        pass
+    return jarr
+
+
+def wait_to_read(jarr):
+    """Block until an array's value is ready (reference `NDArray::WaitToRead`)."""
+    jarr.block_until_ready()
+
+
+def waitall():
+    """Block until all outstanding async work completes (reference
+    `Engine::WaitForAll`, `mx.nd.waitall`)."""
+    with _lock:
+        arrs = list(_in_flight)
+        _in_flight.clear()
+    for a in arrs:
+        try:
+            a.block_until_ready()
+        except Exception:
+            raise
+
+
+_bulk_size = 0
+
+
+def set_bulk_size(size):
+    """Reference `Engine::set_bulk_size` (`include/mxnet/engine.h:308-313`).
+
+    Bulk fusion is subsumed by whole-graph XLA compilation; the knob is kept
+    for API parity and returns the previous value.
+    """
+    global _bulk_size
+    prev, _bulk_size = _bulk_size, size
+    return prev
+
+
+class bulk:
+    """Context manager `mx.engine.bulk(size)` (reference `python/mxnet/engine.py`)."""
+
+    def __init__(self, size):
+        self.size = size
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = set_bulk_size(self.size)
+
+    def __exit__(self, *args):
+        set_bulk_size(self._prev)

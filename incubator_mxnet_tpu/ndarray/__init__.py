@@ -1,0 +1,18 @@
+"""`mx.nd` — the eager NDArray package (reference `python/mxnet/ndarray/`)."""
+from . import ndarray
+from .ndarray import (NDArray, array, zeros, ones, full, empty, arange, eye,
+                      linspace, concatenate, moveaxis, waitall,
+                      imperative_invoke, invoke)
+from . import register as _register
+import sys as _sys
+
+# generated op functions (nd.sum, nd.FullyConnected, ...)
+_register.populate(_sys.modules[__name__])
+
+from . import random  # noqa: E402,F401
+from . import utils   # noqa: E402
+from .utils import save, load  # noqa: E402,F401
+from . import sparse  # noqa: E402,F401
+from . import linalg  # noqa: E402,F401
+from . import contrib  # noqa: E402,F401
+from . import image as _image_mod  # noqa: E402,F401

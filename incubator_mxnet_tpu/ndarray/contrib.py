@@ -1,0 +1,94 @@
+"""`mx.nd.contrib` namespace (reference `python/mxnet/ndarray/contrib.py`).
+
+Generated `_contrib_*` ops are exposed without the prefix; plus the imperative
+control-flow helpers `foreach` / `while_loop` / `cond`
+(reference `src/operator/control_flow.cc:1255-1423` — in eager mode these are
+python loops, matching the reference's imperative fallback; under hybridize
+they trace to `lax.scan`/`while_loop`/`cond`).
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+from .ndarray import NDArray, invoke
+from ..ops import registry as _reg
+
+_this = _sys.modules[__name__]
+for _name in _reg.list_ops():
+    if _name.startswith("_contrib_"):
+        _op = _reg.get(_name)
+
+        def _make(op):
+            def fn(*args, **kwargs):
+                out = kwargs.pop("out", None)
+                return invoke(op, list(args), kwargs, out=out)
+            fn.__name__ = op.name[len("_contrib_"):]
+            return fn
+
+        setattr(_this, _name[len("_contrib_"):], _make(_op))
+
+
+def foreach(body, data, init_states):
+    """Imperative foreach (reference control_flow.cc _foreach)."""
+    states = init_states
+    outputs = []
+    length = data[0].shape[0] if isinstance(data, (list, tuple)) else data.shape[0]
+    for i in range(length):
+        if isinstance(data, (list, tuple)):
+            eles = [d[i] for d in data]
+        else:
+            eles = data[i]
+        outs, states = body(eles, states)
+        outputs.append(outs)
+    from . import ndarray as _nd
+    if isinstance(outputs[0], (list, tuple)):
+        stacked = [
+            invoke(_reg.get("stack"), [o[j] for o in outputs],
+                   {"num_args": len(outputs), "axis": 0})
+            for j in range(len(outputs[0]))]
+        return stacked, states
+    stacked = invoke(_reg.get("stack"), outputs,
+                     {"num_args": len(outputs), "axis": 0})
+    return stacked, states
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Imperative while_loop (reference control_flow.cc _while_loop)."""
+    steps = 0
+    outputs = []
+    vars_ = list(loop_vars)
+    while bool(cond(*vars_)):
+        outs, vars_ = func(*vars_)
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        outputs.append(outs)
+        steps += 1
+        if max_iterations is not None and steps >= max_iterations:
+            break
+    if outputs:
+        stacked = [invoke(_reg.get("stack"), [o[j] for o in outputs],
+                          {"num_args": len(outputs), "axis": 0})
+                   for j in range(len(outputs[0]))]
+    else:
+        stacked = []
+    return stacked, vars_
+
+
+def cond(pred, then_func, else_func):
+    """Imperative cond (reference control_flow.cc _cond)."""
+    return then_func() if bool(pred) else else_func()
+
+
+def isinf(data):
+    import jax.numpy as jnp
+    return NDArray(jnp.isinf(data._data).astype("float32"), ctx=data.context)
+
+
+def isnan(data):
+    import jax.numpy as jnp
+    return NDArray(jnp.isnan(data._data).astype("float32"), ctx=data.context)
+
+
+def isfinite(data):
+    import jax.numpy as jnp
+    return NDArray(jnp.isfinite(data._data).astype("float32"), ctx=data.context)

@@ -1,0 +1,51 @@
+"""`mx.nd.image` ops (reference `src/operator/image/image_random.cc`):
+to_tensor, normalize, flips — the Gluon vision-transform backend."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ndarray import NDArray
+
+
+def to_tensor(data):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (reference image_random.cc ToTensor)."""
+    x = data._data.astype("float32") / 255.0
+    if x.ndim == 3:
+        x = jnp.transpose(x, (2, 0, 1))
+    elif x.ndim == 4:
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    return NDArray(x, ctx=data.context)
+
+
+def normalize(data, mean, std):
+    x = data._data
+    mean = jnp.asarray(mean, x.dtype)
+    std = jnp.asarray(std, x.dtype)
+    nd = x.ndim
+    shape = (-1,) + (1,) * (2 if nd >= 3 else 0)
+    return NDArray((x - mean.reshape(shape)) / std.reshape(shape),
+                   ctx=data.context)
+
+
+def flip_left_right(data):
+    return NDArray(jnp.flip(data._data, axis=-1), ctx=data.context)
+
+
+def flip_top_bottom(data):
+    return NDArray(jnp.flip(data._data, axis=-2), ctx=data.context)
+
+
+def random_flip_left_right(data):
+    from .. import random as _r
+    import jax
+    if jax.random.bernoulli(_r.next_key()):
+        return flip_left_right(data)
+    return data
+
+
+def random_flip_top_bottom(data):
+    from .. import random as _r
+    import jax
+    if jax.random.bernoulli(_r.next_key()):
+        return flip_top_bottom(data)
+    return data

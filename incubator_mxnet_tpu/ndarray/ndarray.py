@@ -1,0 +1,621 @@
+"""NDArray: the async n-dim array bound to a device context.
+
+TPU-native re-expression of the reference NDArray
+(`include/mxnet/ndarray.h:61-82`, `src/ndarray/ndarray.cc`,
+python surface `python/mxnet/ndarray/ndarray.py`):
+
+* the buffer is a `jax.Array` committed to the context's PJRT device — HBM
+  for `mx.tpu()`, host memory for `mx.cpu()` (replaces Chunk + Storage);
+* asynchrony: JAX dispatch is async; `wait_to_read()` blocks like the
+  reference's `WaitToRead` (PJRT buffer semantics give per-buffer ordering,
+  replacing engine read/write vars);
+* every operator application goes through `invoke()` below — the equivalent
+  of `MXImperativeInvokeEx` → `Imperative::Invoke` (`src/c_api/c_api_ndarray.cc:43-143`,
+  `src/imperative/imperative.cc:87`): canonicalize static attrs, fetch the
+  jit-cached XLA executable, run, wrap outputs, record on the autograd tape.
+
+Views note (documented divergence): reference basic-slice views alias the
+Chunk; here views are functional copies — `__setitem__` on the *same* NDArray
+object updates it in place, but writes through a separate view object do not
+propagate to the base.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError, np_dtype, dtype_name
+from ..context import Context, current_context, cpu
+from .. import engine as _engine
+from .. import autograd as _autograd
+from ..ops import registry as _reg
+
+__all__ = ["NDArray", "invoke", "array", "zeros", "ones", "full", "empty",
+           "arange", "eye", "linspace", "concatenate", "moveaxis", "waitall",
+           "imperative_invoke"]
+
+
+class NDArray:
+    __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_requires_grad",
+                 "_stype", "_deferred_init", "__weakref__")
+
+    def __init__(self, data, ctx=None, stype="default"):
+        self._data = data
+        self._ctx = ctx if ctx is not None else _infer_ctx(data)
+        self._grad = None
+        self._grad_req = None
+        self._requires_grad = False
+        self._stype = stype
+
+    # -- basic properties ----------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._data.dtype)
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self):
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return self._stype
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @property
+    def handle(self):
+        """Reference keeps a ctypes handle; here the jax.Array is the handle."""
+        return self._data
+
+    def __repr__(self):
+        try:
+            arr = self.asnumpy()
+        except Exception as e:  # deferred async error surfaces here, like the ref
+            return f"<NDArray {self.shape} @{self._ctx} (error: {e})>"
+        return f"{arr}\n<NDArray {'x'.join(map(str, self.shape))} @{self._ctx}>"
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("The truth value of an NDArray with multiple "
+                             "elements is ambiguous.")
+        return bool(self.asnumpy().item())
+
+    def __float__(self):
+        return float(self.asnumpy().item())
+
+    def __int__(self):
+        return int(self.asnumpy().item())
+
+    def __index__(self):
+        return int(self)
+
+    # -- sync / conversion ---------------------------------------------------
+    def wait_to_read(self):
+        """Block until the value is computed (reference `NDArray::WaitToRead`)."""
+        _engine.wait_to_read(self._data)
+
+    def asnumpy(self):
+        """Copy to a numpy array, blocking (reference `ndarray.py asnumpy`)."""
+        return _np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(-1)[0]
+
+    def item(self):
+        return self.asnumpy().item()
+
+    def astype(self, dtype, copy=True):
+        d = np_dtype(dtype)
+        if not copy and self.dtype == d:
+            return self
+        return _apply_op("Cast", [self], {"dtype": dtype_name(d)})
+
+    def copy(self):
+        return _apply_op("_copy", [self], {})
+
+    def copyto(self, other):
+        """Cross-device copy (reference `CopyFromTo`, `src/ndarray/ndarray.cc:1147`)."""
+        import jax
+        if isinstance(other, Context):
+            out = NDArray(jax.device_put(self._data, other.jax_device), ctx=other)
+            return out
+        if isinstance(other, NDArray):
+            other._set_data(jax.device_put(self._data.astype(other.dtype),
+                                           other._ctx.jax_device))
+            return other
+        raise TypeError("copyto target must be NDArray or Context")
+
+    def as_in_context(self, context):
+        if context == self._ctx:
+            return self
+        return self.copyto(context)
+
+    as_in_ctx = as_in_context
+
+    def as_nd_ndarray(self):
+        return self
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self
+        from . import sparse as _sparse
+        return _sparse.cast_storage(self, stype)
+
+    # -- autograd ------------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        """Allocate a gradient buffer (reference `ndarray.py attach_grad`)."""
+        import jax.numpy as jnp
+        g = NDArray(jnp.zeros(self.shape, dtype=self._data.dtype), ctx=self._ctx)
+        self._mark_variable(g, grad_req)
+
+    def _mark_variable(self, grad_nd, grad_req):
+        self._grad = grad_nd
+        self._grad_req = grad_req
+        self._requires_grad = grad_req != "null"
+
+    def detach(self):
+        out = NDArray(self._data, ctx=self._ctx)
+        return out
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        _autograd.backward([self], [out_grad], retain_graph=retain_graph,
+                           train_mode=train_mode)
+
+    # -- in-place data replacement (engine write-dependency analogue) --------
+    def _set_data(self, jarr):
+        if _autograd.is_recording() and self._requires_grad:
+            raise MXNetError("In-place write to an array that requires grad "
+                             "while recording (reference raises the same)")
+        self._data = jarr
+
+    # -- shape ops -----------------------------------------------------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        reverse = kwargs.get("reverse", False)
+        return _apply_op("Reshape", [self], {"shape": shape, "reverse": reverse})
+
+    def reshape_like(self, other):
+        return self.reshape(other.shape)
+
+    @property
+    def T(self):
+        return _apply_op("transpose", [self], {"axes": ()})
+
+    # -- indexing ------------------------------------------------------------
+    def __getitem__(self, key):
+        _check_bool_index(key)
+        if isinstance(key, NDArray):
+            return _apply_op("_index_nd", [self, key], {})
+        if isinstance(key, _np.ndarray) and key.dtype != _np.bool_:
+            return _apply_op("_index_nd", [self, array(key, ctx=self._ctx,
+                                                       dtype="int32")], {})
+        if _is_basic_index(key):
+            return _apply_op("_index", [self], {"key": key})
+        # mixed advanced indexing: functional fallback (not recorded on tape)
+        jkey = _convert_index(key)
+        return NDArray(self._data[jkey], ctx=self._ctx)
+
+    def __setitem__(self, key, value):
+        import jax.numpy as jnp
+        if isinstance(value, NDArray):
+            value = value._data
+        value = jnp.asarray(value, dtype=self._data.dtype)
+        if key is None or (isinstance(key, slice) and key == slice(None)):
+            self._set_data(jnp.broadcast_to(value, self.shape) + 0)
+            return
+        jkey = _convert_index(key)
+        self._set_data(self._data.at[jkey].set(value))
+
+    # -- arithmetic operators ------------------------------------------------
+    def __add__(self, other):
+        return _binary(self, other, "broadcast_add", "_plus_scalar")
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __iadd__(self, other):
+        out = self.__add__(other)
+        self._set_data(out._data.astype(self._data.dtype))
+        return self
+
+    def __sub__(self, other):
+        return _binary(self, other, "broadcast_sub", "_minus_scalar")
+
+    def __rsub__(self, other):
+        return _binary(self, other, None, "_rminus_scalar")
+
+    def __isub__(self, other):
+        out = self.__sub__(other)
+        self._set_data(out._data.astype(self._data.dtype))
+        return self
+
+    def __mul__(self, other):
+        return _binary(self, other, "broadcast_mul", "_mul_scalar")
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __imul__(self, other):
+        out = self.__mul__(other)
+        self._set_data(out._data.astype(self._data.dtype))
+        return self
+
+    def __truediv__(self, other):
+        return _binary(self, other, "broadcast_div", "_div_scalar")
+
+    def __rtruediv__(self, other):
+        return _binary(self, other, None, "_rdiv_scalar")
+
+    def __itruediv__(self, other):
+        out = self.__truediv__(other)
+        self._set_data(out._data.astype(self._data.dtype))
+        return self
+
+    def __mod__(self, other):
+        return _binary(self, other, "broadcast_mod", "_mod_scalar")
+
+    def __rmod__(self, other):
+        return _binary(self, other, None, "_rmod_scalar")
+
+    def __pow__(self, other):
+        return _binary(self, other, "broadcast_power", "_power_scalar")
+
+    def __rpow__(self, other):
+        return _binary(self, other, None, "_rpower_scalar")
+
+    def __neg__(self):
+        return _apply_op("negative", [self], {})
+
+    def __abs__(self):
+        return _apply_op("abs", [self], {})
+
+    def __eq__(self, other):
+        return _binary(self, other, "broadcast_equal", "_equal_scalar")
+
+    def __ne__(self, other):
+        return _binary(self, other, "broadcast_not_equal", "_not_equal_scalar")
+
+    def __gt__(self, other):
+        return _binary(self, other, "broadcast_greater", "_greater_scalar")
+
+    def __ge__(self, other):
+        return _binary(self, other, "broadcast_greater_equal", "_greater_equal_scalar")
+
+    def __lt__(self, other):
+        return _binary(self, other, "broadcast_lesser", "_lesser_scalar")
+
+    def __le__(self, other):
+        return _binary(self, other, "broadcast_lesser_equal", "_lesser_equal_scalar")
+
+    def __hash__(self):
+        return id(self)
+
+    def __matmul__(self, other):
+        return _apply_op("dot", [self, other], {})
+
+    def __getstate__(self):
+        return {"data": self.asnumpy(), "ctx": str(self._ctx), "stype": self._stype}
+
+    def __setstate__(self, state):
+        import jax.numpy as jnp
+        self._data = jnp.asarray(state["data"])
+        dt, did = state["ctx"].split("(")
+        self._ctx = Context(dt, int(did.rstrip(")")))
+        self._grad = None
+        self._grad_req = None
+        self._requires_grad = False
+        self._stype = state.get("stype", "default")
+
+
+def _infer_ctx(jarr):
+    try:
+        dev = next(iter(jarr.devices()))
+        if dev.platform == "cpu":
+            return cpu(dev.id)
+        return Context("tpu", dev.id)
+    except Exception:
+        return current_context()
+
+
+def _is_basic_index(key):
+    basic = (int, slice, type(None), type(Ellipsis), _np.integer)
+    if isinstance(key, basic):
+        return True
+    if isinstance(key, tuple):
+        return all(isinstance(k, basic) for k in key)
+    return False
+
+
+def _check_bool_index(key):
+    def bad(k):
+        if isinstance(k, NDArray) and k.dtype == _np.bool_:
+            return True
+        if isinstance(k, _np.ndarray) and k.dtype == _np.bool_:
+            return True
+        return False
+    items = key if isinstance(key, tuple) else (key,)
+    for k in items:
+        if bad(k):
+            raise MXNetError("boolean-mask indexing produces dynamic shapes "
+                             "and is not supported (reference NDArray raises "
+                             "for unsupported index types); use nd.where or "
+                             "contrib.boolean_mask alternatives")
+
+
+def _convert_index(key):
+    if isinstance(key, NDArray):
+        return key._data.astype("int32")
+    if isinstance(key, tuple):
+        return tuple(_convert_index(k) for k in key)
+    if isinstance(key, list):
+        return _np.asarray(key)
+    return key
+
+
+def _binary(lhs, rhs, tensor_op, scalar_op):
+    if isinstance(rhs, NDArray):
+        if tensor_op is None:
+            raise TypeError("unsupported operand")
+        return _apply_op(tensor_op, [lhs, rhs], {})
+    if isinstance(rhs, (int, float, bool, _np.generic)):
+        return _apply_op(scalar_op, [lhs], {"scalar": float(rhs)})
+    if isinstance(rhs, _np.ndarray):
+        return _apply_op(tensor_op, [lhs, array(rhs, ctx=lhs.context)], {})
+    return NotImplemented
+
+
+# ---------------------------------------------------------------------------
+# Eager dispatch — the Imperative::Invoke equivalent
+# ---------------------------------------------------------------------------
+
+def _apply_op(op_name, data, kwargs, out=None):
+    return invoke(_reg.get(op_name), data, kwargs, out=out)
+
+
+def invoke(op, data, kwargs, out=None):
+    """Run a registered op on NDArray inputs eagerly.
+
+    Mirrors `Imperative::Invoke` (`src/imperative/imperative.cc:87`): attrs are
+    canonicalized, the XLA executable is fetched from the jit cache
+    (`PushFCompute` analogue), outputs are wrapped, aux states written back,
+    and the call recorded on the autograd tape when recording.
+    """
+    kwargs = dict(kwargs)
+    kwargs.pop("name", None)
+    kwargs.pop("attr", None)
+    ctx_kw = kwargs.pop("ctx", None) if "ctx" not in op.params else None
+    if "ctx" in op.params:
+        ctx_kw = kwargs.get("ctx")
+    params = op.canonicalize_params(kwargs)
+    ctx_param = params.pop("ctx", None)
+    ctx = ctx_kw or ctx_param
+
+    if op.mode_dependent:
+        params["_train"] = _autograd.is_training()
+
+    in_arrays = [d._data if isinstance(d, NDArray) else d for d in data]
+    n_aux = op.num_aux(params)
+
+    if op.dynamic_params:
+        import jax.numpy as jnp
+        for pname in op.dynamic_params:
+            pval = params.pop(pname)
+            in_arrays.append(jnp.asarray(pval, dtype="float32"))
+
+    if op.needs_rng:
+        from .. import random as _random
+        in_arrays = in_arrays + [_random.next_key()]
+
+    results = _reg.eager_call(op, params, in_arrays)
+    n_out = op.num_outputs(params)
+    vis, aux_updates = results[:n_out], results[n_out:]
+
+    # device/context resolution
+    if data:
+        out_ctx = data[0].context if isinstance(data[0], NDArray) else current_context()
+    else:
+        out_ctx = ctx if isinstance(ctx, Context) else (
+            Context(*_parse_ctx(ctx)) if isinstance(ctx, str) else current_context())
+        import jax
+        vis = tuple(jax.device_put(v, out_ctx.jax_device) for v in vis)
+
+    for v in vis:
+        _engine.track(v)
+
+    # write updated aux states in place (BatchNorm running stats etc.)
+    if aux_updates and n_aux:
+        aux_arrays = data[-n_aux:]
+        for a, upd in zip(aux_arrays, aux_updates):
+            if isinstance(a, NDArray):
+                a._data = upd  # bypass recording guard: aux carries no grad
+
+    outputs = [NDArray(v, ctx=out_ctx) for v in vis]
+
+    if (_autograd.is_recording() and not op.stop_grad
+            and any(getattr(d, "_requires_grad", False) for d in data
+                    if isinstance(d, NDArray))):
+        nd_inputs = [d if isinstance(d, NDArray) else None for d in data]
+        _autograd._record_op(op, params, nd_inputs, in_arrays, outputs, n_out)
+        for o in outputs:
+            o._requires_grad = True
+
+    if out is not None:
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        if len(outs) != len(outputs):
+            raise MXNetError(f"Operator {op.name}: out= expects {len(outputs)} "
+                             f"arrays, got {len(outs)}")
+        if _autograd.is_recording() and any(
+                getattr(d, "_requires_grad", False) for d in data
+                if isinstance(d, NDArray)):
+            # reference raises for in-place outputs while recording
+            raise MXNetError("Assigning to out= arrays is not supported when "
+                             "recording with autograd")
+        for tgt, o in zip(outs, outputs):
+            tgt._set_data(o._data.astype(tgt.dtype))
+        return out
+    if len(outputs) == 1:
+        return outputs[0]
+    return outputs
+
+
+def imperative_invoke(op_name, *data, **kwargs):
+    """String-name invoke (the `MXImperativeInvokeEx` surface)."""
+    out = kwargs.pop("out", None)
+    return invoke(_reg.get(op_name), list(data), kwargs, out=out)
+
+
+def _parse_ctx(s):
+    dt, _, rest = s.partition("(")
+    did = int(rest.rstrip(")")) if rest else 0
+    return dt, did
+
+
+# ---------------------------------------------------------------------------
+# Creation functions (reference python/mxnet/ndarray/ndarray.py + utils)
+# ---------------------------------------------------------------------------
+
+def array(source_array, ctx=None, dtype=None):
+    import jax
+    import jax.numpy as jnp
+    ctx = ctx or current_context()
+    if isinstance(source_array, NDArray):
+        src = source_array._data
+        if dtype is not None:
+            src = src.astype(np_dtype(dtype))
+        return NDArray(jax.device_put(src, ctx.jax_device), ctx=ctx)
+    # MXNet semantics: dtype defaults to float32 for any non-NDArray source
+    # (reference `python/mxnet/ndarray/ndarray.py array()`)
+    np_arr = _np.asarray(source_array,
+                         dtype=np_dtype(dtype) if dtype is not None else _np.float32)
+    return NDArray(jax.device_put(jnp.asarray(np_arr), ctx.jax_device), ctx=ctx)
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def zeros(shape, ctx=None, dtype=None, **kwargs):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _apply_op("_zeros", [], {"shape": shape, "dtype": dtype_name(dtype or "float32"),
+                                    "ctx": ctx or current_context()})
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _apply_op("_ones", [], {"shape": shape, "dtype": dtype_name(dtype or "float32"),
+                                   "ctx": ctx or current_context()})
+
+
+def full(shape, val, ctx=None, dtype=None, out=None):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return _apply_op("_full", [], {"shape": shape, "value": val,
+                                   "dtype": dtype_name(dtype or "float32"),
+                                   "ctx": ctx or current_context()}, out=out)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    return _apply_op("_arange", [], {"start": start, "stop": stop, "step": step,
+                                     "repeat": repeat,
+                                     "dtype": dtype_name(dtype or "float32"),
+                                     "ctx": ctx or current_context()})
+
+
+def eye(N, M=0, k=0, ctx=None, dtype=None):
+    return _apply_op("_eye", [], {"N": N, "M": M, "k": k,
+                                  "dtype": dtype_name(dtype or "float32"),
+                                  "ctx": ctx or current_context()})
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype=None):
+    return _apply_op("_linspace", [], {"start": start, "stop": stop, "num": num,
+                                       "endpoint": endpoint,
+                                       "dtype": dtype_name(dtype or "float32"),
+                                       "ctx": ctx or current_context()})
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    return _apply_op("Concat", list(arrays),
+                     {"num_args": len(arrays), "dim": axis})
+
+
+def moveaxis(tensor, source, destination):
+    axes = list(range(tensor.ndim))
+    axes.remove(source % tensor.ndim)
+    axes.insert(destination % tensor.ndim, source % tensor.ndim)
+    return _apply_op("transpose", [tensor], {"axes": tuple(axes)})
+
+
+def waitall():
+    _engine.waitall()
+
+
+# ---------------------------------------------------------------------------
+# Attach registry-op convenience methods to NDArray (the reference code-gens
+# these from the op registry at import, `python/mxnet/ndarray/register.py`).
+# ---------------------------------------------------------------------------
+
+_METHOD_OPS = [
+    "sum", "mean", "prod", "max", "min", "argmax", "argmin", "norm",
+    "abs", "sign", "exp", "log", "log2", "log10", "log1p", "expm1",
+    "sqrt", "rsqrt", "square", "cbrt", "sin", "cos", "tan", "arcsin",
+    "arccos", "arctan", "sinh", "cosh", "tanh", "arcsinh", "arccosh",
+    "arctanh", "sigmoid", "relu", "softmax", "log_softmax", "clip",
+    "round", "rint", "floor", "ceil", "trunc", "fix", "flatten",
+    "expand_dims", "squeeze", "swapaxes", "split", "slice", "slice_axis",
+    "take", "one_hot", "topk", "sort", "argsort", "tile", "repeat",
+    "pad", "flip", "transpose", "dot", "batch_dot", "broadcast_to",
+    "broadcast_like", "broadcast_axes", "zeros_like", "ones_like",
+    "reshape_like", "diag", "nansum", "nanprod", "reciprocal", "erf",
+    "erfinv", "gamma", "gammaln", "degrees", "radians", "softsign",
+    "argmax_channel", "shape_array", "size_array",
+]
+
+
+def _make_method(op_name):
+    def method(self, *args, **kwargs):
+        out = kwargs.pop("out", None)
+        return invoke(_reg.get(op_name), [self] + list(args), kwargs, out=out)
+    method.__name__ = op_name
+    return method
+
+
+def _attach_methods():
+    for name in _METHOD_OPS:
+        if _reg.maybe_get(name) is None:
+            continue
+        if hasattr(NDArray, name):
+            continue
+        setattr(NDArray, name, _make_method(name))
+
+
+_attach_methods()

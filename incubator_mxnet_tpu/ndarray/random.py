@@ -1,0 +1,84 @@
+"""`mx.nd.random` (reference `python/mxnet/ndarray/random.py`)."""
+from __future__ import annotations
+
+from .ndarray import NDArray, invoke
+from ..ops import registry as _reg
+
+
+def _rand(opname, sample_opname, *dist_args, shape=(), dtype="float32",
+          ctx=None, out=None, **kwargs):
+    if dist_args and isinstance(dist_args[0], NDArray):
+        op = _reg.get(sample_opname)
+        return invoke(op, list(dist_args), {"shape": shape, "dtype": dtype},
+                      out=out)
+    op = _reg.get(opname)
+    params = dict(kwargs)
+    params.update({"shape": shape, "dtype": dtype, "ctx": ctx})
+    return invoke(op, [], params, out=out)
+
+
+def uniform(low=0, high=1, shape=(), dtype="float32", ctx=None, out=None):
+    return _rand("_random_uniform", "_sample_uniform", *(
+        (low, high) if isinstance(low, NDArray) else ()),
+        shape=shape, dtype=dtype, ctx=ctx, out=out,
+        **({} if isinstance(low, NDArray) else {"low": low, "high": high}))
+
+
+def normal(loc=0, scale=1, shape=(), dtype="float32", ctx=None, out=None):
+    return _rand("_random_normal", "_sample_normal", *(
+        (loc, scale) if isinstance(loc, NDArray) else ()),
+        shape=shape, dtype=dtype, ctx=ctx, out=out,
+        **({} if isinstance(loc, NDArray) else {"loc": loc, "scale": scale}))
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype="float32", ctx=None):
+    return normal(loc=loc, scale=scale, shape=shape, dtype=dtype, ctx=ctx)
+
+
+def gamma(alpha=1, beta=1, shape=(), dtype="float32", ctx=None, out=None):
+    return _rand("_random_gamma", "_sample_gamma", *(
+        (alpha, beta) if isinstance(alpha, NDArray) else ()),
+        shape=shape, dtype=dtype, ctx=ctx, out=out,
+        **({} if isinstance(alpha, NDArray) else {"alpha": alpha, "beta": beta}))
+
+
+def exponential(lam=1, shape=(), dtype="float32", ctx=None, out=None):
+    return _rand("_random_exponential", "_random_exponential",
+                 shape=shape, dtype=dtype, ctx=ctx, out=out, lam=lam)
+
+
+def poisson(lam=1, shape=(), dtype="float32", ctx=None, out=None):
+    return _rand("_random_poisson", "_random_poisson",
+                 shape=shape, dtype=dtype, ctx=ctx, out=out, lam=lam)
+
+
+def negative_binomial(k=1, p=1, shape=(), dtype="float32", ctx=None, out=None):
+    return _rand("_random_negative_binomial", "_random_negative_binomial",
+                 shape=shape, dtype=dtype, ctx=ctx, out=out, k=k, p=p)
+
+
+def generalized_negative_binomial(mu=1, alpha=1, shape=(), dtype="float32",
+                                  ctx=None, out=None):
+    return _rand("_random_generalized_negative_binomial",
+                 "_random_generalized_negative_binomial",
+                 shape=shape, dtype=dtype, ctx=ctx, out=out, mu=mu, alpha=alpha)
+
+
+def randint(low, high, shape=(), dtype="int32", ctx=None, out=None):
+    return _rand("_random_randint", "_random_randint",
+                 shape=shape, dtype=dtype, ctx=ctx, out=out, low=low, high=high)
+
+
+def multinomial(data, shape=(), get_prob=False, out=None, dtype="int32"):
+    op = _reg.get("_sample_multinomial")
+    return invoke(op, [data], {"shape": shape, "get_prob": get_prob,
+                               "dtype": dtype}, out=out)
+
+
+def shuffle(data, out=None):
+    return invoke(_reg.get("_shuffle"), [data], {}, out=out)
+
+
+def seed(seed_state, ctx="all"):
+    from .. import random as _r
+    _r.seed(seed_state, ctx)

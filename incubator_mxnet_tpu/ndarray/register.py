@@ -1,0 +1,58 @@
+"""Generate module-level NDArray op functions from the registry.
+
+Mirrors the reference's import-time code generation
+(`python/mxnet/ndarray/register.py:30-169` `_make_ndarray_function` over
+`MXSymbolListAtomicSymbolCreators`): every registered op becomes a function in
+`incubator_mxnet_tpu.ndarray` (public names) / `.ndarray._internal`
+(underscore names), with the op docstring attached.
+"""
+from __future__ import annotations
+
+import sys
+import types
+
+from ..ops import registry as _reg
+from .ndarray import NDArray, invoke
+
+_internal = types.ModuleType("incubator_mxnet_tpu.ndarray._internal")
+sys.modules["incubator_mxnet_tpu.ndarray._internal"] = _internal
+
+
+def _make_function(op):
+    def fn(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        data = []
+        for a in args:
+            if isinstance(a, NDArray):
+                data.append(a)
+            elif isinstance(a, (list, tuple)) and all(
+                    isinstance(x, NDArray) for x in a):
+                data.extend(a)
+            else:
+                raise TypeError(
+                    f"Operator {op.name}: positional arguments must be "
+                    f"NDArray, got {type(a).__name__}")
+        if op.variadic_param and op.variadic_param not in kwargs:
+            kwargs[op.variadic_param] = len(data)
+        return invoke(op, data, kwargs, out=out)
+
+    fn.__name__ = op.name
+    fn.__doc__ = op.doc or f"TPU-native operator `{op.name}`."
+    return fn
+
+
+def populate(target_module):
+    """Attach one function per registered op (call after all op modules load)."""
+    seen = set()
+    for name in _reg.list_ops():
+        op = _reg.get(name)
+        if id(op) in seen and name != op.name:
+            pass
+        seen.add(id(op))
+        f = _make_function(op)
+        f.__name__ = name
+        setattr(_internal, name, f)
+        if not name.startswith("_"):
+            if not hasattr(target_module, name):
+                setattr(target_module, name, f)
+    target_module._internal = _internal

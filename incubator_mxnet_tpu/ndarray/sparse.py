@@ -1,0 +1,197 @@
+"""Sparse NDArray types (reference `python/mxnet/ndarray/sparse.py`,
+`include/mxnet/ndarray.h:61-65` row_sparse/csr storage types).
+
+TPU design stance (SURVEY.md §7 hard part (d)): TPUs have no efficient
+scatter/gather sparse formats, so sparse storage lives host-side as
+numpy-backed structures; `tostype('default')` densifies onto the device and
+dense↔sparse conversions are explicit.  The API surface (RowSparseNDArray /
+CSRNDArray / cast_storage / sparse dot) is preserved for parity; compute on
+sparse inputs densifies first (documented, as MKLDNN fallback does in the
+reference).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .ndarray import NDArray, array as _dense_array
+from ..base import MXNetError
+from ..context import current_context
+
+
+class BaseSparseNDArray(NDArray):
+    pass
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """row_sparse: (indices, values) over axis 0 (reference sparse.py:RowSparseNDArray)."""
+
+    def __init__(self, data, indices, shape, ctx=None):
+        self._np_data = np.asarray(data)
+        self._np_indices = np.asarray(indices, dtype=np.int64)
+        self._shape = tuple(shape)
+        self._ctx = ctx or current_context()
+        self._grad = None
+        self._grad_req = None
+        self._requires_grad = False
+        self._stype = "row_sparse"
+        self._data = None  # dense buffer created lazily by tostype
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._np_data.dtype
+
+    @property
+    def indices(self):
+        return _dense_array(self._np_indices, ctx=self._ctx)
+
+    @property
+    def data(self):
+        return _dense_array(self._np_data, ctx=self._ctx)
+
+    def asnumpy(self):
+        out = np.zeros(self._shape, dtype=self._np_data.dtype)
+        if len(self._np_indices):
+            out[self._np_indices] = self._np_data
+        return out
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            return _dense_array(self.asnumpy(), ctx=self._ctx)
+        raise MXNetError(f"cannot cast row_sparse to {stype}")
+
+    def wait_to_read(self):
+        pass
+
+    def __repr__(self):
+        return f"<RowSparseNDArray {self._shape} @{self._ctx}>"
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """csr: (data, indices, indptr) 2-D sparse (reference sparse.py:CSRNDArray)."""
+
+    def __init__(self, data, indices, indptr, shape, ctx=None):
+        self._np_data = np.asarray(data)
+        self._np_indices = np.asarray(indices, dtype=np.int64)
+        self._np_indptr = np.asarray(indptr, dtype=np.int64)
+        self._shape = tuple(shape)
+        self._ctx = ctx or current_context()
+        self._grad = None
+        self._grad_req = None
+        self._requires_grad = False
+        self._stype = "csr"
+        self._data = None
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._np_data.dtype
+
+    @property
+    def indices(self):
+        return _dense_array(self._np_indices, ctx=self._ctx)
+
+    @property
+    def indptr(self):
+        return _dense_array(self._np_indptr, ctx=self._ctx)
+
+    @property
+    def data(self):
+        return _dense_array(self._np_data, ctx=self._ctx)
+
+    def asnumpy(self):
+        m, n = self._shape
+        out = np.zeros((m, n), dtype=self._np_data.dtype)
+        for i in range(m):
+            for jpos in range(self._np_indptr[i], self._np_indptr[i + 1]):
+                out[i, self._np_indices[jpos]] = self._np_data[jpos]
+        return out
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return _dense_array(self.asnumpy(), ctx=self._ctx)
+        raise MXNetError(f"cannot cast csr to {stype}")
+
+    def wait_to_read(self):
+        pass
+
+    def __repr__(self):
+        return f"<CSRNDArray {self._shape} @{self._ctx}>"
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        if isinstance(data, NDArray):
+            data = data.asnumpy()
+        if isinstance(indices, NDArray):
+            indices = indices.asnumpy()
+        return RowSparseNDArray(np.asarray(data, dtype=dtype), indices, shape, ctx)
+    dense = np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1,
+                       dtype=dtype)
+    nz = np.where(np.any(dense.reshape(dense.shape[0], -1) != 0, axis=1))[0]
+    return RowSparseNDArray(dense[nz], nz, dense.shape, ctx)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        vals = [x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+                for x in (data, indices, indptr)]
+        return CSRNDArray(vals[0].astype(dtype) if dtype else vals[0],
+                          vals[1], vals[2], shape, ctx)
+    dense = np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1,
+                       dtype=dtype)
+    m, n = dense.shape
+    indptr = [0]
+    indices = []
+    data = []
+    for i in range(m):
+        nz = np.where(dense[i] != 0)[0]
+        indices.extend(nz.tolist())
+        data.extend(dense[i, nz].tolist())
+        indptr.append(len(indices))
+    return CSRNDArray(np.asarray(data, dtype=dense.dtype), indices, indptr,
+                      (m, n), ctx)
+
+
+def cast_storage(arr, stype):
+    """Reference `cast_storage.cc`."""
+    if stype == "default":
+        return arr.tostype("default") if isinstance(arr, BaseSparseNDArray) else arr
+    if stype == "row_sparse":
+        return row_sparse_array(arr.asnumpy())
+    if stype == "csr":
+        return csr_matrix(arr.asnumpy())
+    raise MXNetError(f"unknown stype {stype}")
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    dtype = dtype or "float32"
+    if stype == "row_sparse":
+        return RowSparseNDArray(np.zeros((0,) + tuple(shape[1:]), dtype=dtype),
+                                np.zeros((0,), dtype=np.int64), shape, ctx)
+    if stype == "csr":
+        return CSRNDArray(np.zeros((0,), dtype=dtype), [], [0] * (shape[0] + 1),
+                          shape, ctx)
+    from . import ndarray as _nd
+    return _nd.zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse dot densifies (documented TPU fallback)."""
+    from .ndarray import _apply_op
+    l = lhs.tostype("default") if isinstance(lhs, BaseSparseNDArray) else lhs
+    r = rhs.tostype("default") if isinstance(rhs, BaseSparseNDArray) else rhs
+    return _apply_op("dot", [l, r], {"transpose_a": transpose_a,
+                                     "transpose_b": transpose_b})

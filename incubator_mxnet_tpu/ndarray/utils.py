@@ -1,0 +1,67 @@
+"""NDArray save/load (reference `python/mxnet/ndarray/utils.py:149-222`,
+binary container `src/ndarray/ndarray.cc:1537`).
+
+Format: the reference's container is a dmlc binary stream with a magic word,
+an NDArray list and a name list.  We write the same *logical* content —
+(names, arrays) — as an uncompressed ``.npz``-style zip with a magic entry, so
+checkpoints are portable and inspectable.  `load` also accepts real numpy
+``.npz`` files.  Byte-compatibility with reference `.params` files is provided
+by `incubator_mxnet_tpu.compat.mxnet_params` (reader).
+"""
+from __future__ import annotations
+
+import io
+import zipfile
+
+import numpy as np
+
+from .ndarray import NDArray, array
+from ..base import MXNetError
+
+_MAGIC = "__incubator_mxnet_tpu_v1__"
+
+
+def save(fname, data):
+    """Save NDArrays (reference `mx.nd.save`): list or dict of arrays."""
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+    elif isinstance(data, (list, tuple)):
+        names = [str(i) for i in range(len(data))]
+        arrays = list(data)
+    else:
+        raise MXNetError("save: data must be NDArray, list, or dict")
+    npys = {}
+    for n, a in zip(names, arrays):
+        npys[n] = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
+    with zipfile.ZipFile(fname, "w", zipfile.ZIP_STORED) as zf:
+        zf.writestr(_MAGIC, b"1")
+        meta_is_list = isinstance(data, (list, tuple))
+        zf.writestr("__meta__", b"list" if meta_is_list else b"dict")
+        for n, arr in npys.items():
+            buf = io.BytesIO()
+            np.save(buf, arr, allow_pickle=False)
+            zf.writestr(n + ".npy", buf.getvalue())
+
+
+def load(fname, ctx=None):
+    """Load NDArrays saved by `save` (reference `mx.nd.load`)."""
+    with zipfile.ZipFile(fname, "r") as zf:
+        names = zf.namelist()
+        if _MAGIC not in names:
+            # plain npz fallback
+            out = {}
+            for n in names:
+                if n.endswith(".npy"):
+                    out[n[:-4]] = array(np.load(io.BytesIO(zf.read(n))), ctx=ctx)
+            return out
+        meta = zf.read("__meta__").decode()
+        out = {}
+        for n in names:
+            if n.endswith(".npy"):
+                out[n[:-4]] = array(np.load(io.BytesIO(zf.read(n))), ctx=ctx)
+        if meta == "list":
+            return [out[str(i)] for i in range(len(out))]
+        return out

@@ -1,0 +1,133 @@
+"""Contrib ops, wave 1 (reference `src/operator/contrib/`).
+
+Detection heads (multibox*, proposal, roi ops) land with the SSD model family;
+this module carries the general-purpose contrib ops: quadratic (the tutorial
+op, `quadratic_op.cc`), arange_like, interleaved attention matmuls
+(`transformer-inl.h`), adaptive pooling, bilinear resize, count_sketch-free
+basics, and the index ops used by detection pipelines.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, REQUIRED
+
+
+@register("_contrib_quadratic", aliases=("quadratic",),
+          params={"a": 0.0, "b": 0.0, "c": 0.0})
+def _quadratic(params, x):
+    """Reference `contrib/quadratic_op.cc`: a*x^2 + b*x + c."""
+    return params["a"] * jnp.square(x) + params["b"] * x + params["c"]
+
+
+@register("_contrib_arange_like", params={"start": 0.0, "step": 1.0,
+                                          "repeat": 1, "axis": None})
+def _arange_like(params, x):
+    axis = params["axis"]
+    repeat = max(int(params["repeat"]), 1)
+    if axis is None:
+        n = -(-x.size // repeat)
+        out = params["start"] + params["step"] * jnp.arange(n, dtype=x.dtype)
+        if repeat > 1:
+            out = jnp.repeat(out, repeat)[:x.size]
+        return out.reshape(x.shape)
+    n = x.shape[int(axis)]
+    out = params["start"] + params["step"] * jnp.arange(
+        -(-n // repeat), dtype=x.dtype)
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)[:n]
+    return out
+
+
+@register("_contrib_AdaptiveAvgPooling2D", params={"output_size": ()})
+def _adaptive_avg_pool(params, x):
+    """Reference `contrib/adaptive_avg_pooling.cc`."""
+    os = params["output_size"]
+    if not os:
+        oh = ow = 1
+    elif isinstance(os, int):
+        oh = ow = int(os)
+    else:
+        oh, ow = int(os[0]), int(os[1])
+    n, c, h, w = x.shape
+    if h % oh == 0 and w % ow == 0:
+        x2 = x.reshape(n, c, oh, h // oh, ow, w // ow)
+        return x2.mean(axis=(3, 5))
+    return jax.image.resize(x, (n, c, oh, ow), method="linear")
+
+
+@register("_contrib_BilinearResize2D",
+          params={"height": 1, "width": 1, "scale_height": None,
+                  "scale_width": None, "mode": "size"})
+def _bilinear_resize(params, x):
+    n, c, h, w = x.shape
+    if params["scale_height"] is not None:
+        oh = int(round(h * float(params["scale_height"])))
+        ow = int(round(w * float(params["scale_width"] or params["scale_height"])))
+    else:
+        oh, ow = int(params["height"]), int(params["width"])
+    return jax.image.resize(x, (n, c, oh, ow), method="bilinear")
+
+
+# -- attention matmuls (reference contrib/transformer-inl.h): interleaved
+# qkv projections used by the transformer example.
+@register("_contrib_div_sqrt_dim")
+def _div_sqrt_dim(params, x):
+    return x / jnp.sqrt(jnp.asarray(x.shape[-1], x.dtype))
+
+
+@register("_contrib_interleaved_matmul_selfatt_qk", nin=1,
+          params={"heads": REQUIRED})
+def _interleaved_qk(params, qkv):
+    """qkv: (L, B, H*3*D) interleaved; returns (B*H, L, L) scores."""
+    heads = int(params["heads"])
+    L, B, E = qkv.shape
+    D = E // heads // 3
+    x = qkv.reshape(L, B, heads, 3, D)
+    q = x[:, :, :, 0, :].transpose(1, 2, 0, 3).reshape(B * heads, L, D)
+    k = x[:, :, :, 1, :].transpose(1, 2, 0, 3).reshape(B * heads, L, D)
+    return jnp.matmul(q, k.transpose(0, 2, 1)) / jnp.sqrt(jnp.asarray(D, qkv.dtype))
+
+
+@register("_contrib_interleaved_matmul_selfatt_valatt", nin=2,
+          params={"heads": REQUIRED})
+def _interleaved_valatt(params, qkv, att):
+    heads = int(params["heads"])
+    L, B, E = qkv.shape
+    D = E // heads // 3
+    x = qkv.reshape(L, B, heads, 3, D)
+    v = x[:, :, :, 2, :].transpose(1, 2, 0, 3).reshape(B * heads, L, D)
+    out = jnp.matmul(att, v)  # (B*H, L, D)
+    return out.reshape(B, heads, L, D).transpose(2, 0, 1, 3).reshape(L, B, heads * D)
+
+
+@register("_contrib_boolean_mask_supported", nin=0, params={})
+def _boolean_mask_supported(params):
+    # dynamic-shape boolean_mask is XLA-incompatible; kept as an explicit stub
+    return jnp.zeros((1,))
+
+
+@register("_contrib_index_copy", nin=3)
+def _index_copy(params, old, idx, new):
+    return old.at[idx.astype("int32")].set(new)
+
+
+@register("_contrib_index_array", nin=1, params={"axes": None})
+def _index_array(params, x):
+    axes = params["axes"]
+    if axes is None:
+        axes = tuple(range(x.ndim))
+    elif isinstance(axes, int):
+        axes = (axes,)
+    grids = jnp.meshgrid(*[jnp.arange(x.shape[a]) for a in axes], indexing="ij")
+    return jnp.stack(grids, axis=-1).astype("int64")
+
+
+@register("_contrib_getnnz", nin=1, params={"axis": None})
+def _getnnz(params, x):
+    axis = params["axis"]
+    nz = (x != 0).astype("int64")
+    if axis is None:
+        return jnp.sum(nz)
+    return jnp.sum(nz, axis=int(axis))

@@ -1,0 +1,49 @@
+"""Creation ops (reference `src/operator/tensor/init_op.cc`)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register, REQUIRED
+
+
+@register("_zeros", nin=0, params={"shape": (), "dtype": "float32"})
+def _zeros(params):
+    return jnp.zeros(tuple(params["shape"]), dtype=params["dtype"] or "float32")
+
+
+@register("_ones", nin=0, params={"shape": (), "dtype": "float32"})
+def _ones(params):
+    return jnp.ones(tuple(params["shape"]), dtype=params["dtype"] or "float32")
+
+
+@register("_full", nin=0, params={"shape": (), "dtype": "float32", "value": REQUIRED})
+def _full(params):
+    return jnp.full(tuple(params["shape"]), params["value"],
+                    dtype=params["dtype"] or "float32")
+
+
+@register("_arange", nin=0,
+          params={"start": 0.0, "stop": None, "step": 1.0, "repeat": 1,
+                  "infer_range": False, "dtype": "float32"})
+def _arange(params):
+    out = jnp.arange(params["start"], params["stop"], params["step"],
+                     dtype=params["dtype"] or "float32")
+    if int(params["repeat"]) > 1:
+        out = jnp.repeat(out, int(params["repeat"]))
+    return out
+
+
+@register("_eye", nin=0, params={"N": REQUIRED, "M": 0, "k": 0, "dtype": "float32"})
+def _eye(params):
+    n = int(params["N"])
+    m = int(params["M"]) or n
+    return jnp.eye(n, m, k=int(params["k"]), dtype=params["dtype"] or "float32")
+
+
+@register("_linspace", nin=0,
+          params={"start": REQUIRED, "stop": REQUIRED, "num": REQUIRED,
+                  "endpoint": True, "dtype": "float32"})
+def _linspace(params):
+    return jnp.linspace(params["start"], params["stop"], int(params["num"]),
+                        endpoint=bool(params["endpoint"]),
+                        dtype=params["dtype"] or "float32")

@@ -1,0 +1,158 @@
+"""Linear-algebra ops (reference `src/operator/tensor/la_op.cc`).
+
+BLAS3/LAPACK family: gemm, gemm2, potrf, potri, trsm, trmm, syrk, gelqf,
+syevd, sumlogdiag, extractdiag/maketrian-style helpers are served by XLA's
+native decompositions (cholesky/qr/eigh lower to TPU-supported HLOs).
+Batch dimensions: all ops operate on the last two axes (as the reference).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.linalg as jsl
+
+from .registry import register
+
+
+@register("linalg_gemm", nin=3,
+          params={"transpose_a": False, "transpose_b": False, "alpha": 1.0,
+                  "beta": 1.0, "axis": -2})
+def _linalg_gemm(params, a, b, c):
+    ta, tb = params["transpose_a"], params["transpose_b"]
+    if ta:
+        a = jnp.swapaxes(a, -1, -2)
+    if tb:
+        b = jnp.swapaxes(b, -1, -2)
+    return float(params["alpha"]) * jnp.matmul(a, b) + float(params["beta"]) * c
+
+
+@register("linalg_gemm2", nin=2,
+          params={"transpose_a": False, "transpose_b": False, "alpha": 1.0,
+                  "axis": -2})
+def _linalg_gemm2(params, a, b):
+    ta, tb = params["transpose_a"], params["transpose_b"]
+    if ta:
+        a = jnp.swapaxes(a, -1, -2)
+    if tb:
+        b = jnp.swapaxes(b, -1, -2)
+    return float(params["alpha"]) * jnp.matmul(a, b)
+
+
+@register("linalg_potrf", nin=1)
+def _linalg_potrf(params, a):
+    return jnp.linalg.cholesky(a)
+
+
+@register("linalg_potri", nin=1)
+def _linalg_potri(params, a):
+    """Inverse of A = L L^T given its Cholesky factor L (reference la_op potri)."""
+    eye = jnp.broadcast_to(jnp.eye(a.shape[-1], dtype=a.dtype),
+                           a.shape[:-2] + (a.shape[-1], a.shape[-1]))
+    linv = jsl.solve_triangular(a, eye, lower=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+@register("linalg_trsm", nin=2,
+          params={"transpose": False, "rightside": False, "lower": True,
+                  "alpha": 1.0})
+def _linalg_trsm(params, a, b):
+    alpha = float(params["alpha"])
+    trans = params["transpose"]
+    lower = params["lower"]
+    if params["rightside"]:
+        # solve X A = alpha B  <=>  A^T X^T = alpha B^T
+        xt = jsl.solve_triangular(jnp.swapaxes(a, -1, -2),
+                                  jnp.swapaxes(b, -1, -2) * alpha,
+                                  lower=not lower if not trans else lower,
+                                  trans=0 if not trans else 0)
+        if trans:
+            xt = jsl.solve_triangular(a, jnp.swapaxes(b, -1, -2) * alpha,
+                                      lower=lower)
+        return jnp.swapaxes(xt, -1, -2)
+    return jsl.solve_triangular(a, b * alpha, lower=lower,
+                                trans=1 if trans else 0)
+
+
+@register("linalg_trmm", nin=2,
+          params={"transpose": False, "rightside": False, "lower": True,
+                  "alpha": 1.0})
+def _linalg_trmm(params, a, b):
+    alpha = float(params["alpha"])
+    tri = jnp.tril(a) if params["lower"] else jnp.triu(a)
+    if params["transpose"]:
+        tri = jnp.swapaxes(tri, -1, -2)
+    if params["rightside"]:
+        return alpha * jnp.matmul(b, tri)
+    return alpha * jnp.matmul(tri, b)
+
+
+@register("linalg_syrk", nin=1, params={"transpose": False, "alpha": 1.0})
+def _linalg_syrk(params, a):
+    at = jnp.swapaxes(a, -1, -2)
+    if params["transpose"]:
+        return float(params["alpha"]) * jnp.matmul(at, a)
+    return float(params["alpha"]) * jnp.matmul(a, at)
+
+
+@register("linalg_gelqf", nin=1, nout=2)
+def _linalg_gelqf(params, a):
+    """LQ factorization A = L Q (reference la_op gelqf) via QR of A^T."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("linalg_syevd", nin=1, nout=2)
+def _linalg_syevd(params, a):
+    w, v = jnp.linalg.eigh(a)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register("linalg_sumlogdiag", nin=1)
+def _linalg_sumlogdiag(params, a):
+    diag = jnp.diagonal(a, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(diag), axis=-1)
+
+
+@register("linalg_extractdiag", nin=1, params={"offset": 0})
+def _linalg_extractdiag(params, a):
+    return jnp.diagonal(a, offset=int(params["offset"]), axis1=-2, axis2=-1)
+
+
+@register("linalg_makediag", nin=1, params={"offset": 0})
+def _linalg_makediag(params, a):
+    k = int(params["offset"])
+    n = a.shape[-1] + abs(k)
+    out = jnp.zeros(a.shape[:-1] + (n, n), dtype=a.dtype)
+    idx = jnp.arange(a.shape[-1])
+    if k >= 0:
+        return out.at[..., idx, idx + k].set(a)
+    return out.at[..., idx - k, idx].set(a)
+
+
+@register("linalg_extracttrian", nin=1, params={"offset": 0, "lower": True})
+def _linalg_extracttrian(params, a):
+    """Reference la_op extracttrian: pack the triangle at diagonal ``offset``
+    (lower: offset <= 0 moves below the diagonal; upper: offset >= 0 above)."""
+    n = a.shape[-1]
+    k = int(params["offset"])
+    if params["lower"]:
+        ii, jj = jnp.tril_indices(n, k=k)
+    else:
+        ii, jj = jnp.triu_indices(n, k=k)
+    return a[..., ii, jj]
+
+
+@register("linalg_inverse", nin=1)
+def _linalg_inverse(params, a):
+    return jnp.linalg.inv(a)
+
+
+@register("linalg_det", nin=1)
+def _linalg_det(params, a):
+    return jnp.linalg.det(a)
+
+
+@register("linalg_slogdet", nin=1, nout=2)
+def _linalg_slogdet(params, a):
+    sign, logdet = jnp.linalg.slogdet(a)
+    return sign, logdet

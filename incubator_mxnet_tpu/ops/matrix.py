@@ -1,0 +1,482 @@
+"""Tensor shape/indexing/linear-algebra manipulation ops.
+
+Reference: `src/operator/tensor/matrix_op.cc` (reshape w/ special codes,
+transpose, slice, dot, …), `indexing_op.cc` (take/Embedding/one_hot/
+gather_nd/scatter_nd), `ordering_op.cc` (topk/sort/argsort),
+`init_op.cc` handled in init_ops.py, sequence ops from `src/operator/
+sequence_{last,mask,reverse}.cc`, `swapaxis.cc`, `pad.cc`, `crop.cc`,
+`slice_channel.cc`, `concat.cc`, `diag_op.cc`, `depth_to_space` family.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register, REQUIRED
+from ..base import MXNetError
+
+
+# ---------------------------------------------------------------------------
+# Reshape with MXNet's special codes (reference matrix_op-inl.h InferReshapeShape)
+# ---------------------------------------------------------------------------
+
+def infer_reshape(target, src_shape, reverse=False):
+    """Resolve an MXNet target shape spec (0/-1/-2/-3/-4 codes) to a concrete shape."""
+    target = list(target)
+    src = list(src_shape)
+    if reverse:
+        target = target[::-1]
+        src = src[::-1]
+    out = []
+    i = 0  # index into target
+    j = 0  # index into src
+    while i < len(target):
+        t = target[i]
+        if t == 0:
+            out.append(src[j]); j += 1
+        elif t == -1:
+            out.append(-1); j += 1
+        elif t == -2:
+            out.extend(src[j:]); j = len(src)
+        elif t == -3:
+            out.append(src[j] * src[j + 1]); j += 2
+        elif t == -4:
+            d1, d2 = target[i + 1], target[i + 2]
+            i += 2
+            if d1 == -1 and d2 == -1:
+                raise MXNetError("Split dims cannot both be -1.")
+            if d1 == -1:
+                d1 = src[j] // d2
+            if d2 == -1:
+                d2 = src[j] // d1
+            out.extend([d1, d2]); j += 1
+        else:
+            out.append(int(t)); j += 1
+        i += 1
+    if reverse:
+        out = out[::-1]
+    # infer the single -1
+    if -1 in out:
+        known = 1
+        for d in out:
+            if d != -1:
+                known *= d
+        total = int(np.prod(src_shape)) if src_shape else 1
+        out[out.index(-1)] = total // max(known, 1)
+    return tuple(out)
+
+
+@register("Reshape", aliases=("reshape",),
+          params={"shape": (), "reverse": False, "target_shape": None, "keep_highest": False})
+def _reshape(params, x):
+    shape = params["shape"]
+    if not shape and params["target_shape"]:
+        shape = params["target_shape"]  # legacy param
+    return jnp.reshape(x, infer_reshape(shape, x.shape, bool(params["reverse"])))
+
+
+@register("Flatten", aliases=("flatten",))
+def _flatten(params, x):
+    """Collapse all but the first axis (reference matrix_op.cc Flatten)."""
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+@register("transpose", params={"axes": ()})
+def _transpose(params, x):
+    axes = params["axes"] or None
+    return jnp.transpose(x, axes)
+
+
+@register("expand_dims", params={"axis": REQUIRED})
+def _expand_dims(params, x):
+    return jnp.expand_dims(x, int(params["axis"]))
+
+
+@register("squeeze", params={"axis": None})
+def _squeeze(params, x):
+    axis = params["axis"]
+    if isinstance(axis, int):
+        axis = (axis,)
+    return jnp.squeeze(x, axis)
+
+
+@register("SwapAxis", aliases=("swapaxes",), params={"dim1": 0, "dim2": 0})
+def _swapaxes(params, x):
+    return jnp.swapaxes(x, int(params["dim1"]), int(params["dim2"]))
+
+
+def _norm_begin_end(shape, begin, end, step=None):
+    ndim = len(shape)
+    begin = list(begin) + [None] * (ndim - len(begin))
+    end = list(end) + [None] * (ndim - len(end))
+    step = list(step or []) + [None] * (ndim - len(step or []))
+    slices = []
+    for b, e, s in zip(begin, end, step):
+        slices.append(slice(b, e, s))
+    return tuple(slices)
+
+
+@register("slice", params={"begin": REQUIRED, "end": REQUIRED, "step": None},
+          aliases=("crop",))
+def _slice(params, x):
+    """Reference matrix_op.cc slice (begin/end/step, None-able entries)."""
+    return x[_norm_begin_end(x.shape, params["begin"], params["end"], params["step"])]
+
+
+@register("slice_axis", params={"axis": REQUIRED, "begin": REQUIRED, "end": None})
+def _slice_axis(params, x):
+    axis = int(params["axis"]) % x.ndim
+    sl = [slice(None)] * x.ndim
+    sl[axis] = slice(params["begin"], params["end"])
+    return x[tuple(sl)]
+
+
+@register("slice_like", nin=2, params={"axes": ()})
+def _slice_like(params, x, like):
+    axes = params["axes"] or tuple(range(x.ndim))
+    sl = [slice(None)] * x.ndim
+    for a in axes:
+        a = a % x.ndim
+        sl[a] = slice(0, like.shape[a])
+    return x[tuple(sl)]
+
+
+@register("reverse", aliases=("flip",), params={"axis": REQUIRED})
+def _reverse(params, x):
+    axis = params["axis"]
+    if isinstance(axis, int):
+        axis = (axis,)
+    return jnp.flip(x, axis)
+
+
+@register("tile", params={"reps": REQUIRED})
+def _tile(params, x):
+    return jnp.tile(x, params["reps"])
+
+
+@register("repeat", params={"repeats": REQUIRED, "axis": None})
+def _repeat(params, x):
+    axis = params["axis"]
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.repeat(x, int(params["repeats"]), axis=int(axis))
+
+
+@register("Pad", aliases=("pad",),
+          params={"mode": "constant", "pad_width": REQUIRED, "constant_value": 0.0})
+def _pad(params, x):
+    pw = params["pad_width"]
+    pairs = [(int(pw[2 * i]), int(pw[2 * i + 1])) for i in range(len(pw) // 2)]
+    mode = params["mode"]
+    if mode == "constant":
+        return jnp.pad(x, pairs, mode="constant",
+                       constant_values=params["constant_value"])
+    if mode == "edge":
+        return jnp.pad(x, pairs, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(x, pairs, mode="reflect")
+    raise MXNetError(f"Pad: unknown mode {mode}")
+
+
+# ---------------------------------------------------------------------------
+# Concat / stack / split
+# ---------------------------------------------------------------------------
+
+@register("Concat", aliases=("concat",), nin=-1, variadic_param="num_args",
+          params={"num_args": 0, "dim": 1})
+def _concat(params, *xs):
+    return jnp.concatenate(xs, axis=int(params["dim"]))
+
+
+@register("stack", nin=-1, variadic_param="num_args",
+          params={"num_args": 0, "axis": 0})
+def _stack(params, *xs):
+    return jnp.stack(xs, axis=int(params["axis"]))
+
+
+@register("add_n", aliases=("ElementWiseSum", "_sum"), nin=-1,
+          variadic_param="num_args", params={"num_args": 0})
+def _add_n(params, *xs):
+    """Reference `ElementwiseSum` (`src/ndarray/ndarray.cc:1243`)."""
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+def _split_nout(params):
+    return int(params["num_outputs"])
+
+
+@register("SliceChannel", aliases=("split",), nout=_split_nout,
+          params={"num_outputs": REQUIRED, "axis": 1, "squeeze_axis": False})
+def _split(params, x):
+    """Reference `slice_channel.cc` — split along axis into num_outputs parts."""
+    n = int(params["num_outputs"])
+    axis = int(params["axis"]) % x.ndim
+    parts = jnp.split(x, n, axis=axis)
+    if params["squeeze_axis"]:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+# ---------------------------------------------------------------------------
+# dot / batch_dot
+# ---------------------------------------------------------------------------
+
+@register("dot", nin=2, params={"transpose_a": False, "transpose_b": False,
+                                "forward_stype": None})
+def _dot(params, a, b):
+    """Reference `src/operator/tensor/dot.cc`: contract last axis of a with
+    first axis of b (after optional transposes).  Lowers to a single MXU matmul."""
+    if params["transpose_a"]:
+        a = jnp.transpose(a)
+    if params["transpose_b"]:
+        b = jnp.transpose(b)
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    return jnp.tensordot(a, b, axes=1)
+
+
+@register("batch_dot", nin=2, params={"transpose_a": False, "transpose_b": False,
+                                      "forward_stype": None})
+def _batch_dot(params, a, b):
+    ta, tb = params["transpose_a"], params["transpose_b"]
+    if ta:
+        a = jnp.swapaxes(a, -1, -2)
+    if tb:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+# ---------------------------------------------------------------------------
+# Indexing
+# ---------------------------------------------------------------------------
+
+@register("take", nin=2, params={"axis": 0, "mode": "clip"})
+def _take(params, a, indices):
+    mode = params["mode"]
+    idx = indices.astype("int32")
+    axis = int(params["axis"]) % a.ndim
+    n = a.shape[axis]
+    if mode == "wrap":
+        idx = jnp.mod(idx, n)
+    else:
+        idx = jnp.clip(idx, 0, n - 1)
+    return jnp.take(a, idx, axis=axis)
+
+
+@register("batch_take", nin=2)
+def _batch_take(params, a, indices):
+    idx = jnp.clip(indices.astype("int32"), 0, a.shape[1] - 1)
+    return jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
+
+
+@register("Embedding", nin=2,
+          params={"input_dim": REQUIRED, "output_dim": REQUIRED,
+                  "dtype": "float32", "sparse_grad": False})
+def _embedding(params, data, weight):
+    """Reference `indexing_op.cc` Embedding: weight[data] gather."""
+    idx = jnp.clip(data.astype("int32"), 0, int(params["input_dim"]) - 1)
+    return jnp.take(weight, idx, axis=0)
+
+
+@register("one_hot", params={"depth": REQUIRED, "on_value": 1.0,
+                             "off_value": 0.0, "dtype": "float32"})
+def _one_hot(params, indices):
+    depth = int(params["depth"])
+    on, off = params["on_value"], params["off_value"]
+    oh = jax.nn.one_hot(indices.astype("int32"), depth, dtype=params["dtype"])
+    return oh * (on - off) + off
+
+
+@register("gather_nd", nin=2)
+def _gather_nd(params, data, indices):
+    """Reference indexing_op.cc gather_nd: indices (M, Y...) selects
+    data[idx_0,...,idx_{M-1}] -> output (Y..., data.shape[M:])."""
+    m = indices.shape[0]
+    idx = tuple(indices[i].astype("int32") for i in range(m))
+    return data[idx]
+
+
+@register("scatter_nd", nin=2, params={"shape": REQUIRED})
+def _scatter_nd(params, data, indices):
+    shape = tuple(params["shape"])
+    m = indices.shape[0]
+    out = jnp.zeros(shape, dtype=data.dtype)
+    idx = tuple(indices[i].astype("int32") for i in range(m))
+    return out.at[idx].set(data)
+
+
+@register("_index", params={"key": REQUIRED})
+def _index(params, x):
+    """Basic indexing as a differentiable op (the reference routes basic
+    `__getitem__` through the slice op so gradients flow; `matrix_op.cc`)."""
+    return x[params["key"]]
+
+
+@register("_index_nd", nin=2)
+def _index_nd(params, x, idx):
+    """Advanced (integer-array) indexing along axis 0, differentiable."""
+    return x[idx.astype("int32")]
+
+
+@register("where", nin=3)
+def _where(params, cond, x, y):
+    return jnp.where(cond != 0, x, y)
+
+
+# ---------------------------------------------------------------------------
+# Ordering (reference ordering_op.cc)
+# ---------------------------------------------------------------------------
+
+def _topk_nout(params):
+    return 2 if params.get("ret_typ") == "both" else 1
+
+
+@register("topk", nout=_topk_nout,
+          params={"axis": -1, "k": 1, "ret_typ": "indices", "is_ascend": False,
+                  "dtype": "float32"})
+def _topk(params, x):
+    axis = int(params["axis"]) % x.ndim
+    k = int(params["k"])
+    ret = params["ret_typ"]
+    neg = not params["is_ascend"]
+    xm = jnp.moveaxis(x, axis, -1)
+    vals, idxs = jax.lax.top_k(xm if neg else -xm, k)
+    if not neg:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idxs = jnp.moveaxis(idxs, -1, axis).astype(params["dtype"])
+    if ret == "value":
+        return vals
+    if ret == "indices":
+        return idxs
+    if ret == "both":
+        return vals, idxs
+    if ret == "mask":
+        oh = jax.nn.one_hot(jnp.moveaxis(idxs, axis, -1).astype("int32"),
+                            x.shape[axis], dtype=x.dtype).sum(axis=-2)
+        return jnp.moveaxis(oh, -1, axis)
+    raise MXNetError(f"topk: bad ret_typ {ret}")
+
+
+@register("sort", params={"axis": -1, "is_ascend": True})
+def _sort(params, x):
+    out = jnp.sort(x, axis=int(params["axis"]))
+    if not params["is_ascend"]:
+        out = jnp.flip(out, axis=int(params["axis"]))
+    return out
+
+
+@register("argsort", params={"axis": -1, "is_ascend": True, "dtype": "float32"})
+def _argsort(params, x):
+    axis = int(params["axis"])
+    idx = jnp.argsort(x, axis=axis)
+    if not params["is_ascend"]:
+        idx = jnp.flip(idx, axis=axis)
+    return idx.astype(params["dtype"])
+
+
+# ---------------------------------------------------------------------------
+# Misc structure ops
+# ---------------------------------------------------------------------------
+
+@register("Cast", aliases=("cast",), params={"dtype": REQUIRED})
+def _cast(params, x):
+    return x.astype(params["dtype"])
+
+
+@register("shape_array")
+def _shape_array(params, x):
+    return jnp.asarray(x.shape, dtype="int64")
+
+
+@register("size_array")
+def _size_array(params, x):
+    return jnp.asarray([x.size], dtype="int64")
+
+
+@register("diag", params={"k": 0, "axis1": 0, "axis2": 1})
+def _diag(params, x):
+    if x.ndim == 1:
+        return jnp.diag(x, k=int(params["k"]))
+    return jnp.diagonal(x, offset=int(params["k"]),
+                        axis1=int(params["axis1"]), axis2=int(params["axis2"]))
+
+
+@register("depth_to_space", params={"block_size": REQUIRED})
+def _depth_to_space(params, x):
+    b = int(params["block_size"])
+    n, c, h, w = x.shape
+    x = x.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register("space_to_depth", params={"block_size": REQUIRED})
+def _space_to_depth(params, x):
+    b = int(params["block_size"])
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 5, 3, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+# ---------------------------------------------------------------------------
+# Sequence ops (reference sequence_last/mask/reverse.cc): data is
+# (seq_len, batch, ...) with optional per-batch sequence_length input.
+# ---------------------------------------------------------------------------
+
+@register("SequenceLast", nin=-1, params={"use_sequence_length": False, "axis": 0})
+def _sequence_last(params, data, *rest):
+    axis = int(params["axis"])
+    if params["use_sequence_length"] and rest:
+        seqlen = rest[0].astype("int32")
+        idx = jnp.maximum(seqlen - 1, 0)
+        dm = jnp.moveaxis(data, axis, 0)
+        return jax.vmap(lambda i, col: col[i], in_axes=(0, 1), out_axes=0)(idx, dm)
+    sl = [slice(None)] * data.ndim
+    sl[axis] = -1
+    return data[tuple(sl)]
+
+
+@register("SequenceMask", nin=-1,
+          params={"use_sequence_length": False, "value": 0.0, "axis": 0})
+def _sequence_mask(params, data, *rest):
+    if not params["use_sequence_length"] or not rest:
+        return data + 0
+    axis = int(params["axis"])
+    seqlen = rest[0].astype("int32")
+    T = data.shape[axis]
+    steps = jnp.arange(T)
+    mask = steps[:, None] < seqlen[None, :]  # (T, B)
+    if axis == 1:
+        mask = mask.T
+        shape = [1] * data.ndim
+        shape[0], shape[1] = data.shape[0], data.shape[1]
+    else:
+        shape = [1] * data.ndim
+        shape[0], shape[1] = data.shape[0], data.shape[1]
+    mask = mask.reshape(shape)
+    return jnp.where(mask, data, jnp.asarray(params["value"], data.dtype))
+
+
+@register("SequenceReverse", nin=-1, params={"use_sequence_length": False, "axis": 0})
+def _sequence_reverse(params, data, *rest):
+    axis = int(params["axis"])
+    if not params["use_sequence_length"] or not rest:
+        return jnp.flip(data, axis=axis)
+    seqlen = rest[0].astype("int32")
+    T = data.shape[axis]
+    steps = jnp.arange(T)
+
+    def rev_col(col, n):
+        idx = jnp.where(steps < n, n - 1 - steps, steps)
+        return col[idx]
+
+    dm = jnp.moveaxis(data, axis, 0)
+    out = jax.vmap(rev_col, in_axes=(1, 0), out_axes=1)(dm, seqlen)
+    return jnp.moveaxis(out, 0, axis)

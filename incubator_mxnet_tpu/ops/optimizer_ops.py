@@ -1,0 +1,136 @@
+"""Fused optimizer update ops.
+
+Reference: `src/operator/optimizer_op.cc` (+`optimizer_op-inl.h`): sgd_update,
+sgd_mom_update, mp_sgd_* (fp16 weights with fp32 master copies — on TPU the
+analogue is bf16 weights + fp32 masters), adam, rmsprop, rmspropalex, ftrl,
+signsgd, signum.
+
+Semantics: ops return the new weight (written to ``out=weight`` by callers,
+matching the reference's in-place kWriteInplace) and update their state
+tensors (momentum/mean/var/…) as aux outputs written back in place.
+``lr``/``wd``/``rescale_grad``/``clip_gradient`` are *dynamic* scalar inputs so
+learning-rate schedules do not retrigger XLA compilation (OpDef.dynamic_params).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+_DYN = ("lr", "wd", "rescale_grad", "clip_gradient")
+_COMMON = {"lr": 0.01, "wd": 0.0, "rescale_grad": 1.0, "clip_gradient": -1.0,
+           "lazy_update": True}
+
+
+def _prep_grad(grad, rescale, clip):
+    g = grad * rescale
+    return jnp.where(clip > 0, jnp.clip(g, -jnp.abs(clip), jnp.abs(clip)), g)
+
+
+@register("sgd_update", nin=2, params=dict(_COMMON), dynamic_params=_DYN)
+def _sgd_update(params, weight, grad, lr, wd, rescale, clip):
+    g = _prep_grad(grad, rescale, clip).astype(weight.dtype)
+    lr = lr.astype(weight.dtype)
+    wd = wd.astype(weight.dtype)
+    return weight - lr * (g + wd * weight)
+
+
+@register("sgd_mom_update", nin=3, naux=1, params={**_COMMON, "momentum": 0.0},
+          dynamic_params=_DYN)
+def _sgd_mom_update(params, weight, grad, mom, lr, wd, rescale, clip):
+    mu = float(params["momentum"])
+    g = _prep_grad(grad, rescale, clip).astype(weight.dtype)
+    lr = lr.astype(weight.dtype)
+    wd = wd.astype(weight.dtype)
+    new_mom = mu * mom - lr * (g + wd * weight)
+    return weight + new_mom, new_mom
+
+
+@register("mp_sgd_update", nin=3, naux=1, params=dict(_COMMON), dynamic_params=_DYN)
+def _mp_sgd_update(params, weight, grad, weight32, lr, wd, rescale, clip):
+    """Multi-precision SGD: grads applied to the fp32 master copy, low-precision
+    weight refreshed from it (reference optimizer_op-inl.h MP_SGDKernel)."""
+    g = _prep_grad(grad.astype("float32"), rescale, clip)
+    new_w32 = weight32 - lr * (g + wd * weight32)
+    return new_w32.astype(weight.dtype), new_w32
+
+
+@register("mp_sgd_mom_update", nin=4, naux=2,
+          params={**_COMMON, "momentum": 0.0}, dynamic_params=_DYN)
+def _mp_sgd_mom_update(params, weight, grad, mom, weight32, lr, wd, rescale, clip):
+    mu = float(params["momentum"])
+    g = _prep_grad(grad.astype("float32"), rescale, clip)
+    new_mom = mu * mom - lr * (g + wd * weight32)
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@register("adam_update", nin=4, naux=2,
+          params={**_COMMON, "beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+          dynamic_params=_DYN)
+def _adam_update(params, weight, grad, mean, var, lr, wd, rescale, clip):
+    b1, b2 = float(params["beta1"]), float(params["beta2"])
+    eps = float(params["epsilon"])
+    g = _prep_grad(grad, rescale, clip).astype(weight.dtype) + \
+        wd.astype(weight.dtype) * weight
+    new_mean = b1 * mean + (1 - b1) * g
+    new_var = b2 * var + (1 - b2) * jnp.square(g)
+    new_w = weight - lr.astype(weight.dtype) * new_mean / (jnp.sqrt(new_var) + eps)
+    return new_w, new_mean, new_var
+
+
+@register("rmsprop_update", nin=3, naux=1,
+          params={**_COMMON, "gamma1": 0.95, "epsilon": 1e-8}, dynamic_params=_DYN)
+def _rmsprop_update(params, weight, grad, n, lr, wd, rescale, clip):
+    g1 = float(params["gamma1"])
+    eps = float(params["epsilon"])
+    g = _prep_grad(grad, rescale, clip) + wd * weight
+    new_n = (1 - g1) * jnp.square(g) + g1 * n
+    new_w = weight - lr * g / jnp.sqrt(new_n + eps)
+    return new_w, new_n
+
+
+@register("rmspropalex_update", nin=5, naux=3,
+          params={**_COMMON, "gamma1": 0.95, "gamma2": 0.9, "epsilon": 1e-8},
+          dynamic_params=_DYN)
+def _rmspropalex_update(params, weight, grad, n, g_avg, delta, lr, wd, rescale, clip):
+    g1, g2 = float(params["gamma1"]), float(params["gamma2"])
+    eps = float(params["epsilon"])
+    g = _prep_grad(grad, rescale, clip) + wd * weight
+    new_n = (1 - g1) * jnp.square(g) + g1 * n
+    new_g = (1 - g1) * g + g1 * g_avg
+    new_delta = g2 * delta - lr * g / jnp.sqrt(new_n - jnp.square(new_g) + eps)
+    return weight + new_delta, new_n, new_g, new_delta
+
+
+@register("ftrl_update", nin=4, naux=2,
+          params={**_COMMON, "lamda1": 0.01, "beta": 1.0}, dynamic_params=_DYN)
+def _ftrl_update(params, weight, grad, z, n, lr, wd, rescale, clip):
+    l1 = float(params["lamda1"])
+    beta = float(params["beta"])
+    g = _prep_grad(grad, rescale, clip)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    new_w = jnp.where(
+        jnp.abs(new_z) > l1,
+        -(new_z - jnp.sign(new_z) * l1) / ((beta + jnp.sqrt(new_n)) / lr + wd),
+        jnp.zeros_like(weight))
+    return new_w, new_z, new_n
+
+
+@register("signsgd_update", nin=2, params=dict(_COMMON), dynamic_params=_DYN)
+def _signsgd_update(params, weight, grad, lr, wd, rescale, clip):
+    g = _prep_grad(grad, rescale, clip)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+@register("signum_update", nin=3, naux=1,
+          params={**_COMMON, "momentum": 0.0, "wd_lh": 0.0}, dynamic_params=_DYN)
+def _signum_update(params, weight, grad, mom, lr, wd, rescale, clip):
+    mu = float(params["momentum"])
+    wd_lh = float(params["wd_lh"])
+    g = _prep_grad(grad, rescale, clip)
+    new_mom = mu * mom - (1 - mu) * (g + wd * weight)
+    new_w = (1 - lr * wd_lh) * weight + lr * jnp.sign(new_mom)
+    return new_w, new_mom

@@ -1,0 +1,156 @@
+"""Random sampling ops (reference `src/operator/random/sample_op.cc`,
+`sample_multinomial_op.cc`, `shuffle_op.cc`).
+
+The reference keeps per-device stateful mt19937/cuRAND generators behind
+ResourceManager (`src/resource.cc:87-160`).  TPU-native RNG is counter-based
+(threefry): every op invocation consumes a fresh subkey from the framework's
+global key chain (`incubator_mxnet_tpu.random`), passed to the kernel as a
+trailing input array — statistical, not bitwise, parity with the reference
+(documented divergence, SURVEY.md §7 hard part (c)).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _shape(params):
+    s = params.get("shape", ())
+    if s is None:
+        s = ()
+    if isinstance(s, int):
+        s = (s,)
+    return tuple(s)
+
+
+def _dt(params):
+    d = params.get("dtype") or "float32"
+    return "float32" if d in (None, "None") else d
+
+
+@register("_random_uniform", nin=0, needs_rng=True, aliases=("uniform",),
+          params={"low": 0.0, "high": 1.0, "shape": (), "dtype": "float32", "ctx": None})
+def _random_uniform(params, key):
+    return jax.random.uniform(key, _shape(params), dtype=_dt(params),
+                              minval=params["low"], maxval=params["high"])
+
+
+@register("_random_normal", nin=0, needs_rng=True, aliases=("normal",),
+          params={"loc": 0.0, "scale": 1.0, "shape": (), "dtype": "float32", "ctx": None})
+def _random_normal(params, key):
+    return params["loc"] + params["scale"] * jax.random.normal(
+        key, _shape(params), dtype=_dt(params))
+
+
+@register("_random_gamma", nin=0, needs_rng=True, aliases=("gamma_sample",),
+          params={"alpha": 1.0, "beta": 1.0, "shape": (), "dtype": "float32", "ctx": None})
+def _random_gamma(params, key):
+    return params["beta"] * jax.random.gamma(key, params["alpha"], _shape(params),
+                                             dtype=_dt(params))
+
+
+@register("_random_exponential", nin=0, needs_rng=True,
+          params={"lam": 1.0, "shape": (), "dtype": "float32", "ctx": None})
+def _random_exponential(params, key):
+    return jax.random.exponential(key, _shape(params), dtype=_dt(params)) / params["lam"]
+
+
+@register("_random_poisson", nin=0, needs_rng=True,
+          params={"lam": 1.0, "shape": (), "dtype": "float32", "ctx": None})
+def _random_poisson(params, key):
+    return jax.random.poisson(key, params["lam"], _shape(params)).astype(_dt(params))
+
+
+@register("_random_negative_binomial", nin=0, needs_rng=True,
+          params={"k": 1, "p": 1.0, "shape": (), "dtype": "float32", "ctx": None})
+def _random_negative_binomial(params, key):
+    k1, k2 = jax.random.split(key)
+    p = params["p"]
+    lam = jax.random.gamma(k1, float(params["k"]), _shape(params)) * ((1 - p) / p)
+    return jax.random.poisson(k2, lam, _shape(params)).astype(_dt(params))
+
+
+@register("_random_generalized_negative_binomial", nin=0, needs_rng=True,
+          params={"mu": 1.0, "alpha": 1.0, "shape": (), "dtype": "float32", "ctx": None})
+def _random_generalized_negative_binomial(params, key):
+    k1, k2 = jax.random.split(key)
+    mu, alpha = params["mu"], params["alpha"]
+    lam = jax.random.gamma(k1, 1.0 / alpha, _shape(params)) * (alpha * mu)
+    return jax.random.poisson(k2, lam, _shape(params)).astype(_dt(params))
+
+
+@register("_random_randint", nin=0, needs_rng=True,
+          params={"low": 0, "high": 1, "shape": (), "dtype": "int32", "ctx": None})
+def _random_randint(params, key):
+    return jax.random.randint(key, _shape(params), int(params["low"]),
+                              int(params["high"]),
+                              dtype=params.get("dtype") or "int32")
+
+
+# -- parameter-tensor variants (_sample_*): one sample row per distribution row
+@register("_sample_uniform", nin=2, needs_rng=True, aliases=(),
+          params={"shape": (), "dtype": "float32"})
+def _sample_uniform(params, low, high, key):
+    s = _shape(params)
+    u = jax.random.uniform(key, low.shape + s, dtype=_dt(params))
+    return low.reshape(low.shape + (1,) * len(s)) + u * (high - low).reshape(
+        low.shape + (1,) * len(s))
+
+
+@register("_sample_normal", nin=2, needs_rng=True,
+          params={"shape": (), "dtype": "float32"})
+def _sample_normal(params, mu, sigma, key):
+    s = _shape(params)
+    z = jax.random.normal(key, mu.shape + s, dtype=_dt(params))
+    return mu.reshape(mu.shape + (1,) * len(s)) + z * sigma.reshape(
+        sigma.shape + (1,) * len(s))
+
+
+@register("_sample_gamma", nin=2, needs_rng=True,
+          params={"shape": (), "dtype": "float32"})
+def _sample_gamma(params, alpha, beta, key):
+    s = _shape(params)
+    a = alpha.reshape(alpha.shape + (1,) * len(s))
+    g = jax.random.gamma(key, jnp.broadcast_to(a, alpha.shape + s), dtype=_dt(params))
+    return g * beta.reshape(beta.shape + (1,) * len(s))
+
+
+def _multinomial_nout(params):
+    return 2 if params.get("get_prob") else 1
+
+
+@register("_sample_multinomial", nout=_multinomial_nout, needs_rng=True,
+          params={"shape": (), "get_prob": False, "dtype": "int32"})
+def _sample_multinomial(params, data, key):
+    """Reference sample_multinomial_op.cc: data (..., K) of probabilities;
+    draws prod(shape) categorical samples per distribution row."""
+    s = _shape(params)
+    n = 1
+    for d in s:
+        n *= d
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    flat = logits.reshape(-1, logits.shape[-1])
+    keys = jax.random.split(key, flat.shape[0])
+    samp = jax.vmap(lambda k, lg: jax.random.categorical(k, lg, shape=(n,)))(
+        keys, flat)                                    # (rows, n)
+    out_shape = data.shape[:-1] + s                    # () shape -> one draw/row
+    samples = samp.reshape(out_shape).astype(params.get("dtype") or "int32")
+    if params.get("get_prob"):
+        oh = jax.nn.one_hot(samples.astype("int32"), data.shape[-1])
+        if s:
+            # oh: (..., *s, K) vs logits (..., K): broadcast over sample dims
+            lg = logits.reshape(data.shape[:-1] + (1,) * len(s) + (data.shape[-1],))
+            lp = jnp.sum(oh * lg, axis=-1)
+        else:
+            lp = jnp.sum(oh * logits, axis=-1)
+        return samples, lp
+    return samples
+
+
+@register("_shuffle", needs_rng=True, aliases=("shuffle",))
+def _shuffle(params, x, key):
+    """Shuffle along the first axis (reference shuffle_op.cc)."""
+    perm = jax.random.permutation(key, x.shape[0])
+    return jnp.take(x, perm, axis=0)

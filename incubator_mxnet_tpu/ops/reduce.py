@@ -1,0 +1,121 @@
+"""Reduction and broadcasting-structure ops.
+
+Reference: `src/operator/tensor/broadcast_reduce_op_{value,index}.cc`
+(sum/mean/prod/nansum/nanprod/max/min/norm/argmax/argmin/broadcast_to/
+broadcast_axis).  MXNet reduce semantics: ``axis`` may be None / int / tuple,
+``exclude=True`` reduces over the complement, ``keepdims`` keeps reduced dims.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+_REDUCE_PARAMS = {"axis": None, "keepdims": False, "exclude": False}
+
+
+def _norm_axis(params, ndim):
+    axis = params.get("axis", None)
+    if axis is None or axis == () or axis == []:
+        axes = tuple(range(ndim))
+        if params.get("exclude", False):
+            axes = ()
+        return axes
+    if isinstance(axis, int):
+        axis = (axis,)
+    axes = tuple(a % ndim for a in axis)
+    if params.get("exclude", False):
+        axes = tuple(a for a in range(ndim) if a not in axes)
+    return axes
+
+
+def _make_reduce(f):
+    def fn(params, x):
+        axes = _norm_axis(params, x.ndim)
+        if axes == ():
+            return x + 0 if f is not jnp.nansum and f is not jnp.nanprod else jnp.nan_to_num(x)
+        return f(x, axis=axes, keepdims=bool(params.get("keepdims", False)))
+    return fn
+
+
+for _name, _f, _aliases in [
+    ("sum", jnp.sum, ("sum_axis",)),
+    ("mean", jnp.mean, ()),
+    ("prod", jnp.prod, ()),
+    ("nansum", jnp.nansum, ()),
+    ("nanprod", jnp.nanprod, ()),
+    ("max", jnp.max, ("max_axis",)),
+    ("min", jnp.min, ("min_axis",)),
+]:
+    register(_name, nin=1, params=dict(_REDUCE_PARAMS), aliases=_aliases)(_make_reduce(_f))
+
+
+@register("norm", params={"ord": 2, "axis": None, "keepdims": False, "out_dtype": None})
+def _norm(params, x):
+    """Reference `broadcast_reduce_op_value.cc` norm (L1/L2)."""
+    ordv = int(params["ord"])
+    axis = params["axis"]
+    if isinstance(axis, int):
+        axis = (axis,)
+    keepdims = bool(params["keepdims"])
+    if ordv == 1:
+        out = jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims)
+    elif ordv == 2:
+        out = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims))
+    else:
+        raise ValueError("norm only supports ord=1 or 2 (as the reference)")
+    if params["out_dtype"]:
+        out = out.astype(params["out_dtype"])
+    return out
+
+
+def _make_arg(f):
+    def fn(params, x):
+        axis = params.get("axis", None)
+        keepdims = bool(params.get("keepdims", False))
+        if axis is None:
+            out = f(x.reshape(-1), axis=0)
+            out = out.astype("float32")
+            return out.reshape((1,) * x.ndim) if keepdims else out
+        out = f(x, axis=int(axis)).astype("float32")
+        if keepdims:
+            out = jnp.expand_dims(out, int(axis))
+        return out
+    return fn
+
+
+# MXNet argmax/argmin return float dtype (reference broadcast_reduce_op_index.cc)
+register("argmax", nin=1, params={"axis": None, "keepdims": False})(_make_arg(jnp.argmax))
+register("argmin", nin=1, params={"axis": None, "keepdims": False})(_make_arg(jnp.argmin))
+
+
+@register("argmax_channel")
+def _argmax_channel(params, x):
+    return jnp.argmax(x, axis=1).astype("float32")
+
+
+@register("broadcast_to", params={"shape": ()})
+def _broadcast_to(params, x):
+    tgt = tuple(params["shape"])
+    # 0 entries mean "keep input size" in the reference
+    tgt = tuple(x.shape[i] if t == 0 else t for i, t in enumerate(tgt))
+    return jnp.broadcast_to(x, tgt)
+
+
+@register("broadcast_axis", params={"axis": (), "size": ()}, aliases=("broadcast_axes",))
+def _broadcast_axis(params, x):
+    axes = params["axis"]
+    sizes = params["size"]
+    if isinstance(axes, int):
+        axes = (axes,)
+    if isinstance(sizes, int):
+        sizes = (sizes,)
+    shape = list(x.shape)
+    for a, s in zip(axes, sizes):
+        shape[a % x.ndim] = s
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+@register("broadcast_like", nin=2)
+def _broadcast_like(params, x, like):
+    return jnp.broadcast_to(x, like.shape)

@@ -1,0 +1,246 @@
+"""Central operator registry.
+
+TPU-native equivalent of the reference's NNVM op registry
+(`NNVM_REGISTER_OP` + string-keyed attribute maps, `include/mxnet/op_attr_types.h:66-271`,
+example registration `src/operator/nn/fully_connected.cc:239-328`).
+
+Design: one registry entry per operator.  Instead of separate
+`FCompute<cpu>` / `FCompute<gpu>` kernels plus hand-written `FInferShape` /
+`FInferType` / `FGradient` tables, each op provides a single **pure,
+jax-traceable compute function** ``fn(params, *arrays) -> array | tuple``:
+
+* eager dispatch jit-compiles it per (op, static-params) — XLA generates the
+  TPU kernel (the `FCompute<tpu>` equivalent);
+* shape/type inference is `jax.eval_shape` of the same function (replaces the
+  InferAttr fixpoint, `src/executor/infer_graph_attr_pass.cc:73`);
+* gradients come from `jax.vjp` of the same function (replaces `FGradient`);
+  ops with non-autodiff gradients (e.g. SoftmaxOutput's implicit CE loss grad,
+  `src/operator/softmax_output.cc`) wrap themselves in `jax.custom_vjp`;
+* the symbolic executor composes these functions into one XLA computation
+  (replaces `GraphExecutor` + bulk segments, `src/executor/graph_executor.cc`).
+
+The Python frontends are *generated* from this registry
+(`ndarray/register.py`, `symbol/register.py`) exactly like the reference
+generates them from `MXSymbolListAtomicSymbolCreators`
+(`python/mxnet/ndarray/register.py:30-169`).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+from ..base import MXNetError, py_literal
+
+__all__ = ["OpDef", "register", "get", "list_ops", "REQUIRED", "eager_call",
+           "vjp_call", "eval_shape"]
+
+
+class _Required:
+    def __repr__(self):
+        return "REQUIRED"
+
+
+REQUIRED = _Required()
+
+_REGISTRY: dict[str, "OpDef"] = {}
+
+
+class OpDef:
+    """A registered operator.
+
+    Attributes
+    ----------
+    name : canonical op name (reference ops keep their MXNet names so that
+        generated frontends and saved Symbol JSON stay compatible).
+    fn : ``fn(params: dict, *arrays) -> jnp.ndarray | tuple`` pure function.
+    nin : number of tensor inputs; -1 = variadic (count from ``variadic_param``).
+    nout : number of outputs, or callable ``(params) -> int``.
+    naux : trailing inputs that are auxiliary states (e.g. BatchNorm running
+        stats); in train mode ``fn`` returns ``nout`` outputs followed by
+        ``naux`` updated aux values which the caller writes back in place.
+    params : dict name -> default (REQUIRED for mandatory params).
+    param_types : optional dict name -> converter applied after coercion.
+    needs_rng : op consumes a PRNG key; dispatch appends a key array input.
+    mode_dependent : op behaves differently in train vs predict mode; dispatch
+        injects boolean param ``_train``.
+    stop_grad : do not record on the autograd tape (BlockGrad & friends).
+    aliases : alternative registered names (reference keeps e.g. both
+        ``Flatten`` and ``flatten``).
+    """
+
+    __slots__ = ("name", "fn", "nin", "nout", "naux", "params", "param_types",
+                 "needs_rng", "mode_dependent", "stop_grad", "aliases",
+                 "variadic_param", "dynamic_params", "doc")
+
+    def __init__(self, name, fn, nin=1, nout=1, naux=0, params=None,
+                 param_types=None, needs_rng=False, mode_dependent=False,
+                 stop_grad=False, aliases=(), variadic_param=None,
+                 dynamic_params=(), doc=None):
+        self.name = name
+        self.fn = fn
+        self.nin = nin
+        self.nout = nout
+        self.naux = naux
+        self.params = dict(params or {})
+        self.param_types = dict(param_types or {})
+        self.needs_rng = needs_rng
+        self.mode_dependent = mode_dependent
+        self.stop_grad = stop_grad
+        self.aliases = tuple(aliases)
+        self.variadic_param = variadic_param
+        # dynamic_params: params passed as traced scalar inputs (appended after
+        # tensor inputs, before the rng key) so e.g. a changing learning rate
+        # does not retrigger XLA compilation.
+        self.dynamic_params = tuple(dynamic_params)
+        self.doc = doc or (fn.__doc__ if fn else None)
+
+    # -- parameter handling ---------------------------------------------------
+    def canonicalize_params(self, kwargs):
+        """Coerce/validate kwargs against the param table; returns plain dict."""
+        out = {}
+        for k, default in self.params.items():
+            if k in kwargs and kwargs[k] is not None:
+                v = py_literal(kwargs[k])
+                conv = self.param_types.get(k)
+                if conv is not None:
+                    v = conv(v)
+                out[k] = _hashable(v)
+            elif default is REQUIRED:
+                raise MXNetError(
+                    f"Operator {self.name}: required parameter '{k}' missing")
+            else:
+                out[k] = _hashable(default)
+        unknown = set(kwargs) - set(self.params) - {"name", "out", "ctx", "attr", "__layout__", "lr_mult", "wd_mult"}
+        if unknown:
+            raise MXNetError(f"Operator {self.name}: unknown parameters {sorted(unknown)}")
+        return out
+
+    def num_outputs(self, params):
+        return self.nout(params) if callable(self.nout) else self.nout
+
+    def num_aux(self, params):
+        return self.naux(params) if callable(self.naux) else self.naux
+
+    def num_inputs(self, params):
+        if self.nin >= 0:
+            return self.nin
+        if self.variadic_param and self.variadic_param in params:
+            return int(params[self.variadic_param])
+        return -1
+
+    def __repr__(self):
+        return f"OpDef({self.name})"
+
+
+def _hashable(v):
+    if isinstance(v, list):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    return v
+
+
+def register(name, **kwargs):
+    """Decorator registering a compute function as operator ``name``.
+
+    Mirrors `NNVM_REGISTER_OP(name).set_attr<FCompute>(...)` — but there is a
+    single backend (XLA) so one function covers cpu+tpu.
+    """
+    def deco(fn):
+        op = OpDef(name, fn, **kwargs)
+        if name in _REGISTRY:
+            raise MXNetError(f"Operator {name} registered twice")
+        _REGISTRY[name] = op
+        for alias in op.aliases:
+            if alias in _REGISTRY:
+                raise MXNetError(f"Operator alias {alias} registered twice")
+            _REGISTRY[alias] = op
+        return fn
+    return deco
+
+
+def get(name) -> OpDef:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise MXNetError(f"Operator {name} is not registered") from None
+
+
+def maybe_get(name) -> Optional[OpDef]:
+    return _REGISTRY.get(name)
+
+
+def list_ops():
+    return sorted(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# Eager dispatch: jit-per-(op, params) cache.  The analogue of the reference's
+# imperative PushFCompute (`src/imperative/imperative_utils.h:361-410`): one
+# cached XLA executable per (op, static attrs, input signature) — jax.jit
+# handles the per-signature level.
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _jitted(op_name, frozen_params):
+    import jax
+    op = _REGISTRY[op_name]
+    params = dict(frozen_params)
+
+    def run(*arrays):
+        return op.fn(params, *arrays)
+
+    return jax.jit(run)
+
+
+def eager_call(op: OpDef, params: dict, arrays):
+    """Execute an op eagerly; returns tuple of jax arrays (outputs then aux)."""
+    frozen = tuple(sorted(params.items()))
+    out = _jitted(op.name, frozen)(*arrays)
+    if not isinstance(out, (tuple, list)):
+        out = (out,)
+    return tuple(out)
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_vjp(op_name, frozen_params):
+    import jax
+    op = _REGISTRY[op_name]
+    params = dict(frozen_params)
+
+    def run(arrays, cotangents):
+        import jax.numpy as jnp
+
+        def fwd(*xs):
+            out = op.fn(params, *xs)
+            return out if isinstance(out, tuple) else (out,)
+        primals, vjp = jax.vjp(fwd, *arrays)
+        # ops may emit trailing aux-state outputs (e.g. BatchNorm running
+        # stats in train mode) that carry no gradient: pad with zeros
+        cts = tuple(cotangents) + tuple(
+            jnp.zeros_like(p) for p in primals[len(cotangents):])
+        return vjp(cts)
+
+    return jax.jit(run)
+
+
+def vjp_call(op: OpDef, params: dict, arrays, cotangents):
+    """Input gradients of an op at ``arrays`` given output ``cotangents``.
+
+    The `FGradient` equivalent (`include/mxnet/op_attr_types.h` FGradient):
+    computed from the same compute function via jax.vjp, compiled and cached.
+    """
+    frozen = tuple(sorted(params.items()))
+    return _jitted_vjp(op.name, frozen)(tuple(arrays), tuple(cotangents))
+
+
+def eval_shape(op: OpDef, params: dict, avals):
+    """Shape/dtype inference (replaces InferShape/InferType fixpoint,
+    `src/executor/infer_graph_attr_pass.cc:35-262`) via jax.eval_shape."""
+    import jax
+
+    def run(*xs):
+        out = op.fn(params, *xs)
+        return out if isinstance(out, tuple) else (out,)
+
+    return jax.eval_shape(run, *avals)

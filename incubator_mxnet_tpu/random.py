@@ -1,0 +1,41 @@
+"""Framework RNG state.
+
+Reference: per-device stateful generators behind ResourceManager
+(`src/resource.cc:87-160`, `include/mxnet/random_generator.h`) seeded by
+`mx.random.seed`.  TPU-native: one threefry key chain; every random op call
+consumes a split subkey (`ops/random_ops.py`).  `seed()` resets the chain —
+reproducible sequences, statistically (not bitwise) matching the reference.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["seed", "next_key"]
+
+_state = threading.local()
+
+
+def _key():
+    import jax
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(0)
+    return _state.key
+
+
+def seed(seed_state, ctx="all"):
+    """Reset the global key chain (reference `python/mxnet/random.py:seed`).
+
+    ``ctx`` is accepted for API parity; the key chain is global because
+    threefry is counter-based — device independence comes for free.
+    """
+    import jax
+    _state.key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    """Split and return a fresh subkey (internal: random-op dispatch)."""
+    import jax
+    k = _key()
+    k, sub = jax.random.split(k)
+    _state.key = k
+    return sub

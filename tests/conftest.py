@@ -1,0 +1,34 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's `tests/python/unittest/common.py` fixtures: seeded
+tests + a `default_context()` switch; multi-device collective tests use the
+8 virtual host devices (the TPU-mesh stand-in, per the build contract).
+"""
+import os
+
+# must be set before jax initializes
+os.environ["JAX_PLATFORMS"] = "cpu"  # tests always run on the virtual CPU mesh
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_ENABLE_X64"] = "1"  # fp64 for numeric-gradient reference checks
+
+# the environment pre-imports jax at interpreter startup, which freezes config
+# defaults before this file runs — override via the config API as well
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+assert len(jax.devices()) == 8, "virtual 8-device CPU mesh not active"
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seeded():
+    """Seed numpy + framework RNG per test (reference `with_seed()` decorator)."""
+    np.random.seed(0)
+    import incubator_mxnet_tpu as mx
+    mx.random.seed(0)
+    yield

@@ -1,0 +1,191 @@
+"""Autograd tape tests (reference tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + 2 * x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy() + 2)
+
+
+def test_chain_and_broadcast():
+    x = nd.array(np.random.rand(3, 4).astype("f4"))
+    w = nd.array(np.random.rand(5, 4).astype("f4"))
+    x.attach_grad()
+    w.attach_grad()
+    with autograd.record():
+        y = nd.dot(x, w, transpose_b=True)
+        z = nd.sum(nd.relu(y))
+    z.backward()
+    # reference grads via numpy
+    yv = x.asnumpy() @ w.asnumpy().T
+    dz = (yv > 0).astype("f4")
+    np.testing.assert_allclose(x.grad.asnumpy(), dz @ w.asnumpy(), rtol=1e-5)
+    np.testing.assert_allclose(w.grad.asnumpy(), dz.T @ x.asnumpy(), rtol=1e-5)
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([10.0, 100.0]))
+    np.testing.assert_allclose(x.grad.asnumpy(), [30.0, 300.0])
+
+
+def test_grad_req_add():
+    x = nd.array([2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = x * x
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [12.0])
+
+
+def test_pause_and_detach():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        with autograd.pause():
+            z = y * 2  # not recorded
+        w = y + 1
+    w.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])
+
+    x2 = nd.array([3.0])
+    x2.attach_grad()
+    with autograd.record():
+        y2 = (x2 * x2).detach() * x2
+    y2.backward()
+    np.testing.assert_allclose(x2.grad.asnumpy(), [9.0])
+
+
+def test_training_flags():
+    assert not autograd.is_recording()
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+
+
+def test_autograd_grad_api():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.sum(x * x)
+    (gx,) = autograd.grad([y], [x])
+    np.testing.assert_allclose(gx.asnumpy(), [2.0, 4.0])
+
+
+def test_stop_gradient():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + nd.BlockGrad(x * 5)
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])
+
+
+def test_multi_output_op_grad():
+    x = nd.array(np.arange(8, dtype="f4").reshape(2, 4))
+    x.attach_grad()
+    with autograd.record():
+        parts = nd.split(x, num_outputs=2, axis=1)
+        y = nd.sum(parts[0] * 2) + nd.sum(parts[1] * 3)
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               [[2, 2, 3, 3], [2, 2, 3, 3]])
+
+
+def test_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    f = Sigmoid()
+    x = nd.array(np.random.rand(5).astype("f4"))
+    x.attach_grad()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5)
+
+
+def test_dropout_respects_mode():
+    x = nd.ones((100, 100))
+    with autograd.record(train_mode=False):
+        y = nd.Dropout(x, p=0.5)
+    assert (y.asnumpy() == 1).all()
+    with autograd.record(train_mode=True):
+        y = nd.Dropout(x, p=0.5)
+    frac = (y.asnumpy() == 0).mean()
+    assert 0.3 < frac < 0.7
+
+
+def test_batchnorm_backward_with_aux():
+    """Regression: vjp through ops with aux-state outputs (BatchNorm train)."""
+    x = nd.array(np.random.rand(4, 3, 2, 2).astype("f4"))
+    x.attach_grad()
+    gamma, beta = nd.ones((3,)), nd.zeros((3,))
+    mmean, mvar = nd.zeros((3,)), nd.ones((3,))
+    with autograd.record():
+        y = nd.BatchNorm(x, gamma, beta, mmean, mvar, fix_gamma=False)
+        z = nd.sum(y)
+    z.backward()
+    assert x.grad is not None
+    assert np.isfinite(x.grad.asnumpy()).all()
+
+
+def test_slicing_gradient_flows():
+    """Regression: basic and advanced indexing must be recorded on the tape."""
+    x = nd.array(np.arange(6, dtype="f4").reshape(3, 2))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.sum(x[0:2] * 2.0)
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [[2, 2], [2, 2], [0, 0]])
+
+    x2 = nd.array(np.arange(6, dtype="f4").reshape(3, 2))
+    x2.attach_grad()
+    idx = nd.array([0, 2], dtype="int32")
+    with autograd.record():
+        y2 = nd.sum(x2[idx] * 3.0)
+    y2.backward()
+    np.testing.assert_allclose(x2.grad.asnumpy(), [[3, 3], [0, 0], [3, 3]])
+
+
+def test_out_kwarg_rejected_under_recording():
+    import pytest
+    x = nd.ones((2,))
+    x.attach_grad()
+    y = nd.zeros((2,))
+    with pytest.raises(mx.MXNetError):
+        with autograd.record():
+            nd.relu(x, out=y)
+
+
+def test_boolean_mask_index_raises():
+    import pytest
+    x = nd.array([1.0, -1.0, 2.0])
+    mask = np.array([True, False, True])
+    with pytest.raises(mx.MXNetError):
+        x[mask]

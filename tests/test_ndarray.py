@@ -1,0 +1,181 @@
+"""NDArray basics (reference tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def test_creation():
+    a = nd.zeros((2, 3))
+    assert a.shape == (2, 3)
+    assert a.dtype == np.float32
+    assert (a.asnumpy() == 0).all()
+
+    b = nd.ones((4,), dtype="int32")
+    assert b.dtype == np.int32
+    assert (b.asnumpy() == 1).all()
+
+    c = nd.full((2, 2), 7.5)
+    assert (c.asnumpy() == 7.5).all()
+
+    d = nd.array([[1, 2], [3, 4]])
+    assert d.shape == (2, 2)
+    assert d.dtype == np.float32  # MXNet default dtype
+
+    e = nd.arange(0, 10, 2)
+    np.testing.assert_allclose(e.asnumpy(), [0, 2, 4, 6, 8])
+
+
+def test_arithmetic():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[10.0, 20.0], [30.0, 40.0]])
+    np.testing.assert_allclose((a + b).asnumpy(), [[11, 22], [33, 44]])
+    np.testing.assert_allclose((b - a).asnumpy(), [[9, 18], [27, 36]])
+    np.testing.assert_allclose((a * 2).asnumpy(), [[2, 4], [6, 8]])
+    np.testing.assert_allclose((2 * a).asnumpy(), [[2, 4], [6, 8]])
+    np.testing.assert_allclose((1 / a).asnumpy(), 1.0 / a.asnumpy())
+    np.testing.assert_allclose((a ** 2).asnumpy(), a.asnumpy() ** 2)
+    np.testing.assert_allclose((-a).asnumpy(), -a.asnumpy())
+    np.testing.assert_allclose((a > 2).asnumpy(), (a.asnumpy() > 2).astype("f4"))
+
+
+def test_broadcast():
+    a = nd.ones((2, 1, 3))
+    b = nd.ones((1, 4, 3))
+    assert (a + b).shape == (2, 4, 3)
+    c = nd.broadcast_to(nd.ones((1, 3)), shape=(4, 3))
+    assert c.shape == (4, 3)
+
+
+def test_reshape_special_codes():
+    x = nd.zeros((2, 3, 4))
+    assert x.reshape((6, 4)).shape == (6, 4)
+    assert x.reshape((-1,)).shape == (24,)
+    assert x.reshape((0, -1)).shape == (2, 12)
+    assert x.reshape((-2,)).shape == (2, 3, 4)
+    assert x.reshape((-3, 4)).shape == (6, 4)
+    assert x.reshape((-4, 1, 2, -2)).shape == (1, 2, 3, 4)
+    assert x.reshape((0, 0, -1)).shape == (2, 3, 4)
+
+
+def test_indexing():
+    x = nd.array(np.arange(24).reshape(2, 3, 4))
+    np.testing.assert_allclose(x[1].asnumpy(), np.arange(24).reshape(2, 3, 4)[1])
+    np.testing.assert_allclose(x[:, 1].asnumpy(),
+                               np.arange(24).reshape(2, 3, 4)[:, 1])
+    np.testing.assert_allclose(x[0, 1, 2].asnumpy(), 6)
+    x[0] = 0
+    assert (x.asnumpy()[0] == 0).all()
+    x[:] = 5
+    assert (x.asnumpy() == 5).all()
+
+
+def test_reduce_ops():
+    x = nd.array(np.arange(12, dtype="f4").reshape(3, 4))
+    np.testing.assert_allclose(x.sum().asnumpy(), 66)
+    np.testing.assert_allclose(nd.sum(x, axis=0).asnumpy(),
+                               x.asnumpy().sum(axis=0))
+    np.testing.assert_allclose(nd.sum(x, axis=1, keepdims=True).asnumpy(),
+                               x.asnumpy().sum(axis=1, keepdims=True))
+    np.testing.assert_allclose(nd.mean(x).asnumpy(), x.asnumpy().mean())
+    np.testing.assert_allclose(nd.max(x, axis=1).asnumpy(),
+                               x.asnumpy().max(axis=1))
+    np.testing.assert_allclose(nd.argmax(x, axis=1).asnumpy(),
+                               x.asnumpy().argmax(axis=1).astype("f4"))
+    # exclude=True reduces over the complement
+    np.testing.assert_allclose(nd.sum(x, axis=0, exclude=True).asnumpy(),
+                               x.asnumpy().sum(axis=1))
+
+
+def test_dot():
+    a = nd.array(np.random.rand(3, 4).astype("f4"))
+    b = nd.array(np.random.rand(4, 5).astype("f4"))
+    np.testing.assert_allclose(nd.dot(a, b).asnumpy(),
+                               a.asnumpy() @ b.asnumpy(), rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.dot(a, b.T, transpose_b=True).asnumpy()[0, 0],
+        (a.asnumpy() @ b.asnumpy())[0, 0], rtol=1e-5)
+
+
+def test_concat_split_stack():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    parts = nd.split(c, num_outputs=2, axis=0)
+    assert len(parts) == 2 and parts[0].shape == (2, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+
+
+def test_astype_copy_context():
+    a = nd.ones((2, 2))
+    b = a.astype("float64")
+    assert b.dtype == np.float64
+    c = a.copyto(mx.cpu())
+    assert c.shape == (2, 2)
+    d = a.as_in_context(mx.cpu())
+    assert d.context.device_type == "cpu"
+
+
+def test_take_embedding_onehot():
+    w = nd.array(np.arange(12, dtype="f4").reshape(4, 3))
+    idx = nd.array([0, 2], dtype="int32")
+    out = nd.take(w, idx)
+    np.testing.assert_allclose(out.asnumpy(),
+                               w.asnumpy()[[0, 2]])
+    emb = nd.Embedding(idx, w, input_dim=4, output_dim=3)
+    np.testing.assert_allclose(emb.asnumpy(), w.asnumpy()[[0, 2]])
+    oh = nd.one_hot(idx, depth=4)
+    np.testing.assert_allclose(oh.asnumpy(), np.eye(4, dtype="f4")[[0, 2]])
+
+
+def test_topk_sort():
+    x = nd.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+    idx = nd.topk(x, k=1)
+    np.testing.assert_allclose(idx.asnumpy().reshape(-1), [0, 1])
+    v = nd.topk(x, k=2, ret_typ="value")
+    np.testing.assert_allclose(v.asnumpy(), [[3, 2], [5, 4]])
+    s = nd.sort(x)
+    np.testing.assert_allclose(s.asnumpy(), np.sort(x.asnumpy()))
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrays.params")
+    data = {"w": nd.ones((2, 2)), "b": nd.zeros((3,))}
+    nd.save(fname, data)
+    loaded = nd.load(fname)
+    assert set(loaded) == {"w", "b"}
+    np.testing.assert_allclose(loaded["w"].asnumpy(), 1)
+
+    nd.save(fname, [nd.ones((2,))])
+    loaded = nd.load(fname)
+    assert isinstance(loaded, list) and len(loaded) == 1
+
+
+def test_random():
+    mx.random.seed(42)
+    a = nd.random.uniform(0, 1, shape=(100,))
+    mx.random.seed(42)
+    b = nd.random.uniform(0, 1, shape=(100,))
+    np.testing.assert_allclose(a.asnumpy(), b.asnumpy())
+    assert 0 <= a.asnumpy().min() and a.asnumpy().max() <= 1
+    n = nd.random.normal(0, 1, shape=(1000,))
+    assert abs(n.asnumpy().mean()) < 0.2
+
+
+def test_waitall_and_engine():
+    a = nd.ones((10, 10))
+    for _ in range(5):
+        a = a * 2
+    nd.waitall()
+    assert a.asnumpy()[0, 0] == 32
+
+
+def test_scalar_conversion():
+    a = nd.array([3.5])
+    assert float(a) == 3.5
+    assert a.asscalar() == np.float32(3.5)
+    with pytest.raises(ValueError):
+        bool(nd.ones((2,)))

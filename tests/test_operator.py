@@ -1,0 +1,335 @@
+"""Operator correctness tests (reference tests/python/unittest/test_operator.py).
+
+Forward checks against numpy references; gradients via the autograd tape
+checked against finite differences for key ops (the reference's
+check_numeric_gradient backbone, `python/mxnet/test_utils.py:790`).
+"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd
+
+
+def numeric_grad(f, x, eps=1e-4):
+    """Central finite differences of scalar f at numpy x."""
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        orig = x[idx]
+        x[idx] = orig + eps
+        fp = f(x)
+        x[idx] = orig - eps
+        fm = f(x)
+        x[idx] = orig
+        g[idx] = (fp - fm) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def check_grad(op_fn, np_x, rtol=1e-3, atol=1e-4):
+    """Compare autograd gradient of sum(op(x)) with finite differences."""
+    x = nd.array(np_x, dtype=np_x.dtype)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.sum(op_fn(x))
+    y.backward()
+    ng = numeric_grad(lambda v: float(nd.sum(op_fn(nd.array(v, dtype=v.dtype))).asnumpy()),
+                      np_x.copy())
+    np.testing.assert_allclose(x.grad.asnumpy(), ng, rtol=rtol, atol=atol)
+
+
+def test_unary_forward():
+    x = np.random.rand(3, 4).astype("float64") + 0.5
+    cases = {
+        "exp": np.exp, "log": np.log, "sqrt": np.sqrt, "square": np.square,
+        "sigmoid": lambda v: 1 / (1 + np.exp(-v)), "tanh": np.tanh,
+        "abs": np.abs, "relu": lambda v: np.maximum(v, 0),
+    }
+    for name, ref in cases.items():
+        out = getattr(nd, name)(nd.array(x, dtype="float64")).asnumpy()
+        np.testing.assert_allclose(out, ref(x), rtol=1e-6, err_msg=name)
+
+
+def test_unary_grads():
+    x = np.random.rand(2, 3).astype("float64") + 0.5
+    for name in ["exp", "log", "sqrt", "square", "sigmoid", "tanh"]:
+        check_grad(getattr(nd, name), x)
+
+
+def test_fully_connected():
+    x = np.random.rand(4, 10).astype("f4")
+    w = np.random.rand(6, 10).astype("f4")
+    b = np.random.rand(6).astype("f4")
+    out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b), num_hidden=6)
+    np.testing.assert_allclose(out.asnumpy(), x @ w.T + b, rtol=1e-5)
+    out2 = nd.FullyConnected(nd.array(x), nd.array(w), num_hidden=6, no_bias=True)
+    np.testing.assert_allclose(out2.asnumpy(), x @ w.T, rtol=1e-5)
+    # flatten semantics: (N, C, H, W) -> (N, C*H*W)
+    x4 = np.random.rand(2, 3, 2, 2).astype("f4")
+    w4 = np.random.rand(5, 12).astype("f4")
+    out3 = nd.FullyConnected(nd.array(x4), nd.array(w4), num_hidden=5, no_bias=True)
+    np.testing.assert_allclose(out3.asnumpy(), x4.reshape(2, -1) @ w4.T, rtol=1e-5)
+
+
+def test_convolution_vs_reference():
+    """Convolution forward against explicit im2col reference."""
+    np.random.seed(1)
+    x = np.random.rand(2, 3, 5, 5).astype("float64")
+    w = np.random.rand(4, 3, 3, 3).astype("float64")
+    b = np.random.rand(4).astype("float64")
+    out = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                         kernel=(3, 3), num_filter=4, stride=(1, 1), pad=(1, 1))
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    ref = np.zeros((2, 4, 5, 5))
+    for n in range(2):
+        for f in range(4):
+            for i in range(5):
+                for j in range(5):
+                    ref[n, f, i, j] = np.sum(
+                        xp[n, :, i:i + 3, j:j + 3] * w[f]) + b[f]
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5)
+
+
+def test_convolution_grouped_strided():
+    x = np.random.rand(1, 4, 8, 8).astype("f4")
+    w = np.random.rand(8, 2, 3, 3).astype("f4")
+    out = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3), num_filter=8,
+                         num_group=2, stride=(2, 2), pad=(1, 1), no_bias=True)
+    assert out.shape == (1, 8, 4, 4)
+
+
+def test_deconvolution_shape_and_grad_identity():
+    x = np.random.rand(1, 3, 4, 4).astype("f4")
+    w = np.random.rand(3, 5, 3, 3).astype("f4")
+    out = nd.Deconvolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                           num_filter=5, stride=(2, 2), pad=(1, 1),
+                           adj=(1, 1), no_bias=True)
+    assert out.shape == (1, 5, 8, 8)
+    # deconv(conv) shape round trip
+    y = nd.Convolution(out, nd.array(np.random.rand(3, 5, 3, 3).astype("f4")),
+                       kernel=(3, 3), num_filter=3, stride=(2, 2), pad=(1, 1),
+                       no_bias=True)
+    assert y.shape == (1, 3, 4, 4)
+
+
+def test_pooling():
+    x = np.arange(16, dtype="f4").reshape(1, 1, 4, 4)
+    mp = nd.Pooling(nd.array(x), kernel=(2, 2), pool_type="max", stride=(2, 2))
+    np.testing.assert_allclose(mp.asnumpy().reshape(2, 2), [[5, 7], [13, 15]])
+    ap = nd.Pooling(nd.array(x), kernel=(2, 2), pool_type="avg", stride=(2, 2))
+    np.testing.assert_allclose(ap.asnumpy().reshape(2, 2), [[2.5, 4.5], [10.5, 12.5]])
+    gp = nd.Pooling(nd.array(x), kernel=(1, 1), pool_type="max", global_pool=True)
+    assert gp.shape == (1, 1, 1, 1) and gp.asnumpy().item() == 15
+    # ceil (full) convention
+    fp = nd.Pooling(nd.array(x), kernel=(3, 3), stride=(2, 2), pool_type="max",
+                    pooling_convention="full")
+    assert fp.shape == (1, 1, 2, 2)
+
+
+def test_batchnorm_train_and_inference():
+    np.random.seed(2)
+    x = np.random.rand(8, 3, 4, 4).astype("f4") * 5
+    gamma = np.ones(3, dtype="f4")
+    beta = np.zeros(3, dtype="f4")
+    mmean = nd.zeros((3,))
+    mvar = nd.ones((3,))
+    with autograd.record(train_mode=True):
+        out = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                           mmean, mvar, fix_gamma=False, momentum=0.9, eps=1e-5)
+    o = out.asnumpy()
+    # normalized per channel over (N,H,W)
+    assert abs(o.mean(axis=(0, 2, 3))).max() < 1e-4
+    np.testing.assert_allclose(o.std(axis=(0, 2, 3)), 1.0, rtol=1e-2)
+    # moving stats updated
+    batch_mean = x.mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(mmean.asnumpy(), 0.1 * batch_mean, rtol=1e-4)
+    # inference path uses moving stats
+    out_inf = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                           mmean, mvar, fix_gamma=False, eps=1e-5)
+    ref = (x - mmean.asnumpy().reshape(1, 3, 1, 1)) / np.sqrt(
+        mvar.asnumpy().reshape(1, 3, 1, 1) + 1e-5)
+    np.testing.assert_allclose(out_inf.asnumpy(), ref, rtol=1e-4)
+
+
+def test_layernorm():
+    x = np.random.rand(4, 10).astype("f4")
+    g = np.random.rand(10).astype("f4")
+    b = np.random.rand(10).astype("f4")
+    out = nd.LayerNorm(nd.array(x), nd.array(g), nd.array(b), eps=1e-5)
+    mu = x.mean(-1, keepdims=True)
+    sd = x.std(-1, keepdims=True)
+    np.testing.assert_allclose(out.asnumpy(), (x - mu) / np.sqrt(sd**2 + 1e-5) * g + b,
+                               rtol=1e-4)
+
+
+def test_softmax_ops():
+    x = np.random.rand(3, 5).astype("f4")
+    s = nd.softmax(nd.array(x)).asnumpy()
+    e = np.exp(x - x.max(-1, keepdims=True))
+    np.testing.assert_allclose(s, e / e.sum(-1, keepdims=True), rtol=1e-5)
+    ls = nd.log_softmax(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(ls, np.log(s), rtol=1e-4, atol=1e-6)
+
+
+def test_softmax_output_grad():
+    """SoftmaxOutput: backward must be softmax - onehot, ignoring head grad."""
+    x = np.random.rand(4, 5).astype("f4")
+    label = np.array([0, 2, 1, 4], dtype="f4")
+    data = nd.array(x)
+    data.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(data, nd.array(label))
+    out.backward()
+    sm = np.exp(x - x.max(-1, keepdims=True))
+    sm = sm / sm.sum(-1, keepdims=True)
+    onehot = np.eye(5, dtype="f4")[label.astype(int)]
+    np.testing.assert_allclose(data.grad.asnumpy(), sm - onehot, rtol=1e-5)
+
+
+def test_softmax_output_ignore_label():
+    x = np.random.rand(3, 4).astype("f4")
+    label = np.array([1, -1, 2], dtype="f4")
+    data = nd.array(x)
+    data.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(data, nd.array(label), use_ignore=True,
+                               ignore_label=-1)
+    out.backward()
+    g = data.grad.asnumpy()
+    assert (g[1] == 0).all() and (g[0] != 0).any()
+
+
+def test_regression_outputs():
+    x = np.random.rand(4, 3).astype("f4")
+    lbl = np.random.rand(4, 3).astype("f4")
+    d = nd.array(x)
+    d.attach_grad()
+    with autograd.record():
+        out = nd.LinearRegressionOutput(d, nd.array(lbl))
+    out.backward()
+    np.testing.assert_allclose(out.asnumpy(), x)
+    np.testing.assert_allclose(d.grad.asnumpy(), (x - lbl) / 3, rtol=1e-5)
+
+
+def test_activation_types():
+    x = np.linspace(-2, 2, 9, dtype="f4")
+    a = nd.array(x)
+    np.testing.assert_allclose(nd.Activation(a, act_type="relu").asnumpy(),
+                               np.maximum(x, 0))
+    np.testing.assert_allclose(nd.Activation(a, act_type="softrelu").asnumpy(),
+                               np.log1p(np.exp(x)), rtol=1e-5)
+    np.testing.assert_allclose(nd.LeakyReLU(a, act_type="leaky", slope=0.1).asnumpy(),
+                               np.where(x > 0, x, 0.1 * x), rtol=1e-6)
+    np.testing.assert_allclose(nd.LeakyReLU(a, act_type="elu", slope=1.0).asnumpy(),
+                               np.where(x > 0, x, np.expm1(x)), rtol=1e-5)
+
+
+def test_optimizer_ops():
+    w = nd.array([1.0, 2.0])
+    g = nd.array([0.1, 0.2])
+    out = nd.sgd_update(w, g, lr=0.1, wd=0.0, out=w)
+    np.testing.assert_allclose(w.asnumpy(), [0.99, 1.98], rtol=1e-6)
+
+    # momentum
+    w = nd.array([1.0])
+    g = nd.array([1.0])
+    mom = nd.zeros((1,))
+    nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9, out=w)
+    np.testing.assert_allclose(w.asnumpy(), [0.9], rtol=1e-6)
+    np.testing.assert_allclose(mom.asnumpy(), [-0.1], rtol=1e-6)
+    nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9, out=w)
+    np.testing.assert_allclose(mom.asnumpy(), [-0.19], rtol=1e-6)
+
+    # adam
+    w = nd.array([1.0])
+    mean = nd.zeros((1,))
+    var = nd.zeros((1,))
+    nd.adam_update(w, g, mean, var, lr=0.01, beta1=0.9, beta2=0.999,
+                   epsilon=1e-8, out=w)
+    assert w.asnumpy()[0] < 1.0
+
+
+def test_rnn_lstm_shapes():
+    T, B, I, H, L = 5, 3, 4, 6, 2
+    from incubator_mxnet_tpu.ops.nn import rnn_param_size
+    psize = rnn_param_size("lstm", I, H, L, False)
+    data = nd.random.uniform(shape=(T, B, I))
+    params = nd.random.uniform(-0.1, 0.1, shape=(psize,))
+    h0 = nd.zeros((L, B, H))
+    c0 = nd.zeros((L, B, H))
+    out = nd.RNN(data, params, h0, c0, state_size=H, num_layers=L,
+                 mode="lstm", state_outputs=True)
+    assert out[0].shape == (T, B, H)
+    assert out[1].shape == (L, B, H)
+    assert out[2].shape == (L, B, H)
+    # bidirectional
+    psize_bi = rnn_param_size("lstm", I, H, L, True)
+    params_bi = nd.random.uniform(-0.1, 0.1, shape=(psize_bi,))
+    out_bi = nd.RNN(data, params_bi, nd.zeros((2 * L, B, H)),
+                    nd.zeros((2 * L, B, H)), state_size=H, num_layers=L,
+                    mode="lstm", bidirectional=True, state_outputs=True)
+    assert out_bi[0].shape == (T, B, 2 * H)
+
+
+def test_rnn_gru_matches_manual():
+    """Single-layer GRU against a manual numpy step."""
+    T, B, I, H = 3, 2, 4, 5
+    from incubator_mxnet_tpu.ops.nn import rnn_param_size
+    np.random.seed(3)
+    psize = rnn_param_size("gru", I, H, 1, False)
+    flat = np.random.uniform(-0.5, 0.5, psize).astype("f4")
+    data = np.random.rand(T, B, I).astype("f4")
+    out = nd.RNN(nd.array(data), nd.array(flat), nd.zeros((1, B, H)),
+                 state_size=H, num_layers=1, mode="gru")
+    # manual
+    wx = flat[:3 * H * I].reshape(3 * H, I)
+    wh = flat[3 * H * I:3 * H * I + 3 * H * H].reshape(3 * H, H)
+    bx = flat[3 * H * (I + H):3 * H * (I + H) + 3 * H]
+    bh = flat[3 * H * (I + H) + 3 * H:]
+    h = np.zeros((B, H), dtype="f4")
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    for t in range(T):
+        xw = data[t] @ wx.T + bx
+        hw = h @ wh.T + bh
+        xr, xz, xn = np.split(xw, 3, -1)
+        hr, hz, hn = np.split(hw, 3, -1)
+        r = sig(xr + hr)
+        z = sig(xz + hz)
+        n = np.tanh(xn + r * hn)
+        h = (1 - z) * n + z * h
+    np.testing.assert_allclose(out.asnumpy()[-1], h, rtol=1e-4, atol=1e-5)
+
+
+def test_linalg():
+    a = np.random.rand(4, 4)
+    spd = a @ a.T + 4 * np.eye(4)
+    l = nd.linalg.potrf(nd.array(spd, dtype="float64"))
+    np.testing.assert_allclose(l.asnumpy() @ l.asnumpy().T, spd, rtol=1e-6)
+    sld = nd.linalg.sumlogdiag(nd.array(np.eye(3) * np.e))
+    np.testing.assert_allclose(sld.asnumpy(), 3.0, rtol=1e-6)
+
+
+def test_where_clip_misc():
+    c = nd.array([1.0, 0.0, 1.0])
+    x = nd.array([1.0, 2.0, 3.0])
+    y = nd.array([10.0, 20.0, 30.0])
+    np.testing.assert_allclose(nd.where(c, x, y).asnumpy(), [1, 20, 3])
+    np.testing.assert_allclose(nd.clip(x, a_min=1.5, a_max=2.5).asnumpy(),
+                               [1.5, 2.0, 2.5])
+
+
+def test_sequence_ops():
+    x = np.arange(24, dtype="f4").reshape(4, 3, 2)  # (T, B, D)
+    seqlen = nd.array([2.0, 3.0, 4.0])
+    masked = nd.SequenceMask(nd.array(x), seqlen, use_sequence_length=True,
+                             value=-1.0)
+    m = masked.asnumpy()
+    assert (m[2, 0] == -1).all() and (m[1, 0] == x[1, 0]).all()
+    last = nd.SequenceLast(nd.array(x), seqlen, use_sequence_length=True)
+    np.testing.assert_allclose(last.asnumpy()[0], x[1, 0])
+    np.testing.assert_allclose(last.asnumpy()[2], x[3, 2])
+    rev = nd.SequenceReverse(nd.array(x), seqlen, use_sequence_length=True)
+    np.testing.assert_allclose(rev.asnumpy()[0, 0], x[1, 0])
+    np.testing.assert_allclose(rev.asnumpy()[2, 0], x[2, 0])
