@@ -22,7 +22,7 @@ _SOFTMAX_OUT_PARAMS = {
 
 
 @register("SoftmaxOutput", nin=2, params=dict(_SOFTMAX_OUT_PARAMS),
-          aliases=("Softmax",))
+          aliases=("Softmax",), input_names=["data", "label"])
 def _softmax_output(params, data, label):
     """Forward = softmax; backward = (softmax - onehot(label)) * grad_scale,
     with ignore-label masking and normalization (reference
@@ -105,11 +105,14 @@ def _regression(link, grad_fn):
 
 # reference regression_output-inl.h: grad = (pred - label) (linear/logistic),
 # sign(pred - label) for MAE; scaled by grad_scale / num_output.
-register("LinearRegressionOutput", nin=2, params={"grad_scale": 1.0})(
+register("LinearRegressionOutput", nin=2, params={"grad_scale": 1.0},
+         input_names=["data", "label"])(
     _regression(lambda d: d, lambda o, l: (o - l)))
-register("LogisticRegressionOutput", nin=2, params={"grad_scale": 1.0})(
+register("LogisticRegressionOutput", nin=2, params={"grad_scale": 1.0},
+         input_names=["data", "label"])(
     _regression(jax.nn.sigmoid, lambda o, l: (o - l)))
-register("MAERegressionOutput", nin=2, params={"grad_scale": 1.0})(
+register("MAERegressionOutput", nin=2, params={"grad_scale": 1.0},
+         input_names=["data", "label"])(
     _regression(lambda d: d, lambda o, l: jnp.sign(o - l)))
 
 
@@ -145,7 +148,7 @@ def _make_loss_op(params, data):
 
 @register("SVMOutput", nin=2,
           params={"margin": 1.0, "regularization_coefficient": 1.0,
-                  "use_linear": False})
+                  "use_linear": False}, input_names=["data", "label"])
 def _svm_output(params, data, label):
     """Reference `svm_output.cc`: forward identity; backward hinge-loss grad."""
     margin = float(params["margin"])

@@ -276,7 +276,8 @@ def _batch_take(params, a, indices):
 
 @register("Embedding", nin=2,
           params={"input_dim": REQUIRED, "output_dim": REQUIRED,
-                  "dtype": "float32", "sparse_grad": False})
+                  "dtype": "float32", "sparse_grad": False},
+          input_names=["data", "weight"])
 def _embedding(params, data, weight):
     """Reference `indexing_op.cc` Embedding: weight[data] gather."""
     idx = jnp.clip(data.astype("int32"), 0, int(params["input_dim"]) - 1)

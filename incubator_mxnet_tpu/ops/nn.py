@@ -27,7 +27,8 @@ from ..base import MXNetError
 # ---------------------------------------------------------------------------
 
 @register("FullyConnected", nin=-1,
-          params={"num_hidden": REQUIRED, "no_bias": False, "flatten": True})
+          params={"num_hidden": REQUIRED, "no_bias": False, "flatten": True},
+          input_names=lambda p: ["data", "weight"] + ([] if p.get("no_bias") else ["bias"]))
 def _fully_connected(params, x, weight, *rest):
     if params["flatten"]:
         x2 = x.reshape(x.shape[0], -1)
@@ -70,7 +71,8 @@ _CONV_PARAMS = {
 }
 
 
-@register("Convolution", nin=-1, params=dict(_CONV_PARAMS))
+@register("Convolution", nin=-1, params=dict(_CONV_PARAMS),
+          input_names=lambda p: ["data", "weight"] + ([] if p.get("no_bias") else ["bias"]))
 def _convolution(params, x, weight, *rest):
     kernel = tuple(params["kernel"])
     nd = len(kernel)
@@ -95,7 +97,8 @@ _DECONV_PARAMS = dict(_CONV_PARAMS)
 _DECONV_PARAMS.update({"adj": (), "target_shape": ()})
 
 
-@register("Deconvolution", nin=-1, params=_DECONV_PARAMS)
+@register("Deconvolution", nin=-1, params=_DECONV_PARAMS,
+          input_names=lambda p: ["data", "weight"] + ([] if p.get("no_bias") else ["bias"]))
 def _deconvolution(params, x, weight, *rest):
     """Transposed convolution = gradient of Convolution w.r.t. its input
     (reference deconvolution-inl.h).  weight layout: (Cin, Cout/g, *kernel)."""
@@ -204,7 +207,8 @@ def _bn_nout(params):
           params={"eps": 1e-3, "momentum": 0.9, "fix_gamma": True,
                   "use_global_stats": False, "output_mean_var": False,
                   "axis": 1, "cudnn_off": False},
-          aliases=("BatchNorm_v1",))
+          aliases=("BatchNorm_v1",),
+          input_names=["data", "gamma", "beta", "moving_mean", "moving_var"])
 def _batch_norm(params, x, gamma, beta, moving_mean, moving_var):
     """Reference `src/operator/nn/batch_norm.cc`.  Aux states
     (moving_mean/var) are inputs 4-5 and returned as updates in train mode."""
@@ -245,7 +249,8 @@ def _ln_nout(params):
 
 
 @register("LayerNorm", nin=3, nout=_ln_nout,
-          params={"axis": -1, "eps": 1e-5, "output_mean_var": False})
+          params={"axis": -1, "eps": 1e-5, "output_mean_var": False},
+          input_names=["data", "gamma", "beta"])
 def _layer_norm(params, x, gamma, beta):
     """Reference `src/operator/nn/layer_norm.cc`."""
     axis = int(params["axis"]) % x.ndim
@@ -261,7 +266,8 @@ def _layer_norm(params, x, gamma, beta):
     return out
 
 
-@register("InstanceNorm", nin=3, params={"eps": 1e-3})
+@register("InstanceNorm", nin=3, params={"eps": 1e-3},
+          input_names=["data", "gamma", "beta"])
 def _instance_norm(params, x, gamma, beta):
     """Reference `src/operator/instance_norm.cc`: normalize over spatial dims
     per (n, c)."""
@@ -329,7 +335,8 @@ def _activation(params, x):
 
 @register("LeakyReLU", nin=-1,
           params={"act_type": "leaky", "slope": 0.25, "lower_bound": 0.125,
-                  "upper_bound": 0.334})
+                  "upper_bound": 0.334},
+          input_names=lambda p: ["data"] + (["gamma"] if p.get("act_type") == "prelu" else []))
 def _leaky_relu(params, x, *rest):
     """Reference `src/operator/leaky_relu.cc` (leaky/prelu/elu/selu/gelu/rrelu)."""
     t = params["act_type"]
@@ -502,6 +509,8 @@ def _rnn_nout(params):
 
 
 @register("RNN", nin=-1, nout=_rnn_nout, mode_dependent=True, needs_rng=True,
+          input_names=lambda p: ["data", "parameters", "state"] + (
+              ["state_cell"] if p.get("mode") == "lstm" else []),
           params={"state_size": REQUIRED, "num_layers": REQUIRED,
                   "bidirectional": False, "mode": REQUIRED, "p": 0.0,
                   "state_outputs": False, "projection_size": None,

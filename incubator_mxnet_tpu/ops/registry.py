@@ -70,12 +70,12 @@ class OpDef:
 
     __slots__ = ("name", "fn", "nin", "nout", "naux", "params", "param_types",
                  "needs_rng", "mode_dependent", "stop_grad", "aliases",
-                 "variadic_param", "dynamic_params", "doc")
+                 "variadic_param", "dynamic_params", "input_names", "doc")
 
     def __init__(self, name, fn, nin=1, nout=1, naux=0, params=None,
                  param_types=None, needs_rng=False, mode_dependent=False,
                  stop_grad=False, aliases=(), variadic_param=None,
-                 dynamic_params=(), doc=None):
+                 dynamic_params=(), input_names=None, doc=None):
         self.name = name
         self.fn = fn
         self.nin = nin
@@ -92,6 +92,11 @@ class OpDef:
         # tensor inputs, before the rng key) so e.g. a changing learning rate
         # does not retrigger XLA compilation.
         self.dynamic_params = tuple(dynamic_params)
+        # input_names: static list or callable(params)->list of input slot
+        # names; the symbolic frontend auto-creates Variables for trailing
+        # missing inputs (reference ListArguments + auto-var creation in
+        # Symbol composition, e.g. fc1_weight/fc1_bias)
+        self.input_names = input_names
         self.doc = doc or (fn.__doc__ if fn else None)
 
     # -- parameter handling ---------------------------------------------------
@@ -120,6 +125,13 @@ class OpDef:
 
     def num_aux(self, params):
         return self.naux(params) if callable(self.naux) else self.naux
+
+    def list_input_names(self, params):
+        if self.input_names is None:
+            return None
+        if callable(self.input_names):
+            return list(self.input_names(params))
+        return list(self.input_names)
 
     def num_inputs(self, params):
         if self.nin >= 0:

@@ -1,0 +1,10 @@
+"""`mx.sym` — symbolic graph package (reference `python/mxnet/symbol/`)."""
+from .symbol import Symbol, Variable, var, Group, load, load_json
+from . import register as _register
+import sys as _sys
+
+_register.populate(_sys.modules[__name__])
+
+from . import contrib  # noqa: E402,F401
+from . import linalg  # noqa: E402,F401
+from . import random  # noqa: E402,F401

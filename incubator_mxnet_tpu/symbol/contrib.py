@@ -1,0 +1,23 @@
+"""`mx.sym.contrib` (reference `python/mxnet/symbol/contrib.py`).
+
+Symbolic control flow (`foreach`/`while_loop`/`cond`) traces python callables
+over Symbols — the graph executor lowers the resulting subgraphs through
+`lax.scan`/`while_loop`/`cond` when compiled (reference
+`src/operator/control_flow.cc` runs them as CachedOp subgraphs)."""
+from __future__ import annotations
+
+import sys as _sys
+
+from ..ops import registry as _reg
+from .symbol import Symbol, _sym_apply
+
+_this = _sys.modules[__name__]
+for _name in _reg.list_ops():
+    if _name.startswith("_contrib_"):
+        def _make(op_name):
+            def fn(*args, **kwargs):
+                data = [a for a in args if isinstance(a, Symbol)]
+                return _sym_apply(op_name, data, kwargs)
+            fn.__name__ = op_name[len("_contrib_"):]
+            return fn
+        setattr(_this, _name[len("_contrib_"):], _make(_name))

@@ -1,0 +1,25 @@
+"""`mx.sym.linalg` (reference `python/mxnet/symbol/linalg.py`)."""
+from .symbol import _sym_apply
+
+
+def _wrap(opname):
+    def fn(*args, **kwargs):
+        return _sym_apply(opname, list(args), kwargs)
+    fn.__name__ = opname.replace("linalg_", "")
+    return fn
+
+
+gemm = _wrap("linalg_gemm")
+gemm2 = _wrap("linalg_gemm2")
+potrf = _wrap("linalg_potrf")
+potri = _wrap("linalg_potri")
+trsm = _wrap("linalg_trsm")
+trmm = _wrap("linalg_trmm")
+syrk = _wrap("linalg_syrk")
+gelqf = _wrap("linalg_gelqf")
+syevd = _wrap("linalg_syevd")
+sumlogdiag = _wrap("linalg_sumlogdiag")
+extractdiag = _wrap("linalg_extractdiag")
+makediag = _wrap("linalg_makediag")
+inverse = _wrap("linalg_inverse")
+det = _wrap("linalg_det")

@@ -1,0 +1,58 @@
+"""Generate `sym.*` op functions from the registry (reference
+`python/mxnet/symbol/register.py`)."""
+from __future__ import annotations
+
+import sys
+import types
+
+from ..ops import registry as _reg
+from .symbol import Symbol, _sym_apply
+
+_internal = types.ModuleType("incubator_mxnet_tpu.symbol._internal")
+sys.modules["incubator_mxnet_tpu.symbol._internal"] = _internal
+
+
+def _make_function(op, public_name):
+    def fn(*args, **kwargs):
+        data = []
+        for a in args:
+            if isinstance(a, Symbol):
+                data.append(a)
+            elif isinstance(a, (list, tuple)) and all(
+                    isinstance(x, Symbol) for x in a):
+                data.extend(a)
+            else:
+                raise TypeError(
+                    f"Operator {op.name}: symbolic inputs must be Symbol, "
+                    f"got {type(a).__name__}")
+        # symbols may also arrive as kwargs (sym op(data=x, weight=w))
+        sym_kwargs = {k: v for k, v in kwargs.items() if isinstance(v, Symbol)}
+        for k in sym_kwargs:
+            kwargs.pop(k)
+        if sym_kwargs and not data:
+            order = ["data", "lhs", "rhs", "weight", "bias", "gamma", "beta",
+                     "moving_mean", "moving_var", "label", "indices", "grid",
+                     "parameters", "state", "state_cell"]
+            for k in order:
+                if k in sym_kwargs:
+                    data.append(sym_kwargs.pop(k))
+            data.extend(sym_kwargs.values())
+        elif sym_kwargs:
+            data.extend(sym_kwargs.values())
+        return _sym_apply(op.name, data, kwargs)
+
+    fn.__name__ = public_name
+    fn.__doc__ = op.doc or f"TPU-native symbolic operator `{op.name}`."
+    return fn
+
+
+def populate(target_module):
+    seen = set()
+    for name in _reg.list_ops():
+        op = _reg.get(name)
+        seen.add(id(op))
+        f = _make_function(op, name)
+        setattr(_internal, name, f)
+        if not name.startswith("_") and not hasattr(target_module, name):
+            setattr(target_module, name, f)
+    target_module._internal = _internal

@@ -1,0 +1,141 @@
+"""Symbol + Executor tests (reference tests/python/unittest/test_symbol.py,
+test_executor.py)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, sym
+
+
+def _mlp_symbol():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=16)
+    act1 = sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = sym.FullyConnected(act1, name="fc2", num_hidden=4)
+    return sym.SoftmaxOutput(fc2, sym.Variable("softmax_label"), name="softmax")
+
+
+def test_compose_and_listing():
+    net = _mlp_symbol()
+    args = net.list_arguments()
+    assert args == ["data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+                    "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+    assert net.list_auxiliary_states() == []
+
+
+def test_infer_shape():
+    net = _mlp_symbol()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(8, 10),
+                                                         softmax_label=(8,))
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (16, 10)
+    assert d["fc1_bias"] == (16,)
+    assert d["fc2_weight"] == (4, 16)
+    assert out_shapes == [(8, 4)]
+
+
+def test_symbol_json_roundtrip():
+    net = _mlp_symbol()
+    js = net.tojson()
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    # same inference results
+    s1 = net.infer_shape(data=(2, 6), softmax_label=(2,))[0]
+    s2 = net2.infer_shape(data=(2, 6), softmax_label=(2,))[0]
+    assert s1 == s2
+
+
+def test_simple_bind_forward_backward():
+    np.random.seed(0)
+    net = _mlp_symbol()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(8, 10), softmax_label=(8,))
+    # init params
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr._data = arr._data + np.random.uniform(
+                -0.1, 0.1, arr.shape).astype("f4")
+    x = np.random.rand(8, 10).astype("f4")
+    y = np.random.randint(0, 4, 8).astype("f4")
+    outs = ex.forward(is_train=True, data=x, softmax_label=y)
+    o = outs[0].asnumpy()
+    assert o.shape == (8, 4)
+    np.testing.assert_allclose(o.sum(axis=1), 1.0, rtol=1e-5)
+    ex.backward()
+    gw = ex.grad_dict["fc1_weight"].asnumpy()
+    assert np.abs(gw).sum() > 0
+
+
+def test_executor_trains_xor():
+    """End-to-end: symbolic MLP learns XOR via executor forward/backward."""
+    np.random.seed(0)
+    X = np.array([[0, 0], [0, 1], [1, 0], [1, 1]], dtype="f4")
+    Y = np.array([0, 1, 1, 0], dtype="f4")
+    data = sym.Variable("data")
+    label = sym.Variable("label")
+    h = sym.Activation(sym.FullyConnected(data, num_hidden=8, name="fc1"),
+                       act_type="tanh")
+    out = sym.FullyConnected(h, num_hidden=2, name="fc2")
+    net = sym.SoftmaxOutput(out, label, name="sm")
+    ex = net.simple_bind(ctx=mx.cpu(), data=(4, 2), label=(4,))
+    rng = np.random.RandomState(5)
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "label"):
+            arr._data = (rng.uniform(-0.5, 0.5, arr.shape)).astype("f4") + arr._data * 0
+    ex.arg_dict["data"]._data = ex.arg_dict["data"]._data * 0 + X
+    ex.arg_dict["label"]._data = ex.arg_dict["label"]._data * 0 + Y
+    for i in range(300):
+        ex.forward_backward()
+        for name in ("fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"):
+            w = ex.arg_dict[name]
+            g = ex.grad_dict[name]
+            w._data = w._data - 0.5 * g._data
+    preds = ex.forward(is_train=False)[0].asnumpy().argmax(axis=1)
+    assert (preds == Y).all(), preds
+
+
+def test_batchnorm_symbolic_aux_update():
+    data = sym.Variable("data")
+    bn = sym.BatchNorm(data, name="bn", fix_gamma=False, momentum=0.5)
+    ex = bn.simple_bind(ctx=mx.cpu(), data=(16, 3))
+    assert set(ex.aux_dict) == {"bn_moving_mean", "bn_moving_var"}
+    x = np.random.rand(16, 3).astype("f4") + 2.0
+    ex.aux_dict["bn_moving_var"]._data = ex.aux_dict["bn_moving_var"]._data + 1.0
+    ex.forward(is_train=True, data=x)
+    mm = ex.aux_dict["bn_moving_mean"].asnumpy()
+    np.testing.assert_allclose(mm, 0.5 * x.mean(axis=0), rtol=1e-4)
+
+
+def test_group_and_internals():
+    a = sym.Variable("a")
+    b = a * 2
+    c = b + 1
+    g = sym.Group([b, c])
+    assert len(g.list_outputs()) == 2
+    internals = c.get_internals()
+    assert len(internals.list_outputs()) >= 3
+    ex = g.bind(mx.cpu(), {"a": nd.array([1.0, 2.0])})
+    outs = ex.forward()
+    np.testing.assert_allclose(outs[0].asnumpy(), [2, 4])
+    np.testing.assert_allclose(outs[1].asnumpy(), [3, 5])
+
+
+def test_grad_req_add_and_null():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a * b
+    ex = c.bind(mx.cpu(), {"a": nd.array([2.0]), "b": nd.array([3.0])},
+                args_grad={"a": nd.zeros((1,)), "b": nd.zeros((1,))},
+                grad_req={"a": "add", "b": "null"})
+    ex.forward(is_train=True)
+    ex.backward(nd.array([1.0]))
+    ex.forward(is_train=True)
+    ex.backward(nd.array([1.0]))
+    np.testing.assert_allclose(ex.grad_dict["a"].asnumpy(), [6.0])
+
+
+def test_scalar_ops_on_symbols():
+    a = sym.Variable("a")
+    expr = (2 * a + 1) / (a - 0.5)
+    ex = expr.bind(mx.cpu(), {"a": nd.array([1.5])})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), [4.0])
