@@ -105,7 +105,9 @@ class Executor:
                 raise MXNetError(f"Unknown argument {k}")
             tgt = self.arg_dict[k]
             src = v._data if isinstance(v, NDArray) else jnp.asarray(v)
-            tgt._data = src.astype(tgt.dtype) if src.dtype != tgt.dtype else src
+            if src.dtype != tgt.dtype:
+                src = src.astype(tgt.dtype)
+            tgt._data = jax.device_put(src, self._ctx.jax_device)
         from . import random as _random
         key = _random.next_key() if self._n_rng else jax.random.PRNGKey(0)
         self._last_key = key
@@ -163,7 +165,9 @@ class Executor:
         for k, v in kwargs.items():
             tgt = self.arg_dict[k]
             src = v._data if isinstance(v, NDArray) else jnp.asarray(v)
-            tgt._data = src.astype(tgt.dtype) if src.dtype != tgt.dtype else src
+            if src.dtype != tgt.dtype:
+                src = src.astype(tgt.dtype)
+            tgt._data = jax.device_put(src, self._ctx.jax_device)
         from . import random as _random
         key = _random.next_key() if self._n_rng else jax.random.PRNGKey(0)
         self._last_key = key
@@ -195,17 +199,20 @@ class Executor:
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params=False):
         """Reference `executor.py copy_params_from`."""
+        dev = self._ctx.jax_device
         for k, v in arg_params.items():
             if k in self.arg_dict:
                 src = v._data if isinstance(v, NDArray) else jnp.asarray(v)
-                self.arg_dict[k]._data = src.astype(self.arg_dict[k].dtype)
+                self.arg_dict[k]._data = jax.device_put(
+                    src.astype(self.arg_dict[k].dtype), dev)
             elif not allow_extra_params:
                 raise MXNetError(f"Found name {k} not in arguments")
         if aux_params:
             for k, v in aux_params.items():
                 if k in self.aux_dict:
                     src = v._data if isinstance(v, NDArray) else jnp.asarray(v)
-                    self.aux_dict[k]._data = src.astype(self.aux_dict[k].dtype)
+                    self.aux_dict[k]._data = jax.device_put(
+                        src.astype(self.aux_dict[k].dtype), dev)
                 elif not allow_extra_params:
                     raise MXNetError(f"Found name {k} not in aux states")
 

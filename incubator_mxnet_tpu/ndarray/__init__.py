@@ -2,7 +2,8 @@
 from . import ndarray
 from .ndarray import (NDArray, array, zeros, ones, full, empty, arange, eye,
                       linspace, concatenate, moveaxis, waitall,
-                      imperative_invoke, invoke)
+                      imperative_invoke, invoke, maximum, minimum, add,
+                      subtract, multiply, divide, modulo, power)
 from . import register as _register
 import sys as _sys
 

@@ -579,6 +579,59 @@ def waitall():
     _engine.waitall()
 
 
+# -- binary helpers accepting NDArray|scalar on either side (reference
+# `python/mxnet/ndarray/ndarray.py` maximum/minimum/add/... wrappers)
+
+def _scalar_or_tensor(lhs, rhs, tensor_op, lscalar_op, rscalar_op):
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        return _apply_op(tensor_op, [lhs, rhs], {})
+    if isinstance(lhs, NDArray):
+        return _apply_op(lscalar_op, [lhs], {"scalar": float(rhs)})
+    if isinstance(rhs, NDArray):
+        return _apply_op(rscalar_op, [rhs], {"scalar": float(lhs)})
+    raise TypeError("at least one argument must be NDArray")
+
+
+def maximum(lhs, rhs):
+    return _scalar_or_tensor(lhs, rhs, "broadcast_maximum",
+                             "_maximum_scalar", "_maximum_scalar")
+
+
+def minimum(lhs, rhs):
+    return _scalar_or_tensor(lhs, rhs, "broadcast_minimum",
+                             "_minimum_scalar", "_minimum_scalar")
+
+
+def add(lhs, rhs):
+    return _scalar_or_tensor(lhs, rhs, "broadcast_add",
+                             "_plus_scalar", "_plus_scalar")
+
+
+def subtract(lhs, rhs):
+    return _scalar_or_tensor(lhs, rhs, "broadcast_sub",
+                             "_minus_scalar", "_rminus_scalar")
+
+
+def multiply(lhs, rhs):
+    return _scalar_or_tensor(lhs, rhs, "broadcast_mul",
+                             "_mul_scalar", "_mul_scalar")
+
+
+def divide(lhs, rhs):
+    return _scalar_or_tensor(lhs, rhs, "broadcast_div",
+                             "_div_scalar", "_rdiv_scalar")
+
+
+def modulo(lhs, rhs):
+    return _scalar_or_tensor(lhs, rhs, "broadcast_mod",
+                             "_mod_scalar", "_rmod_scalar")
+
+
+def power(lhs, rhs):
+    return _scalar_or_tensor(lhs, rhs, "broadcast_power",
+                             "_power_scalar", "_rpower_scalar")
+
+
 # ---------------------------------------------------------------------------
 # Attach registry-op convenience methods to NDArray (the reference code-gens
 # these from the op registry at import, `python/mxnet/ndarray/register.py`).

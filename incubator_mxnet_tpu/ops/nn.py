@@ -174,12 +174,17 @@ def _pooling(params, x):
     strides = (1, 1) + stride
     full_pads = [(0, 0), (0, 0)] + pads
     ptype = params["pool_type"]
+    # NOTE: init values must be python/np scalars so jax recognizes the
+    # max/add monoids and uses the differentiable reduce_window primitives
     if ptype == "max":
-        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
-        return jax.lax.reduce_window(x, jnp.asarray(init, x.dtype), jax.lax.max,
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            init = np.array(-np.inf, x.dtype)[()]
+        else:
+            init = np.array(np.iinfo(np.dtype(x.dtype)).min, x.dtype)[()]
+        return jax.lax.reduce_window(x, init, jax.lax.max,
                                      window, strides, full_pads)
     if ptype in ("avg", "sum"):
-        s = jax.lax.reduce_window(x, jnp.asarray(0, x.dtype), jax.lax.add,
+        s = jax.lax.reduce_window(x, np.zeros((), x.dtype)[()], jax.lax.add,
                                   window, strides, full_pads)
         if ptype == "sum":
             return s

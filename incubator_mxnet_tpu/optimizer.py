@@ -1,0 +1,640 @@
+"""Optimizers (reference `python/mxnet/optimizer.py`).
+
+Each optimizer's `update` dispatches to the fused update ops
+(`ops/optimizer_ops.py` — the reference's `src/operator/optimizer_op.cc`
+kernels, here XLA-compiled with dynamic lr/wd scalars), or composes nd ops
+for the long-tail optimizers.  `create_state_multi_precision` keeps fp32
+master weights for low-precision params (reference `optimizer.py:201`) — the
+TPU-relevant case is bf16 weights.
+"""
+from __future__ import annotations
+
+import math
+import pickle
+
+import numpy
+
+from .base import MXNetError
+from .ndarray import ndarray as _nd
+from .ndarray.ndarray import NDArray
+from . import ndarray as nd
+
+__all__ = ["Optimizer", "SGD", "Signum", "FTML", "DCASGD", "NAG", "SGLD",
+           "Adam", "AdaGrad", "AdaDelta", "RMSProp", "Ftrl", "Adamax",
+           "Nadam", "LBSGD", "Test", "Updater", "get_updater", "create",
+           "register"]
+
+
+class Optimizer:
+    """Base optimizer (reference `optimizer.py:Optimizer`)."""
+
+    opt_registry = {}
+
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise ValueError(f"Cannot find optimizer {name}")
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        if param_idx2name is None:
+            param_idx2name = {}
+        self.idx2name = param_idx2name.copy()
+        self.sym_info = ()
+        if sym is not None:
+            self.sym_info = (sym.attr_dict(), sym.list_arguments())
+        self.param_dict = param_dict if param_dict else {}
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        """fp32 master copy for low-precision weights (reference :201)."""
+        weight_master_copy = None
+        if self.multi_precision and weight.dtype in (numpy.float16,):
+            weight_master_copy = weight.astype("float32")
+            return (weight_master_copy,) + (self.create_state(index,
+                                                              weight_master_copy),)
+        if weight.dtype.name == "bfloat16" and self.multi_precision:
+            weight_master_copy = weight.astype("float32")
+            return (weight_master_copy,) + (self.create_state(index,
+                                                              weight_master_copy),)
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and isinstance(state, tuple) \
+                and isinstance(state[0], NDArray) \
+                and state[0].dtype == numpy.float32 \
+                and weight.dtype != numpy.float32:
+            w32, base_state = state[0], state[1]
+            g32 = grad.astype("float32")
+            self.update(index, w32, g32, base_state)
+            w32.copyto(weight)
+        else:
+            self.update(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning("LRScheduler of the optimizer has already been "
+                              "defined.")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        d.pop("param_dict", None)
+        return d
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self.param_dict = {}
+
+
+register = Optimizer.register
+
+
+def _clip(og):
+    return og if og is not None and og > 0 else -1.0
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and multi-precision (reference `optimizer.py:445`)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype.name in ("float16", "bfloat16"):
+            w32 = weight.astype("float32")
+            mom = nd.zeros(weight.shape, ctx=weight.context, dtype="float32") \
+                if self.momentum != 0.0 else None
+            return (mom, w32)
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                  clip_gradient=_clip(self.clip_gradient))
+        if state is not None:
+            nd.sgd_mom_update(weight, grad, state, momentum=self.momentum,
+                              out=weight, **kw)
+        else:
+            nd.sgd_update(weight, grad, out=weight, **kw)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if isinstance(state, tuple) and len(state) == 2 and \
+                isinstance(state[1], NDArray) and state[1].dtype == numpy.float32 \
+                and weight.dtype != numpy.float32:
+            self._update_count(index)
+            lr = self._get_lr(index)
+            wd = self._get_wd(index)
+            mom, w32 = state
+            kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                      clip_gradient=_clip(self.clip_gradient))
+            if mom is not None:
+                nd.mp_sgd_mom_update(weight, grad, mom, w32,
+                                     momentum=self.momentum, out=weight, **kw)
+            else:
+                nd.mp_sgd_update(weight, grad, w32, out=weight, **kw)
+        else:
+            self.update(index, weight, grad, state)
+
+
+@register
+class Signum(Optimizer):
+    """Reference `optimizer.py:550 Signum` (signSGD + momentum)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd.zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                  clip_gradient=_clip(self.clip_gradient))
+        if state is not None:
+            nd.signum_update(weight, grad, state, momentum=self.momentum,
+                             wd_lh=self.wd_lh, out=weight, **kw)
+        else:
+            nd.signsgd_update(weight, grad, out=weight, **kw)
+
+
+@register
+class FTML(Optimizer):
+    """Reference `optimizer.py:616 FTML`."""
+
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        d, v, z = state
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        v_new = self.beta2 * v + (1 - self.beta2) * g * g
+        d_new = (1 - pow(self.beta1, t)) / lr * (
+            (v_new / (1 - pow(self.beta2, t))).sqrt() + self.epsilon)
+        sigma = d_new - self.beta1 * d
+        z_new = self.beta1 * z + (1 - self.beta1) * g - sigma * weight
+        new_w = -z_new / d_new
+        d._set_data(d_new._data)
+        v._set_data(v_new._data)
+        z._set_data(z_new._data)
+        weight._set_data(new_w._data.astype(weight.dtype))
+
+
+@register
+class DCASGD(Optimizer):
+    """Reference `optimizer.py DCASGD` (delay-compensated async SGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (nd.zeros(weight.shape, weight.context, dtype=weight.dtype),
+                weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        mom, previous_weight = state
+        d = g + wd * weight + self.lamda * g * g * (weight - previous_weight)
+        if mom is not None:
+            new_mom = self.momentum * mom - lr * d
+            mom._set_data(new_mom._data)
+            delta = new_mom
+        else:
+            delta = -lr * d
+        weight._set_data((previous_weight * 0 + weight + delta)._data)
+        previous_weight._set_data(weight._data)
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (reference `optimizer.py NAG`)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd.zeros(weight.shape, weight.context, dtype=weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        if state is not None:
+            mom = state
+            new_mom = self.momentum * mom + g + wd * weight
+            new_w = weight - lr * (g + self.momentum * new_mom + wd * weight)
+            mom._set_data(new_mom._data)
+            weight._set_data(new_w._data.astype(weight.dtype))
+        else:
+            weight._set_data((weight - lr * (g + wd * weight))._data)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference `optimizer.py SGLD`)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        noise = nd.random.normal(0, math.sqrt(lr), shape=weight.shape,
+                                 dtype="float32", ctx=weight.context)
+        weight._set_data(
+            (weight - lr / 2 * (g + wd * weight) + noise)._data.astype(weight.dtype))
+
+
+@register
+class Adam(Optimizer):
+    """Reference `optimizer.py Adam` — fused `adam_update` with bias-corrected lr."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        nd.adam_update(weight, grad, mean, var, lr=lr, wd=wd,
+                       beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
+                       rescale_grad=self.rescale_grad,
+                       clip_gradient=_clip(self.clip_gradient), out=weight)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        g = g + wd * weight
+        hist = state
+        new_hist = hist + g * g
+        hist._set_data(new_hist._data)
+        weight._set_data(
+            (weight - lr * g / ((new_hist + self.float_stable_eps).sqrt()))._data
+            .astype(weight.dtype))
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, weight.context),
+                nd.zeros(weight.shape, weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        acc_g, acc_delta = state
+        new_acc_g = self.rho * acc_g + (1 - self.rho) * g * g
+        delta = ((acc_delta + self.epsilon).sqrt() /
+                 (new_acc_g + self.epsilon).sqrt()) * g
+        new_acc_delta = self.rho * acc_delta + (1 - self.rho) * delta * delta
+        acc_g._set_data(new_acc_g._data)
+        acc_delta._set_data(new_acc_delta._data)
+        weight._set_data((weight - wd * weight - delta)._data.astype(weight.dtype))
+
+
+@register
+class RMSProp(Optimizer):
+    """Reference `optimizer.py RMSProp` (centered=True uses rmspropalex)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (nd.zeros(weight.shape, weight.context),
+                    nd.zeros(weight.shape, weight.context),
+                    nd.zeros(weight.shape, weight.context))
+        return (nd.zeros(weight.shape, weight.context),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                  clip_gradient=_clip(self.clip_gradient),
+                  gamma1=self.gamma1, epsilon=self.epsilon)
+        if not self.centered:
+            (n,) = state
+            nd.rmsprop_update(weight, grad, n, out=weight, **kw)
+        else:
+            n, g, delta = state
+            nd.rmspropalex_update(weight, grad, n, g, delta,
+                                  gamma2=self.gamma2, out=weight, **kw)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, weight.context),
+                nd.zeros(weight.shape, weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        z, n = state
+        nd.ftrl_update(weight, grad, z, n, lr=lr, wd=wd, lamda1=self.lamda1,
+                       beta=self.beta, rescale_grad=self.rescale_grad,
+                       clip_gradient=_clip(self.clip_gradient), out=weight)
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        lr /= (1.0 - self.beta1 ** t)
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        m_t, u_t = state
+        new_m = self.beta1 * m_t + (1.0 - self.beta1) * g
+        new_u = nd.maximum(self.beta2 * u_t, nd.abs(g))
+        m_t._set_data(new_m._data)
+        u_t._set_data(new_u._data)
+        weight._set_data((weight - lr * new_m / new_u)._data.astype(weight.dtype))
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, weight.context, dtype=weight.dtype),
+                nd.zeros(weight.shape, weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        t = self._index_update_count[index]
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient:
+            g = nd.clip(g, a_min=-self.clip_gradient, a_max=self.clip_gradient)
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 **
+                                     ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m_t, v_t = state
+        new_m = self.beta1 * m_t + (1.0 - self.beta1) * g
+        new_v = self.beta2 * v_t + (1.0 - self.beta2) * g * g
+        grad_prime = g / (1.0 - self.m_schedule)
+        m_t_prime = new_m / (1.0 - m_schedule_next)
+        v_t_prime = new_v / (1.0 - self.beta2 ** t)
+        m_t_bar = (1.0 - momentum_t) * grad_prime + momentum_t_1 * m_t_prime
+        m_t._set_data(new_m._data)
+        v_t._set_data(new_v._data)
+        weight._set_data(
+            (weight - lr * m_t_bar / (v_t_prime.sqrt() + self.epsilon))._data
+            .astype(weight.dtype))
+
+
+@register
+class LBSGD(SGD):
+    """Large-batch SGD with LARS-style scaling (reference `optimizer.py LBSGD`);
+    warmup handled by the lr scheduler here."""
+
+    def __init__(self, warmup_strategy="linear", warmup_epochs=5,
+                 batch_scale=1, updates_per_epoch=32, begin_epoch=0,
+                 num_epochs=60, **kwargs):
+        super().__init__(**kwargs)
+
+
+@register
+class Test(Optimizer):
+    """Reference test optimizer."""
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight._set_data((weight + grad * self.rescale_grad)._data)
+        state._set_data(weight._data)
+
+
+create = Optimizer.create_optimizer
+
+
+class Updater:
+    """KVStore updater closure (reference `optimizer.py:Updater`)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(
+                index, weight)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def set_states(self, states):
+        states = pickle.loads(states) if isinstance(states, bytes) else states
+        if isinstance(states, tuple) and len(states) == 2:
+            self.states, self.optimizer = states
+        else:
+            self.states = states
+        self.states_synced = dict.fromkeys(self.states.keys(), False)
+
+    def get_states(self, dump_optimizer=False):
+        return pickle.dumps((self.states, self.optimizer) if dump_optimizer
+                            else self.states)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

@@ -1,0 +1,52 @@
+"""Named-axis collectives — the XLA verbs replacing NCCL
+(reference `src/kvstore/kvstore_nccl.h:285-402` ncclReduce/ncclBcast and
+`comm.h` reduce/broadcast).  These are thin wrappers so framework code reads
+like the reference's comm layer while lowering to ICI collectives."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def all_reduce(x, axis_name, op="sum"):
+    """ncclAllReduce equivalent."""
+    if op == "sum":
+        return jax.lax.psum(x, axis_name)
+    if op == "mean":
+        return jax.lax.pmean(x, axis_name)
+    if op == "max":
+        return jax.lax.pmax(x, axis_name)
+    if op == "min":
+        return jax.lax.pmin(x, axis_name)
+    raise ValueError(f"unknown op {op}")
+
+
+def all_gather(x, axis_name, axis=0, tiled=True):
+    """ncclAllGather equivalent."""
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, scatter_axis=0):
+    """ncclReduceScatter equivalent (ZeRO-style sharded grads)."""
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=scatter_axis,
+                                tiled=True)
+
+
+def ppermute(x, axis_name, perm):
+    """Ring/neighbor exchange (the ring-reduce building block)."""
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def broadcast(x, axis_name, src=0):
+    """ncclBcast equivalent: everyone takes src's value."""
+    idx = jax.lax.axis_index(axis_name)
+    masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+    return jax.lax.psum(masked, axis_name)
+
+
+def axis_index(axis_name):
+    return jax.lax.axis_index(axis_name)
+
+
+def axis_size(axis_name):
+    return jax.lax.psum(1, axis_name)

@@ -1,0 +1,71 @@
+"""Device-mesh construction.
+
+Replaces the reference's device topology machinery (`src/kvstore/
+gpu_topology.h:491-782` PCIe/NVLink spanning trees): on TPU the physical
+topology is the ICI torus and XLA's collective scheduler owns routing, so the
+framework only chooses the *logical* mesh shape (dp/tp/pp/sp axes).
+Multi-host: `jax.distributed.initialize` + `jax.devices()` spanning all hosts
+gives a global mesh; DCN-vs-ICI placement follows axis order (outermost axes
+land on DCN, reference scaling-book recipe).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+
+DEFAULT_AXES = ("dp", "tp")
+
+
+def make_mesh(shape=None, axis_names=None, devices=None):
+    """Create a `jax.sharding.Mesh`.
+
+    shape: dict axis->size (e.g. {'dp': 4, 'tp': 2}) or tuple of sizes.
+    Unspecified → all devices on one 'dp' axis.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if shape is None:
+        shape = {"dp": n}
+    if isinstance(shape, dict):
+        axis_names = tuple(shape.keys())
+        sizes = tuple(shape.values())
+    else:
+        sizes = tuple(shape)
+        axis_names = tuple(axis_names or DEFAULT_AXES[:len(sizes)])
+    total = int(np.prod(sizes))
+    if total != n:
+        raise MXNetError(f"mesh shape {sizes} needs {total} devices, "
+                         f"have {n}")
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, axis_names)
+
+
+def local_mesh(n=None, axis_names=("dp",)):
+    """Mesh over the first n local devices (testing convenience)."""
+    import jax
+    devs = jax.local_devices()
+    n = n or len(devs)
+    return make_mesh({axis_names[0]: n}, devices=devs[:n])
+
+
+def mesh_axes(mesh):
+    return tuple(mesh.axis_names)
+
+
+def initialize_distributed(coordinator_address=None, num_processes=None,
+                           process_id=None):
+    """Multi-host bring-up (replaces ps-lite scheduler bootstrapping,
+    reference `tools/launch.py` + DMLC_PS_ROOT_URI env wiring)."""
+    import jax
+    kwargs = {}
+    if coordinator_address:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)

@@ -1,0 +1,61 @@
+"""Pipeline parallelism over the `pp` mesh axis.
+
+Absent in the reference (closest: manual model-parallel LSTM layer placement,
+`docs/faq/model_parallel_lstm.md`); provided here as a first-class GPipe-style
+microbatch schedule: stages are one SPMD program where each pp rank applies
+its stage function and passes activations to the next rank via ppermute,
+with a steady-state loop over microbatches.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def pipeline_step(stage_fn, n_microbatches, axis_name="pp"):
+    """Build a pipelined forward over `axis_name`.
+
+    stage_fn(params, x) -> y applies THIS rank's stage.  Input microbatches
+    are fed on rank 0; outputs emerge on the last rank (gathered at the end).
+    Returns fwd(params, microbatches) where microbatches has leading dim
+    n_microbatches on every rank (only rank 0's values are used).
+    """
+    def fwd(params, microbatches):
+        n_stages = jax.lax.psum(1, axis_name)
+        my_idx = jax.lax.axis_index(axis_name)
+        total_ticks = n_microbatches + n_stages - 1
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        mb_shape = microbatches.shape[1:]
+        buf = jnp.zeros(mb_shape, microbatches.dtype)
+        outputs = jnp.zeros((n_microbatches,) + mb_shape, microbatches.dtype)
+
+        def tick(carry, t):
+            buf, outputs = carry
+            # rank 0 injects microbatch t (if in range); others use incoming
+            inject = jnp.where(t < n_microbatches,
+                               microbatches[jnp.minimum(t, n_microbatches - 1)],
+                               jnp.zeros(mb_shape, microbatches.dtype))
+            x = jnp.where(my_idx == 0, inject, buf)
+            y = stage_fn(params, x)
+            # last rank records its result for microbatch t - (n_stages - 1)
+            out_t = t - (n_stages - 1)
+            is_last = my_idx == n_stages - 1
+            valid = jnp.logical_and(out_t >= 0, is_last)
+            outputs = jax.lax.cond(
+                valid,
+                lambda o: o.at[jnp.maximum(out_t, 0)].set(y),
+                lambda o: o,
+                outputs)
+            buf_next = jax.lax.ppermute(y, axis_name, perm)
+            return (buf_next, outputs), None
+
+        (_, outputs), _ = jax.lax.scan(tick, (buf, outputs),
+                                       jnp.arange(total_ticks))
+        # broadcast final outputs from last rank to all (so callers see them)
+        outputs = jax.lax.psum(
+            jnp.where(my_idx == n_stages - 1, outputs,
+                      jnp.zeros_like(outputs)), axis_name)
+        return outputs
+
+    return fwd

@@ -1,0 +1,109 @@
+"""Ring attention: sequence/context parallelism over the `sp` mesh axis.
+
+The reference (2018-era) handles long sequences only by bucketing
+(`BucketingModule`, SURVEY.md §5); this module provides the modern
+first-class answer: each device holds a sequence shard of Q/K/V; K/V shards
+rotate around the ring via `ppermute` while a blockwise online-softmax
+accumulates exact attention — memory O(T/n) per device, ICI-bandwidth-bound.
+(Technique: Liu et al., Ring Attention with Blockwise Transformers, 2023.)
+
+`ring_attention` is written against named axes inside `shard_map`; it works
+on any mesh axis (CPU test mesh included).  A Pallas-fused per-block kernel
+can replace `_block_attn` later without changing the ring protocol.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _block_attn(q, k, v, bias=None):
+    """One (Tq, Tk) attention block returning (out_unnorm, row_max, row_sum).
+
+    q: (B, Tq, H, D), k/v: (B, Tk, H, D)
+    """
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if bias is not None:
+        scores = scores + bias
+    m = jnp.max(scores, axis=-1)                      # (B, H, Tq)
+    p = jnp.exp(scores - m[..., None])
+    l = jnp.sum(p, axis=-1)                           # (B, H, Tq)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)           # (B, Tq, H, D)
+    return o, m, l
+
+
+def blockwise_attention(q, k, v, block_size=None, causal=False):
+    """Single-device blockwise (memory-efficient) attention over KV blocks.
+    Exact softmax via online accumulation (the flash-attention recurrence)."""
+    B, T, H, D = q.shape
+    bs = block_size or T
+    nblocks = (k.shape[1] + bs - 1) // bs
+    neg = jnp.asarray(-1e30, q.dtype)
+
+    m = jnp.full((B, H, T), neg, q.dtype)
+    l = jnp.zeros((B, H, T), q.dtype)
+    o = jnp.zeros_like(q)
+
+    q_pos = jnp.arange(T)
+    for i in range(nblocks):
+        ks = k[:, i * bs:(i + 1) * bs]
+        vs = v[:, i * bs:(i + 1) * bs]
+        bias = None
+        if causal:
+            k_pos = jnp.arange(i * bs, i * bs + ks.shape[1])
+            mask = q_pos[:, None] >= k_pos[None, :]
+            bias = jnp.where(mask, 0.0, neg)[None, None]
+        bo, bm, bl = _block_attn(q, ks, vs, bias)
+        m_new = jnp.maximum(m, bm)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(bm - m_new)
+        l = l * alpha + bl * beta
+        o = o * alpha.transpose(0, 2, 1)[..., None] + \
+            bo * beta.transpose(0, 2, 1)[..., None]
+        m = m_new
+    return o / l.transpose(0, 2, 1)[..., None]
+
+
+def ring_attention(q, k, v, axis_name, causal=False):
+    """Exact attention over sequence shards on `axis_name`.
+
+    Call inside shard_map with q/k/v sharded on the sequence dim:
+    q,k,v local shapes (B, T_local, H, D).  K/V rotate n-1 times around the
+    ring; each step contributes one block to the online softmax.
+    """
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    B, Tl, H, D = q.shape
+    neg = jnp.asarray(-1e30, q.dtype)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, i):
+        m, l, o, k_cur, v_cur = carry
+        # which device's shard are we currently holding? source = my_idx - i
+        src = (my_idx - i) % n
+        bias = None
+        if causal:
+            q_pos = my_idx * Tl + jnp.arange(Tl)
+            k_pos = src * Tl + jnp.arange(Tl)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            bias = jnp.where(mask, 0.0, neg)[None, None]
+        bo, bm, bl = _block_attn(q, k_cur, v_cur, bias)
+        m_new = jnp.maximum(m, bm)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(bm - m_new)
+        l2 = l * alpha + bl * beta
+        o2 = o * alpha.transpose(0, 2, 1)[..., None] + \
+            bo * beta.transpose(0, 2, 1)[..., None]
+        # rotate KV to the next device; overlapped with next block's compute
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (m_new, l2, o2, k_next, v_next), None
+
+    m0 = jnp.full((B, H, Tl), neg, q.dtype)
+    l0 = jnp.zeros((B, H, Tl), q.dtype)
+    o0 = jnp.zeros_like(q)
+    (m, l, o, _, _), _ = jax.lax.scan(step, (m0, l0, o0, k, v),
+                                      jnp.arange(n))
+    return o / l.transpose(0, 2, 1)[..., None]

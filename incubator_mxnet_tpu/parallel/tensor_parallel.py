@@ -1,0 +1,59 @@
+"""Tensor-parallel sharding rules.
+
+The declarative successor to the reference's manual model parallelism
+(`ctx_group` attrs + `group2ctx` bind arg, `symbol.py:1336-1439`, PlaceDevice
+pass): parameters get `PartitionSpec`s by name pattern; XLA/GSPMD inserts the
+all-gathers/reduce-scatters that the reference's `_CrossDeviceCopy` op did by
+hand.  Megatron-style rules: column-parallel then row-parallel pairs."""
+from __future__ import annotations
+
+import re
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+class ShardingRules:
+    """Ordered (regex, PartitionSpec) rules applied to parameter names."""
+
+    def __init__(self, rules=(), default=P()):
+        self.rules = [(re.compile(pat), spec) for pat, spec in rules]
+        self.default = default
+
+    def spec_for(self, name):
+        for prog, spec in self.rules:
+            if prog.search(name):
+                return spec
+        return self.default
+
+    @staticmethod
+    def megatron(tp_axis="tp"):
+        """Column-parallel qkv/ffn-in, row-parallel proj/ffn-out."""
+        return ShardingRules([
+            (r"(qkv|query|key|value|gate|up|fc1|ffn_in).*weight", P(tp_axis, None)),
+            (r"(out_proj|down|fc2|ffn_out|proj).*weight", P(None, tp_axis)),
+            (r"embed.*weight", P(tp_axis, None)),
+            (r"bias", P()),
+        ])
+
+
+def shard_params(params, mesh, rules, name_fn=None):
+    """Place a dict/pytree of params per the rules.
+
+    params: dict name -> array (or pytree with string paths via name_fn).
+    """
+    out = {}
+    for name, arr in params.items():
+        spec = rules.spec_for(name if name_fn is None else name_fn(name))
+        # drop axes that don't divide
+        clean = []
+        for dim, ax in zip(arr.shape, tuple(spec) + (None,) * (arr.ndim - len(spec))):
+            if ax is None:
+                clean.append(None)
+            else:
+                size = mesh.shape[ax] if isinstance(ax, str) else 1
+                clean.append(ax if dim % size == 0 else None)
+        sharding = NamedSharding(mesh, P(*clean))
+        data = arr._data if hasattr(arr, "_data") else arr
+        out[name] = jax.device_put(data, sharding)
+    return out

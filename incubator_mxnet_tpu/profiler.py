@@ -1,0 +1,186 @@
+"""Profiler (reference `python/mxnet/profiler.py`, C++ `src/profiler/`).
+
+TPU-native: bridges to the JAX/XLA profiler (trace-viewer output readable in
+TensorBoard/Perfetto — the chrome://tracing equivalent of the reference's
+`DumpProfile`, `src/profiler/profiler.h:270-304`).  The python API surface
+(set_config/set_state/dump, Task/Frame/Counter/Marker custom objects) matches
+the reference; custom objects are recorded into the same trace via
+`jax.profiler.TraceAnnotation`/host events.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import threading
+
+__all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
+           "resume", "Task", "Frame", "Counter", "Marker"]
+
+_config = {"profile_all": False, "profile_symbolic": False,
+           "profile_imperative": False, "profile_memory": False,
+           "profile_api": False, "filename": "profile.json",
+           "aggregate_stats": False}
+_state = {"running": False, "dir": None}
+_custom_events = []
+_lock = threading.Lock()
+
+
+def set_config(**kwargs):
+    """Reference `profiler.py:33 set_config`."""
+    _config.update(kwargs)
+
+
+def set_state(state_="stop", profile_process="worker"):
+    """'run' starts a JAX profiler trace; 'stop' ends and writes it
+    (reference `profiler.py set_state` → `MXSetProcessProfilerState`)."""
+    import jax
+    if state_ == "run" and not _state["running"]:
+        trace_dir = os.path.splitext(_config["filename"])[0] + "_trace"
+        os.makedirs(trace_dir, exist_ok=True)
+        try:
+            jax.profiler.start_trace(trace_dir)
+            _state.update(running=True, dir=trace_dir)
+        except Exception:
+            _state.update(running=True, dir=None)  # already tracing etc.
+    elif state_ == "stop" and _state["running"]:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        _state.update(running=False)
+
+
+def state():
+    return "run" if _state["running"] else "stop"
+
+
+def pause(profile_process="worker"):
+    set_state("stop")
+
+
+def resume(profile_process="worker"):
+    set_state("run")
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write custom-event chrome trace alongside the XLA trace
+    (reference `MXDumpProfile`)."""
+    events = []
+    with _lock:
+        for ev in _custom_events:
+            events.append(ev)
+    with open(_config["filename"], "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+
+
+def dumps(reset=False):
+    """Aggregate stats string (reference `MXAggregateProfileStatsPrint`)."""
+    lines = ["Profile Statistics:"]
+    with _lock:
+        by_name = {}
+        for ev in _custom_events:
+            if ev.get("ph") == "X":
+                by_name.setdefault(ev["name"], []).append(ev["dur"])
+        for name, durs in sorted(by_name.items()):
+            lines.append(f"  {name}: count={len(durs)} "
+                         f"total_us={sum(durs):.1f} avg_us={sum(durs)/len(durs):.1f}")
+        if reset:
+            _custom_events.clear()
+    return "\n".join(lines)
+
+
+def _emit(event):
+    with _lock:
+        _custom_events.append(event)
+
+
+class _Named:
+    def __init__(self, name):
+        self.name = name
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+
+
+class Task(_Named):
+    """Reference `profiler.py:257 Task`."""
+
+    def __init__(self, name, domain=None):
+        super().__init__(name)
+        self._t0 = None
+        self._ann = None
+
+    def start(self):
+        import jax
+        self._t0 = time.perf_counter_ns()
+        try:
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+        except Exception:
+            self._ann = None
+
+    def stop(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+        if self._t0 is not None:
+            dur = (time.perf_counter_ns() - self._t0) / 1000.0
+            _emit({"name": self.name, "ph": "X", "cat": "task",
+                   "ts": self._t0 / 1000.0, "dur": dur, "pid": 0, "tid": 0})
+
+
+class Frame(Task):
+    """Reference `profiler.py Frame`."""
+
+
+class Counter:
+    """Reference `profiler.py Counter`."""
+
+    def __init__(self, name, domain=None, value=None):
+        self.name = name
+        self.value = 0
+        if value is not None:
+            self.set_value(value)
+
+    def set_value(self, value):
+        self.value = value
+        _emit({"name": self.name, "ph": "C", "ts": time.perf_counter_ns() / 1e3,
+               "pid": 0, "args": {self.name: value}})
+
+    def increment(self, delta=1):
+        self.set_value(self.value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self.value - delta)
+
+    def __iadd__(self, v):
+        self.increment(v)
+        return self
+
+    def __isub__(self, v):
+        self.decrement(v)
+        return self
+
+
+class Marker:
+    """Reference `profiler.py Marker` (instant event)."""
+
+    def __init__(self, name, domain=None):
+        self.name = name
+
+    def mark(self, scope="process"):
+        _emit({"name": self.name, "ph": "i", "ts": time.perf_counter_ns() / 1e3,
+               "pid": 0, "tid": 0, "s": scope[0]})
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    """Deprecated reference API kept for compatibility."""
+    set_config(filename=filename)
+
+
+def profiler_set_state(state_="stop"):
+    set_state(state_)

@@ -1,0 +1,219 @@
+"""RecordIO: packed binary record files (reference `python/mxnet/recordio.py`,
+dmlc-core recordio format).
+
+Byte-compatible with the reference format so existing `.rec` datasets work:
+records are [magic uint32 0xced7230a][lrecord uint32][data][pad to 4B],
+where lrecord encodes cflag (3 bits) | length (29 bits).  `IRHeader`
+(flag, label, id, id2) matches `mx.recordio.IRHeader` for image records.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import numbers
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_MAGIC = 0xced7230a
+_CFLAG_BITS = 29
+
+
+class MXRecordIO:
+    """Sequential reader/writer (reference `recordio.py:MXRecordIO`)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.handle = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.handle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.is_open = True
+
+    def close(self):
+        if self.is_open:
+            self.handle.close()
+            self.is_open = False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["handle"] = None
+        if d["is_open"]:
+            d["is_open"] = False
+            d["_reopen"] = True
+        return d
+
+    def __setstate__(self, d):
+        reopen = d.pop("_reopen", False)
+        self.__dict__.update(d)
+        if reopen:
+            self.open()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def write(self, buf):
+        assert self.writable
+        length = len(buf)
+        lrecord = (0 << _CFLAG_BITS) | length
+        self.handle.write(struct.pack("<II", _MAGIC, lrecord))
+        self.handle.write(buf)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.handle.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        header = self.handle.read(8)
+        if len(header) < 8:
+            return None
+        magic, lrecord = struct.unpack("<II", header)
+        if magic != _MAGIC:
+            raise MXNetError("Invalid RecordIO magic")
+        length = lrecord & ((1 << _CFLAG_BITS) - 1)
+        buf = self.handle.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.handle.read(pad)
+        return buf
+
+    def tell(self):
+        return self.handle.tell()
+
+    def seek(self, pos):
+        assert not self.writable
+        self.handle.seek(pos)
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access reader/writer with .idx file
+    (reference `recordio.py:MXIndexedRecordIO`)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if self.flag == "w":
+            self.fidx = open(self.idx_path, "w")
+        else:
+            self.fidx = open(self.idx_path, "r")
+            for line in self.fidx:
+                parts = line.strip().split("\t")
+                key = self.key_type(parts[0])
+                self.idx[key] = int(parts[1])
+                self.keys.append(key)
+
+    def close(self):
+        if self.is_open:
+            super().close()
+            self.fidx.close()
+
+    def read_idx(self, idx):
+        self.seek(self.idx[idx])
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write(f"{key}\t{pos}\n")
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+class IRHeader:
+    """Image record header (reference `recordio.py:IRHeader` namedtuple)."""
+
+    __slots__ = ("flag", "label", "id", "id2")
+
+    def __init__(self, flag, label, id, id2):  # noqa: A002
+        self.flag = flag
+        self.label = label
+        self.id = id
+        self.id2 = id2
+
+    def __iter__(self):
+        yield from (self.flag, self.label, self.id, self.id2)
+
+    def __eq__(self, other):
+        return tuple(self) == tuple(other)
+
+
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack header + bytes (reference `recordio.py pack`)."""
+    flag, label, id_, id2 = header
+    if isinstance(label, numbers.Number):
+        hdr = struct.pack(_IR_FORMAT, 0, float(label), id_, id2)
+        return hdr + s
+    label = np.asarray(label, dtype=np.float32)
+    hdr = struct.pack(_IR_FORMAT, label.size, 0.0, id_, id2)
+    return hdr + label.tobytes() + s
+
+
+def unpack(s):
+    """Unpack to (IRHeader, bytes) (reference `recordio.py unpack`)."""
+    flag, label, id_, id2 = struct.unpack(_IR_FORMAT, s[:_IR_SIZE])
+    payload = s[_IR_SIZE:]
+    if flag > 0:
+        label = np.frombuffer(payload[:flag * 4], dtype=np.float32)
+        payload = payload[flag * 4:]
+    return IRHeader(flag, label, id_, id2), payload
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Encode image + pack (reference `recordio.py pack_img`; PIL instead of
+    OpenCV — documented divergence, same bytes-on-disk container)."""
+    import io as _io
+    from PIL import Image
+    if isinstance(img, np.ndarray):
+        img = Image.fromarray(img.astype(np.uint8))
+    buf = _io.BytesIO()
+    fmt = "JPEG" if img_fmt.lower() in (".jpg", ".jpeg") else "PNG"
+    img.save(buf, format=fmt, quality=quality)
+    return pack(header, buf.getvalue())
+
+
+def unpack_img(s, iscolor=-1):
+    """Unpack + decode image to numpy HWC (reference `recordio.py unpack_img`)."""
+    import io as _io
+    from PIL import Image
+    header, payload = unpack(s)
+    img = Image.open(_io.BytesIO(payload))
+    if iscolor == 0:
+        img = img.convert("L")
+    elif iscolor == 1:
+        img = img.convert("RGB")
+    return header, np.asarray(img)

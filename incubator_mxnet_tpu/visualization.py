@@ -1,0 +1,97 @@
+"""Network visualization (reference `python/mxnet/visualization.py`):
+print_summary + plot_network (graphviz-gated)."""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64, .74, 1.)):
+    """Reference `visualization.py print_summary`."""
+    if shape is not None:
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape_partial(**shape)
+        if out_shapes is None:
+            raise ValueError("Input shape is incomplete")
+        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+
+    def print_row(fields, positions_):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[:positions_[i]]
+            line += " " * (positions_[i] - len(line))
+        print(line)
+
+    positions = [int(line_length * p) for p in positions]
+    print("_" * line_length)
+    print_row(["Layer (type)", "Output Shape", "Param #", "Previous Layer"],
+              positions)
+    print("=" * line_length)
+    total_params = 0
+
+    def count_params(node):
+        nonlocal total_params
+        op = node["op"]
+        if op == "null":
+            return 0
+        return 0
+
+    for i, node in enumerate(nodes):
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            continue
+        out_shape = ""
+        key = name + "_output"
+        if shape is not None and key in shape_dict:
+            out_shape = str(shape_dict[key])
+        pre_nodes = [nodes[item[0]]["name"] for item in node["inputs"]
+                     if nodes[item[0]]["op"] != "null"]
+        # parameter count: sum of variable-input sizes
+        params = 0
+        if shape is not None:
+            for item in node["inputs"]:
+                src = nodes[item[0]]
+                if src["op"] == "null" and not (
+                        src["name"].endswith("data") or
+                        src["name"].endswith("label")):
+                    skey = src["name"] + "_output"
+                    # variables appear in internals as their own outputs
+        print_row([f"{name}({op})", out_shape, params,
+                   ",".join(pre_nodes)], positions)
+    print("=" * line_length)
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Reference `visualization.py plot_network` — requires graphviz."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("Draw network requires graphviz library") from None
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    dot = Digraph(name=title)
+    hidden = set()
+    for i, node in enumerate(nodes):
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            if hide_weights and not name.endswith("data"):
+                hidden.add(i)
+                continue
+            dot.node(name=name, label=name, shape="oval")
+        else:
+            dot.node(name=name, label=f"{name}\n{op}", shape="box")
+    for i, node in enumerate(nodes):
+        if node["op"] == "null" or i in hidden:
+            continue
+        for item in node["inputs"]:
+            if item[0] in hidden:
+                continue
+            dot.edge(nodes[item[0]]["name"], node["name"])
+    return dot

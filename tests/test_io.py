@@ -1,0 +1,134 @@
+"""IO tests (reference tests/python/unittest/test_io.py, test_recordio)."""
+import os
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.io import (NDArrayIter, ResizeIter, PrefetchingIter,
+                                    CSVIter, DataBatch, DataDesc)
+from incubator_mxnet_tpu import recordio
+
+
+def test_ndarray_iter():
+    data = np.arange(100).reshape(25, 4).astype("f4")
+    labels = np.arange(25).astype("f4")
+    it = NDArrayIter(data, labels, batch_size=10, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (10, 4)
+    assert batches[2].pad == 5
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:10])
+    np.testing.assert_allclose(batches[0].label[0].asnumpy(), labels[:10])
+
+    it2 = NDArrayIter(data, labels, batch_size=10, last_batch_handle="discard")
+    assert len(list(it2)) == 2
+
+    # dict input and provide_data names
+    it3 = NDArrayIter({"x": data}, {"y": labels}, batch_size=5)
+    assert it3.provide_data[0].name == "x"
+    assert it3.provide_label[0].name == "y"
+
+
+def test_ndarray_iter_shuffle_reset():
+    data = np.arange(20).astype("f4").reshape(20, 1)
+    it = NDArrayIter(data, data[:, 0], batch_size=4, shuffle=True)
+    seen1 = np.concatenate([b.data[0].asnumpy()[:, 0] for b in it])
+    it.reset()
+    seen2 = np.concatenate([b.data[0].asnumpy()[:, 0] for b in it])
+    assert sorted(seen1) == sorted(seen2) == list(range(20))
+
+
+def test_resize_iter():
+    data = np.arange(40).reshape(10, 4).astype("f4")
+    it = ResizeIter(NDArrayIter(data, np.zeros(10), batch_size=5), size=7)
+    assert len(list(it)) == 7
+
+
+def test_prefetching_iter():
+    data = np.arange(80).reshape(20, 4).astype("f4")
+    base = NDArrayIter(data, np.zeros(20), batch_size=5)
+    it = PrefetchingIter(base)
+    batches = list(it)
+    assert len(batches) == 4
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_csv_iter(tmp_path):
+    data_path = str(tmp_path / "data.csv")
+    label_path = str(tmp_path / "label.csv")
+    np.savetxt(data_path, np.arange(24).reshape(8, 3), delimiter=",")
+    np.savetxt(label_path, np.arange(8), delimiter=",")
+    it = CSVIter(data_csv=data_path, data_shape=(3,), label_csv=label_path,
+                 batch_size=4)
+    batches = list(it)
+    assert len(batches) == 2
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(),
+                               np.arange(12).reshape(4, 3))
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "test.rec")
+    writer = recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        writer.write(b"record_%d" % i)
+    writer.close()
+    reader = recordio.MXRecordIO(path, "r")
+    for i in range(5):
+        assert reader.read() == b"record_%d" % i
+    assert reader.read() is None
+    reader.close()
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "test.rec")
+    idx_path = str(tmp_path / "test.idx")
+    writer = recordio.MXIndexedRecordIO(idx_path, path, "w")
+    for i in range(5):
+        writer.write_idx(i, b"record_%d" % i)
+    writer.close()
+    reader = recordio.MXIndexedRecordIO(idx_path, path, "r")
+    assert reader.read_idx(3) == b"record_3"
+    assert reader.read_idx(0) == b"record_0"
+    assert reader.keys == list(range(5))
+    reader.close()
+
+
+def test_pack_unpack():
+    header = recordio.IRHeader(0, 2.0, 7, 0)
+    s = recordio.pack(header, b"imagebytes")
+    h2, payload = recordio.unpack(s)
+    assert payload == b"imagebytes"
+    assert h2.label == 2.0 and h2.id == 7
+    # multi-label
+    header = recordio.IRHeader(0, [1.0, 2.0, 3.0], 7, 0)
+    s = recordio.pack(header, b"x")
+    h2, payload = recordio.unpack(s)
+    np.testing.assert_allclose(h2.label, [1, 2, 3])
+    assert payload == b"x"
+
+
+def test_image_record_iter(tmp_path):
+    """End-to-end: pack images into a .rec, read via ImageRecordIter."""
+    from incubator_mxnet_tpu.io import ImageRecordIter
+    path = str(tmp_path / "imgs.rec")
+    idx_path = str(tmp_path / "imgs.idx")
+    writer = recordio.MXIndexedRecordIO(idx_path, path, "w")
+    rng = np.random.RandomState(0)
+    for i in range(12):
+        img = (rng.rand(24, 24, 3) * 255).astype("uint8")
+        s = recordio.pack_img(recordio.IRHeader(0, float(i % 3), i, 0), img,
+                              img_fmt=".png")
+        writer.write_idx(i, s)
+    writer.close()
+    it = ImageRecordIter(path_imgrec=path, data_shape=(3, 20, 20),
+                         batch_size=4, shuffle=True, rand_crop=True,
+                         preprocess_threads=2)
+    batch = it.next()
+    assert batch.data[0].shape == (4, 3, 20, 20)
+    assert batch.label[0].shape == (4,)
+    n = 1 + len(list(it))
+    assert n == 3
+    it.reset()
+    assert len(list(it)) == 3

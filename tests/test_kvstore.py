@@ -1,0 +1,109 @@
+"""KVStore tests (reference tests/python/unittest/test_kvstore.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+
+
+def _check(kv_type):
+    kv = mx.kv.create(kv_type)
+    kv.init(3, nd.ones(SHAPE))
+    out = nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 1)
+    kv.push(3, nd.ones(SHAPE) * 4)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 4)
+
+
+def test_single_kv_pair():
+    for kv_type in ("local", "device", "tpu"):
+        _check(kv_type)
+
+
+def test_list_kv_pair():
+    kv = mx.kv.create("local")
+    kv.init(KEYS, [nd.ones(SHAPE)] * len(KEYS))
+    kv.push(KEYS, [nd.ones(SHAPE) * 4] * len(KEYS))
+    outs = [nd.zeros(SHAPE) for _ in KEYS]
+    kv.pull(KEYS, out=outs)
+    for o in outs:
+        np.testing.assert_allclose(o.asnumpy(), 4)
+
+
+def test_aggregate_multi_device():
+    """Multi-device push is reduced (reference comm.h Reduce semantics)."""
+    import jax
+    ndev = min(4, len(jax.devices()))
+    kv = mx.kv.create("tpu")
+    kv.init(9, nd.zeros(SHAPE))
+    vals = [nd.ones(SHAPE, ctx=mx.tpu(i)) * (i + 1) for i in range(ndev)]
+    kv.push(9, vals)
+    out = nd.zeros(SHAPE)
+    kv.pull(9, out=out)
+    np.testing.assert_allclose(out.asnumpy(), sum(range(1, ndev + 1)))
+    # pull back to each device
+    outs = [nd.zeros(SHAPE, ctx=mx.tpu(i)) for i in range(ndev)]
+    kv.pull(9, out=outs)
+    for o in outs:
+        np.testing.assert_allclose(o.asnumpy(), sum(range(1, ndev + 1)))
+
+
+def test_updater():
+    kv = mx.kv.create("local")
+    kv.init(3, nd.ones(SHAPE))
+
+    def updater(key, recv, stored):
+        stored += recv * 2
+
+    kv.set_updater(updater)
+    kv.push(3, nd.ones(SHAPE))
+    out = nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 3)
+
+
+def test_set_optimizer_updates_weights():
+    kv = mx.kv.create("local")
+    kv.init("w", nd.ones(SHAPE))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    kv.push("w", nd.ones(SHAPE))  # grad of ones
+    out = nd.zeros(SHAPE)
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 0.9, rtol=1e-6)
+
+
+def test_gradient_compression():
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init(0, nd.zeros((4,)))
+    kv.push(0, nd.array([1.0, -1.0, 0.2, 0.0]))
+    out = nd.zeros((4,))
+    kv.pull(0, out=out)
+    np.testing.assert_allclose(out.asnumpy(), [0.5, -0.5, 0.0, 0.0])
+    # error feedback: residual carries over
+    kv.push(0, nd.array([0.0, 0.0, 0.4, 0.0]))
+    kv.pull(0, out=out)
+    np.testing.assert_allclose(out.asnumpy(), [0.5, -0.5, 0.5, 0.0])
+
+
+def test_type_and_rank():
+    kv = mx.kv.create("local")
+    assert kv.type == "local"
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+    kvd = mx.kv.create("dist_sync")
+    assert "dist" in kvd.type
+
+
+def test_errors():
+    kv = mx.kv.create("local")
+    with pytest.raises(mx.MXNetError):
+        kv.push(42, nd.ones(SHAPE))  # not initialized
+    kv.init(1, nd.ones(SHAPE))
+    with pytest.raises(mx.MXNetError):
+        kv.init(1, nd.ones(SHAPE))  # double init

@@ -1,0 +1,165 @@
+"""Optimizer + metric + initializer + lr_scheduler tests
+(reference tests/python/unittest/test_optimizer.py, test_metric.py)."""
+import math
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def _train_quadratic(opt, steps=60):
+    """Minimize ||w - 3||^2 with the given optimizer; returns final w."""
+    w = nd.array([0.0, 0.0])
+    state = opt.create_state(0, w)
+    for _ in range(steps):
+        grad = 2 * (w - 3)
+        opt.update(0, w, grad, state)
+    return w.asnumpy()
+
+
+def test_optimizers_converge():
+    cases = [
+        mx.optimizer.SGD(learning_rate=0.1),
+        mx.optimizer.SGD(learning_rate=0.05, momentum=0.9),
+        mx.optimizer.Adam(learning_rate=0.3),
+        mx.optimizer.RMSProp(learning_rate=0.3),
+        mx.optimizer.RMSProp(learning_rate=0.3, centered=True),
+        mx.optimizer.AdaGrad(learning_rate=1.5),
+        mx.optimizer.AdaDelta(rho=0.9, epsilon=1e-4),
+        mx.optimizer.Adamax(learning_rate=0.5),
+        mx.optimizer.Nadam(learning_rate=0.3),
+        mx.optimizer.Ftrl(learning_rate=2.0),
+        mx.optimizer.Signum(learning_rate=0.05),
+        mx.optimizer.NAG(learning_rate=0.05, momentum=0.9),
+        mx.optimizer.FTML(learning_rate=0.3),
+    ]
+    for opt in cases:
+        w = _train_quadratic(opt, steps=200)
+        assert np.abs(w - 3).max() < 0.5, (type(opt).__name__, w)
+
+
+def test_sgd_matches_reference_formula():
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.01,
+                           rescale_grad=0.5)
+    w = nd.array([1.0])
+    state = opt.create_state(0, w)
+    g = nd.array([2.0])
+    opt.update(0, w, g, state)
+    # mom = 0.9*0 - 0.1*(0.5*2 + 0.01*1); w += mom
+    exp_mom = -0.1 * (1.0 + 0.01)
+    np.testing.assert_allclose(state.asnumpy(), [exp_mom], rtol=1e-6)
+    np.testing.assert_allclose(w.asnumpy(), [1.0 + exp_mom], rtol=1e-6)
+
+
+def test_optimizer_registry_and_lr():
+    opt = mx.optimizer.create("sgd", learning_rate=0.3)
+    assert isinstance(opt, mx.optimizer.SGD)
+    assert opt._get_lr(0) == 0.3
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    opt2 = mx.optimizer.create("sgd", learning_rate=1.0, lr_scheduler=sched)
+    w = nd.array([0.0])
+    for _ in range(10):
+        opt2.update(0, w, nd.array([0.0]), None)
+    assert opt2._get_lr(0) < 1.0
+
+
+def test_lr_schedulers():
+    s = mx.lr_scheduler.FactorScheduler(step=10, factor=0.1)
+    s.base_lr = 1.0
+    assert s(5) == 1.0
+    assert abs(s(15) - 0.1) < 1e-9
+    m = mx.lr_scheduler.MultiFactorScheduler(step=[5, 10], factor=0.1)
+    m.base_lr = 1.0
+    assert m(2) == 1.0
+    assert abs(m(7) - 0.1) < 1e-9
+    assert abs(m(12) - 0.01) < 1e-9
+    p = mx.lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0, pwr=1)
+    assert abs(p(50) - 0.5) < 1e-6
+    c = mx.lr_scheduler.CosineScheduler(max_update=100, base_lr=1.0)
+    assert abs(c(0) - 1.0) < 1e-6
+    assert abs(c(100)) < 1e-6
+
+
+def test_multi_precision_sgd():
+    import ml_dtypes
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                           multi_precision=True)
+    w = nd.array(np.ones(4), dtype="bfloat16")
+    state = opt.create_state_multi_precision(0, w)
+    assert isinstance(state, tuple)
+    mom, w32 = state
+    assert w32.dtype == np.float32
+    g = nd.array(np.ones(4) * 0.5, dtype="bfloat16")
+    opt.update_multi_precision(0, w, g, state)
+    assert w.dtype == np.dtype(ml_dtypes.bfloat16)
+    assert abs(float(w32.asnumpy()[0]) - 0.95) < 1e-6
+
+
+def test_metrics():
+    pred = nd.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]])
+    label = nd.array([1, 0, 0])
+    acc = mx.metric.create("acc")
+    acc.update([label], [pred])
+    assert abs(acc.get()[1] - 2.0 / 3) < 1e-6
+
+    mse = mx.metric.MSE()
+    mse.update([nd.array([1.0, 2.0])], [nd.array([1.5, 2.5])])
+    assert abs(mse.get()[1] - 0.25) < 1e-6
+
+    f1 = mx.metric.F1()
+    f1.update([nd.array([1, 0, 1, 1])],
+              [nd.array([[0.2, 0.8], [0.8, 0.2], [0.1, 0.9], [0.9, 0.1]])])
+    assert 0 < f1.get()[1] <= 1.0
+
+    perp = mx.metric.Perplexity(ignore_label=None)
+    perp.update([nd.array([0, 1])], [nd.array([[0.5, 0.5], [0.5, 0.5]])])
+    assert abs(perp.get()[1] - 2.0) < 1e-3
+
+    comp = mx.metric.create(["acc", "mse"])
+    names, values = comp.get() if False else (None, None)
+    comp.update([label], [pred])
+    got = comp.get()
+    assert len(got[0]) == 2
+
+    custom = mx.metric.np(lambda l, p: float((l == p.argmax(1)).mean()),
+                          name="mycustom")
+    custom.update([label], [pred])
+    assert abs(custom.get()[1] - 2.0 / 3) < 1e-6
+
+    topk = mx.metric.TopKAccuracy(top_k=2)
+    topk.update([label], [pred])
+    assert topk.get()[1] == 1.0
+
+
+def test_initializers():
+    w = nd.zeros((64, 32))
+    mx.initializer.Xavier(factor_type="avg", magnitude=3)("fc_weight", w)
+    a = w.asnumpy()
+    bound = math.sqrt(3.0 / ((64 + 32) / 2))
+    assert abs(a).max() <= bound + 1e-6
+    assert abs(a).std() > 0
+
+    b = nd.zeros((10,))
+    mx.initializer.Uniform(0.1)("some_bias", b)
+    assert (b.asnumpy() == 0).all()  # bias pattern → zero init
+
+    g = nd.zeros((10,))
+    mx.initializer.Xavier()("bn_gamma", g)
+    assert (g.asnumpy() == 1).all()
+
+    c = nd.zeros((3, 3))
+    mx.initializer.Constant(2.5)("c_weight", c)
+    assert (c.asnumpy() == 2.5).all()
+
+    o = nd.zeros((16, 16))
+    mx.initializer.Orthogonal()("o_weight", o)
+    q = o.asnumpy()
+    np.testing.assert_allclose(q @ q.T, np.eye(16) * (1.414 ** 2), atol=1e-4)
+
+    mixed = mx.initializer.Mixed([".*bias", ".*"],
+                                 [mx.initializer.Zero(),
+                                  mx.initializer.Uniform(0.1)])
+    t = nd.zeros((4,))
+    mixed("fc1_bias", t)
+    assert (t.asnumpy() == 0).all()
