@@ -1,0 +1,17 @@
+"""Gluon: the imperative-first API (reference `python/mxnet/gluon/`).
+
+Define-by-run Blocks with optional `hybridize()` trace-to-XLA compilation —
+the API the TPU framework centers on (SURVEY.md §2.3).
+"""
+from .parameter import Parameter, Constant, ParameterDict, \
+    DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import rnn
+from . import loss
+from . import data
+from . import model_zoo
+from . import utils
+from . import contrib
+from .utils import split_and_load

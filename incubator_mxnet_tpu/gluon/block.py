@@ -1,0 +1,571 @@
+"""Gluon Block / HybridBlock / SymbolBlock
+(reference `python/mxnet/gluon/block.py` — Block:126, HybridBlock:672,
+_build_cache:749 → CachedOp:786, SymbolBlock:953).
+
+`hybridize()` = trace `hybrid_forward` once with Symbols, then compile the
+graph to a single XLA computation via the shared graph evaluator — the exact
+TPU analogue of the reference's CachedOp JIT (trace to nnvm graph, cached
+optimized replay), with jax.jit's signature cache playing the role of
+CachedOp's re-trace-on-new-shape check (`cached_op.cc:265`).
+"""
+from __future__ import annotations
+
+import copy
+import re
+import threading
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..ndarray.ndarray import NDArray, invoke
+from .. import ndarray as nd
+from ..ops.registry import OpDef
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+class _BlockScope:
+    """Name manager for Block prefixes (reference `block.py:_BlockScope`)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+        self._name_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                from ..symbol.symbol import _NameManager
+                prefix = _NameManager.next_name(hint + "_") + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            prefix = f"{hint}{count}_"
+            current._counter[hint] = count + 1
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        if self._block._empty_prefix:
+            return
+        _BlockScope._current.value = self._old_scope
+
+
+class Block:
+    """Base building block (reference `block.py:126 Block`)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(prefix, params,
+                                                        self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = {}
+        self._reg_params = {}
+        self._forward_hooks = {}
+        self._forward_pre_hooks = {}
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join(f"  ({key}): {_indent(repr(block), 2)}"
+                           for key, block in self._children.items())
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    def __setattr__(self, name, value):
+        if hasattr(self, name):
+            existing = getattr(self, name)
+            if isinstance(existing, (Parameter, Block)) and \
+                    not isinstance(value, type(existing)) and \
+                    not isinstance(existing, type(value)):
+                raise TypeError(f"Changing attribute type for {name} from "
+                                f"{type(existing)} to {type(value)} is not "
+                                "allowed.")
+        if isinstance(value, Block):
+            self.register_child(value, name)
+        elif isinstance(value, Parameter):
+            assert name not in self._reg_params or \
+                self._reg_params[name] is value, \
+                "Overriding Parameter attribute %s is not allowed." % name
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        """Reference `block.py name_scope`."""
+        return self._scope
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self, select=None):
+        """All Parameters of this block and children (reference
+        `block.py collect_params`)."""
+        ret = ParameterDict(self._params.prefix)
+        if not select:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({name: value for name, value in self.params.items()
+                        if pattern.match(name)})
+        for cld in self._children.values():
+            ret.update(cld.collect_params(select=select))
+        return ret
+
+    def register_child(self, block, name=None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    def register_forward_hook(self, hook):
+        handle = len(self._forward_hooks)
+        self._forward_hooks[handle] = hook
+        return handle
+
+    def register_forward_pre_hook(self, hook):
+        handle = len(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle] = hook
+        return handle
+
+    def apply(self, fn):
+        for cld in self._children.values():
+            cld.apply(fn)
+        fn(self)
+        return self
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        from .. import initializer as init_mod
+        self.collect_params().initialize(init or init_mod.Uniform(), ctx,
+                                         verbose, force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        for cld in self._children.values():
+            cld.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def save_parameters(self, filename, deduplicate=False):
+        """Reference `block.py:314 save_parameters` — keys are the
+        prefix-stripped structural names."""
+        params = self._collect_params_with_prefix()
+        arg_dict = {key: val._reduce() for key, val in params.items()}
+        nd.save(filename, arg_dict)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current"):
+        """Reference `block.py:356 load_parameters`."""
+        loaded = nd.load(filename)
+        params = self._collect_params_with_prefix()
+        if not loaded and not params:
+            return
+        if not any("." in k for k in loaded):
+            # legacy full-name format
+            full = self.collect_params()
+            full.load(filename, ctx, allow_missing, ignore_extra,
+                      self.prefix)
+            return
+        if not allow_missing:
+            for name in params:
+                assert name in loaded, \
+                    f"Parameter '{name}' is missing in file '{filename}'"
+        for name in loaded:
+            if name not in params:
+                if not ignore_extra:
+                    raise ValueError(
+                        f"Parameter '{name}' loaded from file '{filename}' "
+                        "is not present in this Block")
+                continue
+            param = params[name]
+            value = loaded[name]
+            if param._data is None:
+                param.shape = value.shape
+                if param._deferred_init:
+                    init, pctx, default_init, _ = param._deferred_init
+                    param._deferred_init = (
+                        init, [ctx] if isinstance(ctx, Context) else
+                        (ctx or pctx), default_init, value)
+                    param._finish_deferred_init()
+                else:
+                    param.initialize(ctx=ctx or [cpu()])
+                    param.set_data(value)
+            else:
+                param.set_data(value)
+
+    save_params = save_parameters
+    load_params = load_parameters
+
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        ret = {prefix + key: val for key, val in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    def __call__(self, *args):
+        for hook in self._forward_pre_hooks.values():
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks.values():
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        """Print per-layer summary by running a forward with hooks."""
+        rows = []
+
+        def add_hook(block):
+            def hook(blk, inp, out):
+                o = out[0] if isinstance(out, (list, tuple)) else out
+                n_params = sum(int(p.data().size) for p in
+                               blk._reg_params.values()
+                               if p._data is not None)
+                rows.append((blk.name, type(blk).__name__,
+                             tuple(o.shape) if hasattr(o, "shape") else "?",
+                             n_params))
+            return block.register_forward_hook(hook)
+
+        handles = []
+        def walk(b):
+            handles.append((b, add_hook(b)))
+            for c in b._children.values():
+                walk(c)
+        walk(self)
+        self(*inputs)
+        for b, h in handles:
+            b._forward_hooks.pop(h, None)
+        print(f"{'Layer':<30}{'Type':<20}{'Output Shape':<24}{'Params':<12}")
+        print("-" * 86)
+        total = 0
+        for name, typ, shape, n in rows:
+            print(f"{name:<30}{typ:<20}{str(shape):<24}{n:<12}")
+            total += n
+        print("-" * 86)
+        print(f"Total params: {total}")
+
+
+def _indent(s, num_spaces):
+    lines = s.split("\n")
+    if len(lines) == 1:
+        return s
+    first = lines.pop(0)
+    return first + "\n" + "\n".join(" " * num_spaces + line for line in lines)
+
+
+class _CachedGraph:
+    """Compiled trace of a HybridBlock (the CachedOp, `cached_op.h:68`)."""
+
+    _counter = [0]
+
+    def __init__(self, symbol, n_data, data_names, block):
+        from ..symbol.symbol import graph_eval_fn
+        self.symbol = symbol
+        self.block = block
+        self._fns = {}
+        # build once to learn input ordering + rng/aux structure
+        fn, arg_nodes, aux_nodes, n_rng = graph_eval_fn(symbol, False)
+        graph_eval_fn(symbol, True)
+        self.arg_names = [n.name for n in arg_nodes]
+        self.aux_names = [n.name for n in aux_nodes]
+        self.n_rng = n_rng
+        self.data_names = data_names
+        n_out = len(symbol._entries)
+        _CachedGraph._counter[0] += 1
+        uid = _CachedGraph._counter[0]
+
+        cache = {}
+
+        def op_fn(params, *arrays):
+            import jax
+            is_train = bool(params.get("_train", False))
+            if is_train not in cache:
+                cache[is_train] = graph_eval_fn(symbol, is_train)[0]
+            gfn = cache[is_train]
+            if self.n_rng:
+                key = arrays[-1]
+                arrays = arrays[:-1]
+            else:
+                key = jax.random.PRNGKey(0)
+            na = len(self.arg_names)
+            args, aux = arrays[:na], arrays[na:]
+            outs, new_aux = gfn(tuple(args), tuple(aux), key)
+            if is_train and new_aux:
+                return tuple(outs) + tuple(new_aux)
+            return tuple(outs) if len(outs) > 1 else outs[0]
+
+        from ..ops.registry import register_opdef
+        self.op = register_opdef(OpDef(
+            name=f"_cached_op{uid}", fn=op_fn, nin=-1,
+            nout=n_out, naux=len(self.aux_names),
+            params={}, mode_dependent=True, needs_rng=n_rng > 0))
+
+    def __call__(self, inputs, param_lookup):
+        """inputs: list[NDArray]; param_lookup: name -> NDArray."""
+        data_map = dict(zip(self.data_names, inputs))
+        args = []
+        for name in self.arg_names:
+            if name in data_map:
+                args.append(data_map[name])
+            else:
+                args.append(param_lookup(name))
+        for name in self.aux_names:
+            args.append(param_lookup(name))
+        return invoke(self.op, args, {})
+
+
+class HybridBlock(Block):
+    """Block with optional trace-to-XLA compilation
+    (reference `block.py:672 HybridBlock`)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_graph = None
+        self._flags = {}
+
+    def __setattr__(self, name, value):
+        super().__setattr__(name, value)
+        if isinstance(value, HybridBlock):
+            self._clear_cached_op()
+
+    def _clear_cached_op(self):
+        self._cached_graph = None
+
+    def hybridize(self, active=True, **kwargs):
+        """Activate compiled execution (reference `block.py hybridize`)."""
+        self._active = active
+        self._flags = kwargs
+        self._clear_cached_op()
+        super().hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        self._clear_cached_op()
+        super().cast(dtype)
+
+    def infer_shape(self, *args):
+        self._deferred_infer_shape(*args)
+
+    def _trace_symbol(self, n_inputs):
+        """Trace hybrid_forward into a Symbol graph."""
+        from .. import symbol as sym_mod
+        from ..symbol.symbol import Symbol, Group
+        data_syms = [sym_mod.var(f"data{i}" if n_inputs > 1 else "data")
+                     for i in range(n_inputs)]
+        param_syms = {name: p.var() for name, p in self._reg_params.items()}
+        out = self.hybrid_forward(sym_mod, *data_syms, **param_syms)
+        if isinstance(out, (list, tuple)):
+            out = Group(list(out))
+        names = [s.name for s in data_syms]
+        return out, names
+
+    def _deferred_infer_shape(self, *args):
+        """Infer unknown parameter shapes from input shapes by tracing
+        (reference `block.py _deferred_infer_shape` → infer_shape pass)."""
+        inputs = [a for a in args if isinstance(a, NDArray)]
+        out, names = self._trace_symbol(len(inputs))
+        shapes = {n: i.shape for n, i in zip(names, inputs)}
+        arg_shapes, _, aux_shapes = out._infer_shape_impl(True, **shapes)
+        all_params = {p.name: p for p in self.collect_params().values()}
+        inferred = dict(zip(out.list_arguments(), arg_shapes or []))
+        inferred.update(dict(zip(out.list_auxiliary_states(),
+                                 aux_shapes or [])))
+        for name, shape in inferred.items():
+            if name in all_params and shape is not None:
+                all_params[name].shape = shape
+
+    def _finish_deferred(self, *args):
+        for p in self.collect_params().values():
+            if p._deferred_init:
+                try:
+                    p._finish_deferred_init()
+                except AssertionError:
+                    self._deferred_infer_shape(*args)
+                    p._finish_deferred_init()
+
+    def _build_cache(self, *args):
+        inputs = [a for a in args if isinstance(a, NDArray)]
+        out, names = self._trace_symbol(len(inputs))
+        self._cached_graph = _CachedGraph(out, len(inputs), names, self)
+
+    def forward(self, x, *args):
+        """Dispatch eager or cached-compiled (reference `block.py:902`)."""
+        if isinstance(x, NDArray):
+            ctx = x.context
+            try:
+                params = {name: p.data(ctx)
+                          for name, p in self._reg_params.items()}
+            except DeferredInitializationError:
+                self._deferred_infer_shape(x, *args)
+                for p in self.collect_params().values():
+                    if p._deferred_init:
+                        p._finish_deferred_init()
+                params = {name: p.data(ctx)
+                          for name, p in self._reg_params.items()}
+
+            if self._active:
+                return self._call_cached_op(x, *args)
+            return self.hybrid_forward(nd, x, *args, **params)
+        # symbolic input (SymbolBlock composition)
+        from .. import symbol as sym_mod
+        params = {name: p.var() for name, p in self._reg_params.items()}
+        return self.hybrid_forward(sym_mod, x, *args, **params)
+
+    def _call_cached_op(self, *args):
+        inputs = [a for a in args if isinstance(a, NDArray)]
+        # finish deferred init for ALL nested params before compiling
+        pending = [p for p in self.collect_params().values()
+                   if p._data is None]
+        if pending:
+            self._deferred_infer_shape(*inputs)
+            for p in pending:
+                if p._deferred_init:
+                    p._finish_deferred_init()
+                else:
+                    p.initialize(ctx=inputs[0].context)
+        if self._cached_graph is None:
+            self._build_cache(*args)
+        cg = self._cached_graph
+        ctx = inputs[0].context
+        all_params = None
+
+        def lookup(name):
+            nonlocal all_params
+            if all_params is None:
+                all_params = {p.name: p
+                              for p in self.collect_params().values()}
+            return all_params[name].data(ctx)
+
+        return cg(inputs, lookup)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    def export(self, path, epoch=0):
+        """Save symbol + params for deployment (reference `block.py:869`)."""
+        if self._cached_graph is None:
+            raise MXNetError("Please first call block.hybridize() and then "
+                             "run forward with this block at least once "
+                             "before calling export.")
+        sym = self._cached_graph.symbol
+        sym.save(f"{path}-symbol.json")
+        arg_names = set(sym.list_arguments())
+        aux_names = set(sym.list_auxiliary_states())
+        arg_dict = {}
+        for param in self.collect_params().values():
+            if param.name in arg_names:
+                arg_dict[f"arg:{param.name}"] = param._reduce()
+            elif param.name in aux_names:
+                arg_dict[f"aux:{param.name}"] = param._reduce()
+        nd.save("%s-%04d.params" % (path, epoch), arg_dict)
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap an arbitrary Symbol as a Block (reference `block.py:953`)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix=None, params=params)
+        from ..symbol.symbol import Symbol, Group
+        if isinstance(outputs, (list, tuple)):
+            outputs = Group(list(outputs))
+        if isinstance(inputs, Symbol):
+            inputs = [inputs]
+        self._output_symbol = outputs
+        self._input_names = [i.name for i in inputs]
+        arg_names = outputs.list_arguments()
+        aux_names = outputs.list_auxiliary_states()
+        for name in arg_names:
+            if name not in self._input_names:
+                self._reg_params[name] = self.params.get(
+                    name[len(self.params.prefix):] if name.startswith(
+                        self.params.prefix) else name,
+                    allow_deferred_init=True)
+                self._reg_params[name].name = name
+                self.params._params[name] = self._reg_params[name]
+        for name in aux_names:
+            self._reg_params[name] = self.params.get(
+                name, grad_req="null", allow_deferred_init=True)
+            self._reg_params[name].name = name
+            self.params._params[name] = self._reg_params[name]
+        self._cached_graph = _CachedGraph(outputs, len(inputs),
+                                          self._input_names, self)
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        """Reference `block.py:986 SymbolBlock.imports`."""
+        from .. import symbol as sym_mod
+        output = sym_mod.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [sym_mod.var(n) for n in input_names]
+        ret = SymbolBlock(output, inputs)
+        if param_file is not None:
+            loaded = nd.load(param_file)
+            fixed = {}
+            for k, v in loaded.items():
+                name = k.split(":", 1)[1] if ":" in k else k
+                fixed[name] = v
+            for name, param in ret._reg_params.items():
+                if name in fixed:
+                    param.shape = fixed[name].shape
+                    param.initialize(ctx=ctx or [cpu()])
+                    param.set_data(fixed[name])
+        return ret
+
+    def forward(self, x, *args):
+        if isinstance(x, NDArray):
+            inputs = [x] + [a for a in args if isinstance(a, NDArray)]
+            ctx = x.context
+            for p in self.collect_params().values():
+                if p._data is None and not p._deferred_init:
+                    p.initialize(ctx=ctx)
+                elif p._deferred_init:
+                    p._finish_deferred_init()
+
+            def lookup(name):
+                return self._reg_params[name].data(ctx)
+
+            return self._cached_graph(inputs, lookup)
+        raise MXNetError("SymbolBlock requires NDArray inputs")
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError

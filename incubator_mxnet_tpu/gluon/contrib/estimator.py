@@ -1,0 +1,25 @@
+"""Minimal training-loop helper in the spirit of gluon.contrib."""
+from __future__ import annotations
+
+
+class Estimator:
+    """Simple fit loop over a Gluon net + loss + trainer."""
+
+    def __init__(self, net, loss, trainer, metrics=None, context=None):
+        self.net = net
+        self.loss = loss
+        self.trainer = trainer
+        self.metrics = metrics or []
+        self.context = context
+
+    def fit(self, train_data, epochs=1):
+        from ... import autograd
+        for _ in range(epochs):
+            for batch in train_data:
+                data, label = batch
+                with autograd.record():
+                    out = self.net(data)
+                    loss = self.loss(out, label)
+                loss.backward()
+                self.trainer.step(data.shape[0])
+        return self

@@ -1,0 +1,105 @@
+"""DataLoader (reference `python/mxnet/gluon/data/dataloader.py:26-112`).
+
+The reference forks multiprocessing workers and ships batches through
+CPUShared-memory NDArray pickling.  Here workers are threads: batchify is
+numpy (releases the GIL for decode-heavy datasets), there is no fork — the
+reference's `pthread_atfork` engine-restart machinery (`initialize.cc:52-66`)
+is unnecessary by construction, and batches land directly in host memory
+ready for the device transfer.  `num_workers` keeps its meaning as the
+prefetch parallelism degree.
+"""
+from __future__ import annotations
+
+import threading
+import queue as _queue
+
+import numpy as np
+
+from ...ndarray.ndarray import NDArray, array
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference `dataloader.py default_batchify_fn`)."""
+    if isinstance(data[0], NDArray):
+        import jax.numpy as jnp
+        return NDArray(jnp.stack([d._data for d in data]),
+                       ctx=data[0].context)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = np.asarray(data)
+    return array(data, dtype=data.dtype if data.dtype != np.float64
+                 else np.float32)
+
+
+class DataLoader:
+    """Loads batches from a Dataset (reference `dataloader.py:DataLoader`)."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 thread_pool=False):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size must be specified unless "
+                                 "batch_sampler is specified")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must not be specified if sampler "
+                                 "is specified")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError("batch_size, shuffle, sampler and last_batch "
+                             "must not be specified if batch_sampler is "
+                             "specified.")
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._prefetch = max(0, prefetch or 2 * self._num_workers)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for batch_idx in self._batch_sampler:
+                yield self._batchify_fn([self._dataset[i] for i in batch_idx])
+            return
+
+        # threaded pipeline: workers fetch+batchify, consumer preserves order
+        batches = list(self._batch_sampler)
+        results = {}
+        results_lock = threading.Lock()
+        results_ready = threading.Condition(results_lock)
+        task_q = _queue.Queue()
+        for i, b in enumerate(batches):
+            task_q.put((i, b))
+
+        def worker():
+            while True:
+                try:
+                    i, idx = task_q.get_nowait()
+                except _queue.Empty:
+                    return
+                out = self._batchify_fn([self._dataset[j] for j in idx])
+                with results_ready:
+                    results[i] = out
+                    results_ready.notify_all()
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(self._num_workers)]
+        for t in threads:
+            t.start()
+        for i in range(len(batches)):
+            with results_ready:
+                while i not in results:
+                    results_ready.wait(timeout=60)
+                yield results.pop(i)

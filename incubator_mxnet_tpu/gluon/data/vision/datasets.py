@@ -1,0 +1,220 @@
+"""Vision datasets (reference `python/mxnet/gluon/data/vision/datasets.py`).
+
+This environment has zero egress, so the download path raises with a clear
+message; datasets read pre-downloaded idx/bin files when `root` contains
+them.  `SyntheticImageDataset` provides a deterministic stand-in used by the
+test suite and benchmarks (same role as the reference CI's cached data).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ....base import MXNetError
+from ....ndarray.ndarray import array
+from ..dataset import Dataset, ArrayDataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset", "SyntheticImageDataset"]
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, transform):
+        self._transform = transform
+        self._data = None
+        self._label = None
+        self._root = os.path.expanduser(root)
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST from local idx files (reference `datasets.py:MNIST`)."""
+
+    _train_files = ("train-images-idx3-ubyte", "train-labels-idx1-ubyte")
+    _test_files = ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")
+
+    def __init__(self, root="~/.mxnet/datasets/mnist", train=True,
+                 transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        files = self._train_files if self._train else self._test_files
+        paths = []
+        for f in files:
+            found = None
+            for cand in (os.path.join(self._root, f),
+                         os.path.join(self._root, f + ".gz")):
+                if os.path.exists(cand):
+                    found = cand
+                    break
+            if found is None:
+                raise MXNetError(
+                    f"MNIST file {f} not found under {self._root}. This "
+                    "environment has no network access — place the idx files "
+                    "there manually, or use "
+                    "gluon.data.vision.SyntheticImageDataset for testing.")
+            paths.append(found)
+        self._data = array(_read_images(paths[0])[..., None])
+        self._label = _read_labels(paths[1]).astype(np.int32)
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root="~/.mxnet/datasets/fashion-mnist", train=True,
+                 transform=None):
+        super().__init__(root, train, transform)
+
+
+def _read_images(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        return np.frombuffer(f.read(n * rows * cols),
+                             dtype=np.uint8).reshape(n, rows, cols)
+
+
+def _read_labels(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        _, n = struct.unpack(">II", f.read(8))
+        return np.frombuffer(f.read(n), dtype=np.uint8)
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR10 from local bin files (reference `datasets.py:CIFAR10`)."""
+
+    def __init__(self, root="~/.mxnet/datasets/cifar10", train=True,
+                 transform=None):
+        self._train = train
+        self._archive_file_name = "cifar-10-binary"
+        super().__init__(root, transform)
+
+    def _file_list(self):
+        if self._train:
+            return [f"data_batch_{i}.bin" for i in range(1, 6)]
+        return ["test_batch.bin"]
+
+    def _get_data(self):
+        data = []
+        labels = []
+        for fname in self._file_list():
+            path = os.path.join(self._root, fname)
+            if not os.path.exists(path):
+                raise MXNetError(
+                    f"CIFAR file {fname} not found under {self._root} "
+                    "(no network access; place files manually or use "
+                    "SyntheticImageDataset).")
+            raw = np.fromfile(path, dtype=np.uint8).reshape(-1, 3073)
+            labels.append(raw[:, 0])
+            data.append(raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+        self._data = array(np.concatenate(data))
+        self._label = np.concatenate(labels).astype(np.int32)
+
+
+class CIFAR100(CIFAR10):
+    def __init__(self, root="~/.mxnet/datasets/cifar100", fine_label=False,
+                 train=True, transform=None):
+        self._fine_label = fine_label
+        super(CIFAR10, self).__init__(root, transform)  # skip CIFAR10 init
+        self._train = train
+
+    def _file_list(self):
+        return ["train.bin" if self._train else "test.bin"]
+
+
+class ImageRecordDataset(Dataset):
+    """Images from a RecordIO file (reference `datasets.py:ImageRecordDataset`)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        from ..dataset import RecordFileDataset
+        self._record = RecordFileDataset(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from .... import recordio
+        record = self._record[idx]
+        header, img = recordio.unpack_img(record, self._flag)
+        img = array(img, dtype="uint8")
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self._record)
+
+
+class ImageFolderDataset(Dataset):
+    """folder/label/img.jpg layout (reference `datasets.py:ImageFolderDataset`)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png"]
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                if os.path.splitext(filename)[1].lower() in self._exts:
+                    self.items.append((os.path.join(path, filename), label))
+
+    def __getitem__(self, idx):
+        from .... import image as img_mod
+        with open(self.items[idx][0], "rb") as f:
+            img = img_mod.imdecode(f.read(), to_rgb=self._flag)
+        label = self.items[idx][1]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)
+
+
+class SyntheticImageDataset(Dataset):
+    """Deterministic synthetic classification images (testing/benchmarks)."""
+
+    def __init__(self, num_samples=1000, shape=(28, 28, 1), num_classes=10,
+                 seed=0, transform=None):
+        rng = np.random.RandomState(seed)
+        protos = rng.randint(0, 255, (num_classes,) + tuple(shape)) \
+            .astype(np.uint8)
+        self._labels = rng.randint(0, num_classes, num_samples).astype(np.int32)
+        noise = rng.randint(-20, 20, (num_samples,) + tuple(shape))
+        imgs = protos[self._labels].astype(np.int32) + noise
+        self._imgs = np.clip(imgs, 0, 255).astype(np.uint8)
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        img = array(self._imgs[idx], dtype="uint8")
+        label = self._labels[idx]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self._labels)

@@ -1,0 +1,134 @@
+"""Vision transforms (reference `python/mxnet/gluon/data/vision/transforms.py`),
+backed by `nd.image.*` ops (reference `src/operator/image/image_random.cc`)."""
+from __future__ import annotations
+
+import random as _pyrandom
+
+import numpy as np
+
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential
+from ....ndarray.ndarray import NDArray, array
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "RandomBrightness", "RandomContrast", "RandomSaturation"]
+
+
+class Compose(Sequential):
+    """Sequentially composed transforms (reference `transforms.py:Compose`)."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.Cast(x, dtype=self._dtype)
+
+
+class ToTensor(Block):
+    """HWC uint8 -> CHW float [0,1] (reference `transforms.py:ToTensor`)."""
+
+    def forward(self, x):
+        from ....ndarray import image as nd_image
+        return nd_image.to_tensor(x)
+
+
+class Normalize(Block):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = mean
+        self._std = std
+
+    def forward(self, x):
+        from ....ndarray import image as nd_image
+        return nd_image.normalize(x, self._mean, self._std)
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+        self._keep = keep_ratio
+
+    def forward(self, x):
+        from .... import image as img_mod
+        img = x.asnumpy()
+        if self._keep:
+            return img_mod.resize_short(img, min(self._size))
+        return array(img_mod._resize_np(img, self._size[0], self._size[1]),
+                     dtype="uint8" if img.dtype == np.uint8 else "float32")
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+
+    def forward(self, x):
+        from .... import image as img_mod
+        return img_mod.center_crop(x, self._size)[0]
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4., 4 / 3.),
+                 interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        from .... import image as img_mod
+        return img_mod.random_size_crop(x, self._size, self._scale,
+                                        self._ratio)[0]
+
+
+class RandomFlipLeftRight(HybridBlock):
+    def hybrid_forward(self, F, x):
+        from ....ndarray import image as nd_image
+        return nd_image.random_flip_left_right(x)
+
+
+class RandomFlipTopBottom(HybridBlock):
+    def hybrid_forward(self, F, x):
+        from ....ndarray import image as nd_image
+        return nd_image.random_flip_top_bottom(x)
+
+
+class _RandomJitter(Block):
+    def __init__(self, jitter):
+        super().__init__()
+        self._jitter = jitter
+
+    def _alpha(self):
+        return 1.0 + _pyrandom.uniform(-self._jitter, self._jitter)
+
+
+class RandomBrightness(_RandomJitter):
+    def forward(self, x):
+        arr = x.asnumpy().astype("float32") * self._alpha()
+        return array(np.clip(arr, 0, 255), dtype="float32")
+
+
+class RandomContrast(_RandomJitter):
+    def forward(self, x):
+        arr = x.asnumpy().astype("float32")
+        mean = arr.mean()
+        arr = mean + (arr - mean) * self._alpha()
+        return array(np.clip(arr, 0, 255), dtype="float32")
+
+
+class RandomSaturation(_RandomJitter):
+    def forward(self, x):
+        arr = x.asnumpy().astype("float32")
+        gray = arr.mean(axis=-1, keepdims=True)
+        arr = gray + (arr - gray) * self._alpha()
+        return array(np.clip(arr, 0, 255), dtype="float32")

@@ -1,0 +1,32 @@
+"""Pretrained model store (reference `model_zoo/model_store.py:30-41`).
+
+Zero-egress environment: pretrained weights cannot be downloaded.  If weight
+files are placed under ``root`` manually, they are used; otherwise a clear
+error explains the situation.
+"""
+from __future__ import annotations
+
+import os
+
+from ...base import MXNetError
+
+_model_sha1 = {}  # name -> sha1 (reference populates from its registry)
+
+
+def get_model_file(name, root="~/.mxnet/models"):
+    root = os.path.expanduser(root)
+    file_path = os.path.join(root, f"{name}.params")
+    if os.path.exists(file_path):
+        return file_path
+    raise MXNetError(
+        f"Pretrained weights for '{name}' not found at {file_path} and this "
+        "environment has no network access. Place the .params file there "
+        "manually, or construct the model with pretrained=False.")
+
+
+def purge(root="~/.mxnet/models"):
+    root = os.path.expanduser(root)
+    if os.path.isdir(root):
+        for f in os.listdir(root):
+            if f.endswith(".params"):
+                os.remove(os.path.join(root, f))

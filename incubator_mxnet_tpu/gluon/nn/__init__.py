@@ -1,0 +1,5 @@
+"""`gluon.nn` neural-network layers (reference `python/mxnet/gluon/nn/`)."""
+from .activations import *
+from .basic_layers import *
+from .conv_layers import *
+from .activations import Activation

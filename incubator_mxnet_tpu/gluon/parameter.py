@@ -1,0 +1,427 @@
+"""Gluon Parameter / ParameterDict (reference `python/mxnet/gluon/parameter.py`).
+
+Parameter holds per-context NDArray copies with deferred shape init; `var()`
+exposes it to symbolic tracing (hybridize).  Gradient buffers attach through
+the autograd tape (`attach_grad`), exactly as the reference wires
+`mark_variables`.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from .. import initializer as init_mod
+from ..initializer import InitDesc
+from ..ndarray.ndarray import NDArray
+from .. import ndarray as nd
+
+__all__ = ["DeferredInitializationError", "Parameter", "Constant",
+           "ParameterDict"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Error for unfinished deferred initialization (reference parameter.py)."""
+
+
+class Parameter:
+    """A Block parameter (reference `parameter.py:Parameter`)."""
+
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self._var = None
+        self._data = None       # list[NDArray], one per ctx
+        self._grad = None
+        self._ctx_list = None
+        self._deferred_init = ()
+        self.name = name
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._differentiable = differentiable
+        if not differentiable:
+            grad_req = "null"
+        self._grad_req = None
+        self.grad_req = grad_req
+        self._stype = stype
+
+    def __repr__(self):
+        return f"Parameter {self.name} (shape={self.shape}, dtype={self.dtype})"
+
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        assert req in ("write", "add", "null")
+        if not self._differentiable:
+            req = "null"
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if req == "null":
+            self._grad = None
+            if self._data:
+                for d in self._data:
+                    d._mark_variable(None, "null")
+                    d._requires_grad = False
+        elif self._data is not None:
+            self._init_grad()
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        unknown_ok = all(s1 == 0 or s1 == s2
+                         for s1, s2 in zip(self._shape, new_shape))
+        if not (len(self._shape) == len(new_shape) and unknown_ok):
+            raise AssertionError(
+                f"Expected shape {new_shape} is incompatible with given shape "
+                f"{self._shape}.")
+        self._shape = tuple(new_shape)
+
+    def _check_initialized(self, ctx=None):
+        if self._data is not None:
+            if ctx is not None and ctx not in self._ctx_list:
+                raise MXNetError(
+                    f"Parameter '{self.name}' was not initialized on context "
+                    f"{ctx}. It was only initialized on {self._ctx_list}.")
+            return
+        if self._deferred_init:
+            raise DeferredInitializationError(
+                f"Parameter '{self.name}' has not been initialized yet because "
+                "initialization was deferred. Actual initialization happens "
+                "during the first forward pass. Please pass one batch of data "
+                "through the network before accessing Parameters.")
+        raise MXNetError(
+            f"Parameter '{self.name}' has not been initialized. Note that you "
+            "should initialize parameters and create Trainer with "
+            "Block.collect_params() instead of Block.params because the later "
+            "does not include Parameters of nested child Blocks")
+
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """Reference `parameter.py initialize`."""
+        if default_init is None:
+            default_init = init_mod.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if init is None:
+            init = self.init  # may be None -> pattern-dispatched default_init
+        if self._shape is None or any(s == 0 for s in self._shape):
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init, None)
+                return
+            raise ValueError(
+                f"Cannot initialize Parameter '{self.name}' because it has "
+                "invalid shape: {self._shape}.")
+        self._deferred_init = (init, ctx, default_init, None)
+        self._finish_deferred_init()
+
+    def _finish_deferred_init(self):
+        if not self._deferred_init:
+            return
+        init, ctx, default_init, data = self._deferred_init
+        self._deferred_init = ()
+        assert self._shape is not None and all(s > 0 for s in self._shape), \
+            f"Cannot initialize Parameter '{self.name}' because it has " \
+            f"invalid shape: {self._shape}."
+        if data is None:
+            data = nd.zeros(self._shape, dtype=self.dtype, ctx=cpu())
+            if isinstance(init, init_mod.Initializer):
+                # explicit per-parameter init overrides name-pattern dispatch
+                init._init_weight(InitDesc(self.name), data)
+            elif isinstance(init, str):
+                init_mod.create(init)._init_weight(InitDesc(self.name), data)
+            elif callable(init):
+                init(InitDesc(self.name), data)
+            else:
+                # gluon semantics: the default initializer is applied via
+                # _init_weight regardless of the parameter name pattern
+                # (reference parameter.py passes {'__init__': init} attrs)
+                d = init_mod.create(default_init)
+                if isinstance(d, init_mod.Initializer):
+                    d._init_weight(InitDesc(self.name), data)
+                else:
+                    d(InitDesc(self.name), data)
+        self._init_impl(data, ctx)
+
+    def _init_impl(self, data, ctx_list):
+        self._ctx_list = list(ctx_list)
+        self._data = [data.copyto(c) for c in self._ctx_list]
+        self._init_grad()
+
+    def _init_grad(self):
+        if self.grad_req == "null":
+            self._grad = None
+            return
+        self._grad = [nd.zeros(d.shape, dtype=d.dtype, ctx=d.context)
+                      for d in self._data]
+        for d, g in zip(self._data, self._grad):
+            d._mark_variable(g, self.grad_req)
+
+    def _reduce(self):
+        """Average over contexts (reference `parameter.py _reduce`)."""
+        if len(self._data) == 1:
+            return self._data[0]
+        out = self._data[0].copyto(cpu())
+        for d in self._data[1:]:
+            out += d.copyto(cpu())
+        return out / len(self._data)
+
+    def reset_ctx(self, ctx):
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._data:
+            data = self._reduce()
+            self._init_impl(data, ctx)
+        elif self._deferred_init:
+            init, _, default_init, data = self._deferred_init
+            self._deferred_init = (init, ctx, default_init, data)
+        else:
+            raise ValueError(f"Cannot reset context for Parameter '{self.name}' "
+                             "because it has not been initialized.")
+
+    def set_data(self, data):
+        self.shape = data.shape
+        if self._data is None:
+            assert self._deferred_init, \
+                f"Parameter '{self.name}' has not been initialized"
+            init, ctx, default_init, _ = self._deferred_init
+            self._deferred_init = (init, ctx, default_init, data)
+            return
+        for d in self._data:
+            src = data._data if isinstance(data, NDArray) else data
+            import jax
+            d._data = jax.device_put(src.astype(d.dtype), d.context.jax_device)
+
+    def data(self, ctx=None):
+        """NDArray on the given context (reference `parameter.py data`)."""
+        self._check_initialized(ctx)
+        if ctx is None:
+            return self._data[0]
+        for c, d in zip(self._ctx_list, self._data):
+            if c == ctx:
+                return d
+        raise MXNetError(f"Parameter '{self.name}' not initialized on {ctx}")
+
+    def list_data(self):
+        self._check_initialized()
+        return list(self._data)
+
+    def grad(self, ctx=None):
+        if self._data is not None and self._grad is None:
+            raise MXNetError(
+                f"Cannot get gradient array for Parameter '{self.name}' "
+                "because grad_req='null'")
+        self._check_initialized(ctx)
+        if ctx is None:
+            return self._grad[0]
+        for c, g in zip(self._ctx_list, self._grad):
+            if c == ctx:
+                return g
+        raise MXNetError(f"Parameter '{self.name}' not initialized on {ctx}")
+
+    def list_grad(self):
+        self._check_initialized()
+        if self._grad is None:
+            raise MXNetError(f"grad_req='null' for Parameter '{self.name}'")
+        return list(self._grad)
+
+    def list_ctx(self):
+        if self._data is None:
+            if self._deferred_init:
+                return self._deferred_init[1]
+            raise MXNetError(f"Parameter '{self.name}' has not been initialized")
+        return self._ctx_list
+
+    def zero_grad(self):
+        if self._grad is None:
+            return
+        for g in self._grad:
+            g._data = g._data * 0
+
+    def var(self):
+        """Symbol variable for tracing (reference `parameter.py var`)."""
+        from ..symbol import Variable
+        if self._var is None:
+            self._var = Variable(self.name, shape=self._shape,
+                                 dtype=self.dtype, lr_mult=self.lr_mult,
+                                 wd_mult=self.wd_mult)
+        return self._var
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is None:
+            return
+        self._data = [d.astype(dtype) for d in self._data]
+        self._init_grad()
+
+
+class Constant(Parameter):
+    """Non-trainable constant parameter (reference `parameter.py Constant`)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            value = nd.array(value)
+        self.value = value
+
+        class InitC(init_mod.Initializer):
+            def _init_weight(self2, _, arr):
+                value.copyto(arr)
+
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype, init=InitC())
+
+
+class ParameterDict:
+    """Dict of Parameters with prefix (reference `parameter.py ParameterDict`)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = {}
+        self._shared = shared
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __repr__(self):
+        s = "\n".join(repr(v) for v in self.values())
+        return f"ParameterDict '{self._prefix}' (\n{s}\n)"
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def get(self, name, **kwargs):
+        """Create-or-retrieve (reference `parameter.py ParameterDict.get`)."""
+        name = self.prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if hasattr(param, k) and getattr(param, k) is not None:
+                    existing = getattr(param, k)
+                    if k == "shape" and v is not None and existing is not None:
+                        # merge unknown dims
+                        if len(v) == len(existing):
+                            merged = tuple(a if a != 0 else b
+                                           for a, b in zip(existing, v))
+                            param._shape = merged
+                        continue
+                else:
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self.prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise KeyError(f"No constant named '{name}'.")
+            param = Constant(name, value)
+            self._params[name] = param
+        return param
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise ValueError(f"Cannot update self with other because they "
+                                 f"have different Parameters with the same "
+                                 f"name '{k}'")
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        if init is None:
+            init = init_mod.Uniform()
+        for _, v in self.items():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for v in self.values():
+            v.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for v in self.values():
+            v.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for v in self.values():
+            setattr(v, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        arg_dict = {}
+        for param in self.values():
+            weight = param._reduce()
+            if not param.name.startswith(strip_prefix):
+                raise ValueError(f"Prefix '{strip_prefix}' is to be stripped "
+                                 f"before saving, but Parameter's name "
+                                 f"'{param.name}' does not start with it")
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        nd.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        arg_dict = nd.load(filename)
+        arg_dict = {restore_prefix + k: v for k, v in arg_dict.items()}
+        if not allow_missing:
+            for name in self.keys():
+                assert name in arg_dict, \
+                    f"Parameter '{name}' is missing in file '{filename}'"
+        for name in arg_dict:
+            if name not in self._params:
+                if not ignore_extra:
+                    raise ValueError(
+                        f"Parameter '{name}' loaded from file '{filename}' is "
+                        "not present in ParameterDict")
+                continue
+            param = self._params[name]
+            if param._data is None and param._deferred_init:
+                init, pctx, default_init, _ = param._deferred_init
+                param.shape = arg_dict[name].shape
+                param._deferred_init = (init, pctx if ctx is None else
+                                        ([ctx] if isinstance(ctx, Context)
+                                         else ctx), default_init,
+                                        arg_dict[name])
+                param._finish_deferred_init()
+            elif param._data is None:
+                param.shape = arg_dict[name].shape
+                param.initialize(ctx=ctx or [cpu()])
+                param.set_data(arg_dict[name])
+            else:
+                param.set_data(arg_dict[name])

@@ -1,0 +1,83 @@
+"""Gluon utilities (reference `python/mxnet/gluon/utils.py`)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context, cpu
+from ..ndarray.ndarray import NDArray, array
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split along batch axis (reference `utils.py split_data`)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}. Use a batch size "
+            f"that's a multiple of {num_slice} or set even_split=False.")
+    n_each = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * n_each
+        end = (i + 1) * n_each if i < num_slice - 1 else size
+        sl = [slice(None)] * data.ndim
+        sl[batch_axis] = slice(begin, end)
+        slices.append(data[tuple(sl)])
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split + place each shard on its context (reference `utils.py`)."""
+    if not isinstance(data, NDArray):
+        data = array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale so total L2 norm <= max_norm (reference `utils.py`)."""
+    assert len(arrays) > 0
+    ctx = arrays[0].context
+    total_norm_sq = 0.0
+    for arr in arrays:
+        a = arr.asnumpy().astype(np.float64)
+        total_norm_sq += float((a * a).sum())
+    total_norm = math.sqrt(total_norm_sq)
+    if check_isfinite and not math.isfinite(total_norm):
+        import warnings
+        warnings.warn(UserWarning(
+            "nan or inf is detected. Clipping results will be undefined."),
+            stacklevel=2)
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr._set_data(arr._data * scale)
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True):
+    """Reference `utils.py download` — this environment has zero egress."""
+    raise MXNetError(
+        "download() is unavailable: this environment has no network access. "
+        "Place files manually and point APIs at the local path.")

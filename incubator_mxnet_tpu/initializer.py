@@ -26,6 +26,11 @@ class InitDesc(str):
 def register(klass):
     name = klass.__name__.lower()
     _INIT_REGISTRY[name] = klass
+    # reference registers plural aliases for Zero/One
+    if name == "zero":
+        _INIT_REGISTRY["zeros"] = klass
+    if name == "one":
+        _INIT_REGISTRY["ones"] = klass
     return klass
 
 

@@ -21,3 +21,4 @@ from . import loss_output   # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import linalg_ops    # noqa: F401
 from . import contrib_ops   # noqa: F401
+from . import ctc           # noqa: F401

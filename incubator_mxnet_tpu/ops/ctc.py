@@ -1,0 +1,84 @@
+"""CTC loss (reference `src/operator/contrib/ctc_loss.cc` over bundled
+warpctc).
+
+TPU-native: the CTC forward (alpha) recursion in log space as a `lax.scan`
+over time — fully jax-traceable, so the gradient comes from autodiff of the
+log-sum-exp recursion (warpctc's hand-written backward is the same quantity).
+blank = 0 ('first', the MXNet default).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+NEG = -1e30
+
+
+def _ctc_single(logp, labels, input_len, label_len):
+    """loss for one sequence.  logp: (T, C) log-probs; labels: (L,) int32."""
+    T, C = logp.shape
+    L = labels.shape[0]
+    S = 2 * L + 1
+    # extended label sequence [blank, l0, blank, l1, ..., blank]
+    ext = jnp.zeros((S,), dtype=jnp.int32)
+    ext = ext.at[1::2].set(labels)
+    s_idx = jnp.arange(S)
+    valid_s = s_idx < (2 * label_len + 1)
+
+    # can skip from s-2 if ext[s] != blank and ext[s] != ext[s-2]
+    ext_m2 = jnp.concatenate([jnp.full((2,), -1, jnp.int32), ext[:-2]])
+    can_skip = (ext != 0) & (ext != ext_m2)
+
+    alpha0 = jnp.full((S,), NEG)
+    alpha0 = alpha0.at[0].set(logp[0, 0])
+    alpha0 = alpha0.at[1].set(jnp.where(label_len > 0, logp[0, ext[1]], NEG))
+
+    def step(alpha, t):
+        a0 = alpha
+        a1 = jnp.concatenate([jnp.full((1,), NEG), alpha[:-1]])
+        a2 = jnp.where(can_skip,
+                       jnp.concatenate([jnp.full((2,), NEG), alpha[:-2]]),
+                       NEG)
+        m = jnp.maximum(jnp.maximum(a0, a1), a2)
+        new = m + jnp.log(jnp.exp(a0 - m) + jnp.exp(a1 - m) + jnp.exp(a2 - m))
+        new = new + logp[t, ext]
+        new = jnp.where(valid_s, new, NEG)
+        # freeze beyond input_len
+        new = jnp.where(t < input_len, new, alpha)
+        return new, None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+    end1 = alpha[jnp.maximum(2 * label_len, 0)]
+    end2 = jnp.where(label_len > 0, alpha[jnp.maximum(2 * label_len - 1, 0)],
+                     NEG)
+    m = jnp.maximum(end1, end2)
+    ll = m + jnp.log(jnp.exp(end1 - m) + jnp.exp(end2 - m))
+    return -ll
+
+
+@register("ctc_loss", nin=-1,
+          aliases=("CTCLoss", "_contrib_ctc_loss", "_contrib_CTCLoss"),
+          params={"use_data_lengths": False, "use_label_lengths": False,
+                  "blank_label": "first"})
+def _ctc_loss(params, data, label, *rest):
+    """data: (T, N, C) activations (softmax applied internally, as warpctc);
+    label: (N, L) padded with 0; optional data_lengths (N,), label_lengths (N,)."""
+    T, N, C = data.shape
+    logp = jax.nn.log_softmax(data, axis=-1)
+    idx = 0
+    if params["use_data_lengths"]:
+        data_lens = rest[idx].astype("int32")
+        idx += 1
+    else:
+        data_lens = jnp.full((N,), T, jnp.int32)
+    labels = label.astype("int32")
+    if params["use_label_lengths"]:
+        label_lens = rest[idx].astype("int32")
+    else:
+        # padding value 0 terminates the label (blank_label='first')
+        label_lens = jnp.sum((labels > 0).astype(jnp.int32), axis=1)
+
+    logp_n = jnp.swapaxes(logp, 0, 1)  # (N, T, C)
+    return jax.vmap(_ctc_single)(logp_n, labels, data_lens, label_lens)

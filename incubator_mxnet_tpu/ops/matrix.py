@@ -324,6 +324,31 @@ def _index_nd(params, x, idx):
     return x[idx.astype("int32")]
 
 
+@register("reshape_like", nin=2, params={"lhs_begin": None, "lhs_end": None,
+                                         "rhs_begin": None, "rhs_end": None})
+def _reshape_like(params, lhs, rhs):
+    """Reference matrix_op.cc reshape_like."""
+    return jnp.reshape(lhs, rhs.shape)
+
+
+@register("pick", nin=2, params={"axis": -1, "keepdims": False, "mode": "clip"})
+def _pick(params, data, index):
+    """Reference broadcast_reduce_op_index.cc pick: select one element along
+    axis per position of index."""
+    axis = int(params["axis"]) % data.ndim
+    idx = index.astype("int32")
+    n = data.shape[axis]
+    if params["mode"] == "wrap":
+        idx = jnp.mod(idx, n)
+    else:
+        idx = jnp.clip(idx, 0, n - 1)
+    idx_exp = jnp.expand_dims(idx, axis)
+    out = jnp.take_along_axis(data, idx_exp, axis=axis)
+    if params["keepdims"]:
+        return out
+    return jnp.squeeze(out, axis)
+
+
 @register("where", nin=3)
 def _where(params, cond, x, y):
     return jnp.where(cond != 0, x, y)

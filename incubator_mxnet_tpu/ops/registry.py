@@ -171,6 +171,12 @@ def register(name, **kwargs):
     return deco
 
 
+def register_opdef(op):
+    """Register a dynamically-created OpDef (CachedOp graphs)."""
+    _REGISTRY[op.name] = op
+    return op
+
+
 def get(name) -> OpDef:
     try:
         return _REGISTRY[name]

@@ -583,10 +583,14 @@ def _infer_graph(symbol, shapes, partial):
     known = {}
     for n in topo:
         if n.is_variable:
+            cand = None
             if n.name in shapes:
-                known[n.name] = tuple(shapes[n.name])
+                cand = tuple(shapes[n.name])
             elif "__shape__" in n._extra_attrs:
-                known[n.name] = tuple(n._extra_attrs["__shape__"])
+                cand = tuple(n._extra_attrs["__shape__"])
+            # shapes containing 0 are "unknown dims" (deferred init) — solve
+            if cand is not None and all(d > 0 for d in cand):
+                known[n.name] = cand
 
     # forward abstract interpretation with on-demand variable shape solving:
     # variables without shapes get inferred where unambiguous (weight shapes

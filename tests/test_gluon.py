@@ -1,0 +1,286 @@
+"""Gluon tests (reference tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd, gluon
+from incubator_mxnet_tpu.gluon import nn
+
+
+def test_parameter_basics():
+    p = gluon.Parameter("weight", shape=(4, 3))
+    p.initialize(init=mx.initializer.Xavier(), ctx=mx.cpu())
+    assert p.data().shape == (4, 3)
+    assert p.grad().shape == (4, 3)
+    assert p.list_ctx() == [mx.cpu()]
+    p.zero_grad()
+    assert (p.grad().asnumpy() == 0).all()
+
+
+def test_parameter_deferred_init():
+    p = gluon.Parameter("w", shape=(4, 0), allow_deferred_init=True)
+    p.initialize(ctx=mx.cpu())
+    with pytest.raises(gluon.DeferredInitializationError):
+        p.data()
+    p.shape = (4, 7)
+    p._finish_deferred_init()
+    assert p.data().shape == (4, 7)
+
+
+def test_dense_eager_and_shape_inference():
+    net = nn.Dense(5)
+    net.initialize()
+    x = nd.random.uniform(shape=(3, 8))
+    out = net(x)
+    assert out.shape == (3, 5)
+    assert net.weight.shape == (5, 8)  # inferred from input
+
+
+def test_sequential_train_eager():
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(2))
+    net.initialize(mx.initializer.Xavier())
+    X = nd.array(np.random.randn(64, 10).astype("f4"))
+    y_true = nd.array((np.random.randn(64) > 0).astype("f4"))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    losses = []
+    for _ in range(40):
+        with autograd.record():
+            out = net(X)
+            loss = loss_fn(out, y_true)
+        loss.backward()
+        trainer.step(64)
+        losses.append(float(loss.asnumpy().mean()))
+    assert losses[-1] < losses[0] * 0.7, losses[:3] + losses[-3:]
+
+
+def test_hybridize_matches_eager():
+    np.random.seed(1)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(mx.initializer.Xavier())
+    x = nd.array(np.random.rand(5, 12).astype("f4"))
+    eager_out = net(x).asnumpy()
+    net.hybridize()
+    hybrid_out = net(x).asnumpy()
+    np.testing.assert_allclose(eager_out, hybrid_out, rtol=1e-5)
+    # gradients flow through the cached op
+    for p in net.collect_params().values():
+        p.zero_grad()
+    with autograd.record():
+        out = net(x)
+        loss = nd.sum(out * out)
+    loss.backward()
+    w0 = list(net.collect_params().values())[0]
+    assert np.abs(w0.grad().asnumpy()).sum() > 0
+
+
+def test_hybridize_deferred_init():
+    """Hybridized net with no explicit in_units: shapes inferred at first call."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize()
+    net.hybridize()
+    out = net(nd.ones((2, 6)))
+    assert out.shape == (2, 3)
+    assert net[0].weight.shape == (8, 6)
+
+
+def test_hybridize_batchnorm_updates_running_stats():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.BatchNorm())
+    net.initialize()
+    net.hybridize()
+    x = nd.random.uniform(1, 2, shape=(16, 6))
+    with autograd.record():
+        net(x)
+    bn = net[1]
+    rm = bn.running_mean.data().asnumpy()
+    assert np.abs(rm).sum() > 0  # moving mean moved away from zero
+
+
+def test_conv_block_and_pooling():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, kernel_size=3, padding=1, activation="relu"),
+            nn.MaxPool2D(2, 2),
+            nn.Conv2D(16, kernel_size=3, padding=1),
+            nn.GlobalAvgPool2D(),
+            nn.Flatten(),
+            nn.Dense(10))
+    net.initialize()
+    out = net(nd.random.uniform(shape=(2, 3, 16, 16)))
+    assert out.shape == (2, 10)
+    assert net[0].weight.shape == (8, 3, 3, 3)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    net.initialize()
+    net(nd.ones((1, 6)))
+    fname = str(tmp_path / "net.params")
+    net.save_parameters(fname)
+
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(4), nn.Dense(2))
+    net2.load_parameters(fname)
+    out1 = net(nd.ones((3, 6))).asnumpy()
+    out2 = net2(nd.ones((3, 6))).asnumpy()
+    np.testing.assert_allclose(out1, out2, rtol=1e-6)
+
+
+def test_losses():
+    pred = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    label = nd.array([[1.5, 2.5], [2.5, 3.5]])
+    l2 = gluon.loss.L2Loss()
+    np.testing.assert_allclose(l2(pred, label).asnumpy(), [0.125, 0.125],
+                               rtol=1e-5)
+    l1 = gluon.loss.L1Loss()
+    np.testing.assert_allclose(l1(pred, label).asnumpy(), [0.5, 0.5],
+                               rtol=1e-5)
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    out = sce(nd.array([[10.0, 0.0]]), nd.array([0.0]))
+    assert out.asnumpy()[0] < 0.001
+    bce = gluon.loss.SigmoidBCELoss()
+    out = bce(nd.array([[10.0]]), nd.array([[1.0]]))
+    assert out.asnumpy()[0] < 0.001
+    huber = gluon.loss.HuberLoss()
+    np.testing.assert_allclose(
+        huber(nd.array([[0.5]]), nd.array([[0.0]])).asnumpy(), [0.125],
+        rtol=1e-5)
+    hinge = gluon.loss.HingeLoss()
+    np.testing.assert_allclose(
+        hinge(nd.array([[0.5]]), nd.array([[1.0]])).asnumpy(), [0.5],
+        rtol=1e-5)
+
+
+def test_ctc_loss():
+    """CTC loss sanity: perfect prediction ≈ low loss (reference test_loss)."""
+    T, N, C = 10, 2, 5
+    pred = np.full((N, T, C), -10.0, dtype="f4")
+    labels = np.array([[1, 2, 3, 0], [2, 4, 0, 0]], dtype="f4")
+    # make the aligned path very likely: l1 b l2 b ...
+    for n, seq in enumerate([[1, 1, 2, 2, 3, 3, 0, 0, 0, 0],
+                             [2, 2, 4, 4, 0, 0, 0, 0, 0, 0]]):
+        for t, c in enumerate(seq):
+            pred[n, t, c] = 10.0
+    ctc = gluon.loss.CTCLoss(layout="NTC", label_layout="NT")
+    loss = ctc(nd.array(pred), nd.array(labels))
+    assert loss.shape == (N,)
+    assert (loss.asnumpy() < 2.0).all(), loss.asnumpy()
+
+
+def test_lstm_layer_and_cells():
+    lstm = gluon.rnn.LSTM(hidden_size=8, num_layers=2)
+    lstm.initialize()
+    x = nd.random.uniform(shape=(5, 3, 6))  # TNC
+    out = lstm(x)
+    assert out.shape == (5, 3, 8)
+    # with states
+    states = lstm.begin_state(batch_size=3)
+    out, new_states = lstm(x, states)
+    assert out.shape == (5, 3, 8)
+    assert new_states[0].shape == (2, 3, 8)
+
+    cell = gluon.rnn.LSTMCell(hidden_size=8)
+    cell.initialize()
+    outputs, states = cell.unroll(5, x.transpose(axes=(1, 0, 2)),
+                                  layout="NTC")
+    assert len(outputs) == 5
+    assert outputs[0].shape == (3, 8)
+
+
+def test_gru_bidirectional():
+    gru = gluon.rnn.GRU(hidden_size=4, num_layers=1, bidirectional=True)
+    gru.initialize()
+    x = nd.random.uniform(shape=(7, 2, 5))
+    out = gru(x)
+    assert out.shape == (7, 2, 8)
+
+
+def test_sequential_rnn_cells():
+    stack = gluon.rnn.SequentialRNNCell()
+    stack.add(gluon.rnn.LSTMCell(hidden_size=8))
+    stack.add(gluon.rnn.GRUCell(hidden_size=4))
+    stack.initialize()
+    x = nd.random.uniform(shape=(2, 6, 10))
+    outputs, states = stack.unroll(6, x, layout="NTC")
+    assert outputs[-1].shape == (2, 4)
+
+
+def test_dataloader_and_dataset():
+    X = np.random.rand(20, 3).astype("f4")
+    y = np.arange(20).astype("f4")
+    dataset = gluon.data.ArrayDataset(X, y)
+    assert len(dataset) == 20
+    loader = gluon.data.DataLoader(dataset, batch_size=5)
+    batches = list(loader)
+    assert len(batches) == 4
+    data, label = batches[0]
+    assert data.shape == (5, 3)
+    np.testing.assert_allclose(label.asnumpy(), y[:5])
+    # shuffled, threaded
+    loader = gluon.data.DataLoader(dataset, batch_size=5, shuffle=True,
+                                   num_workers=2)
+    seen = np.concatenate([b[1].asnumpy() for b in loader])
+    assert sorted(seen) == sorted(y)
+
+
+def test_transforms_and_synthetic_dataset():
+    from incubator_mxnet_tpu.gluon.data.vision import (SyntheticImageDataset,
+                                                       transforms)
+    ds = SyntheticImageDataset(num_samples=32, shape=(8, 8, 3))
+    tf = transforms.Compose([transforms.ToTensor(),
+                             transforms.Normalize(0.5, 0.5)])
+    ds_t = ds.transform_first(tf)
+    img, label = ds_t[0]
+    assert img.shape == (3, 8, 8)
+    loader = gluon.data.DataLoader(ds_t, batch_size=8)
+    data, labels = next(iter(loader))
+    assert data.shape == (8, 3, 8, 8)
+
+
+def test_model_zoo_smoke():
+    from incubator_mxnet_tpu.gluon.model_zoo import get_model
+    for name, shape in [("resnet18_v1", (1, 3, 32, 32)),
+                        ("resnet18_v2", (1, 3, 32, 32)),
+                        ("squeezenet1.1", (1, 3, 64, 64)),
+                        ("mobilenet0.25", (1, 3, 32, 32))]:
+        net = get_model(name, classes=10)
+        net.initialize()
+        out = net(nd.random.uniform(shape=shape))
+        assert out.shape == (1, 10), name
+
+
+def test_split_and_load():
+    data = nd.arange(0, 16).reshape((8, 2))
+    parts = gluon.split_and_load(data, [mx.cpu(), mx.cpu()])
+    assert len(parts) == 2 and parts[0].shape == (4, 2)
+
+
+def test_clip_global_norm():
+    arrays = [nd.ones((2, 2)) * 3, nd.ones((3,)) * 4]
+    norm = gluon.utils.clip_global_norm(arrays, 1.0)
+    total = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    assert abs(total - 1.0) < 1e-5
+    assert norm > 1.0
+
+
+def test_symbol_block(tmp_path):
+    """export + SymbolBlock.imports round trip (reference block.py:986)."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, activation="relu"), nn.Dense(2))
+    net.initialize()
+    net.hybridize()
+    x = nd.ones((1, 6))
+    ref = net(x).asnumpy()
+    path = str(tmp_path / "model")
+    net.export(path)
+    net2 = gluon.SymbolBlock.imports(path + "-symbol.json", "data",
+                                     path + "-0000.params")
+    out = net2(x).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
