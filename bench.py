@@ -1,0 +1,114 @@
+"""Benchmark: ResNet-50 training throughput (img/sec) on one chip.
+
+Baseline (BASELINE.md): reference MXNet ResNet-50 *training* at 363.69
+img/sec on V100, batch 128 (`docs/faq/perf.md:205-224`).  The whole train
+step — forward, backward, SGD-momentum update, BatchNorm stat updates — is
+ONE donated XLA program, which is the framework's flagship execution path
+(hybridized graph → single compiled computation).
+
+Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Env overrides: BENCH_BATCH (default 128), BENCH_IMAGE (224), BENCH_STEPS (20),
+BENCH_DTYPE (float32|bfloat16).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 363.69  # reference ResNet-50 training, V100 bs=128
+
+
+def build_train_step(batch, image, dtype):
+    import jax
+    import jax.numpy as jnp
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd
+    from incubator_mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+    from incubator_mxnet_tpu.symbol.symbol import graph_eval_fn
+
+    mx.random.seed(0)
+    net = resnet50_v1(classes=1000)
+    net.initialize(mx.initializer.Xavier())
+    x = nd.random.uniform(shape=(batch, 3, image, image))
+    net.hybridize()
+    net(x)
+    cg = net._cached_graph
+    gfn = graph_eval_fn(cg.symbol, True)[0]
+
+    all_params = {p.name: p for p in net.collect_params().values()}
+    data_name = cg.data_names[0]
+    arg_names = [n for n in cg.arg_names if n != data_name]
+    key = jax.random.PRNGKey(0)
+
+    def cast(a):
+        return a.astype(dtype) if a.dtype == np.float32 and \
+            dtype != "float32" else a
+
+    weights = {n: cast(all_params[n].data()._data) for n in arg_names}
+    moms = {n: jnp.zeros_like(w) for n, w in weights.items()}
+    auxs = [all_params[n].data()._data for n in cg.aux_names]
+
+    def loss_fn(w, img, label, aux):
+        args = []
+        it = iter(cg.arg_names)
+        args = tuple(img if n == data_name else w[n] for n in cg.arg_names)
+        outs, new_aux = gfn(args, tuple(aux), key)
+        logits = outs[0].astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits)
+        ll = jnp.take_along_axis(logp, label[:, None], -1)
+        return -jnp.mean(ll), new_aux
+
+    @jax.jit
+    def train_step(w, m, aux, img, label, lr):
+        (loss, new_aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(w, img, label, aux)
+        new_w = {}
+        new_m = {}
+        for n in w:
+            g = grads[n].astype(w[n].dtype)
+            mom = 0.9 * m[n] - lr * g
+            new_m[n] = mom
+            new_w[n] = w[n] + mom
+        return new_w, new_m, list(new_aux), loss
+
+    train_step_d = jax.jit(train_step.__wrapped__, donate_argnums=(0, 1, 2))
+    img = jnp.asarray(np.random.rand(batch, 3, image, image), dtype)
+    label = jnp.asarray(np.random.randint(0, 1000, batch), jnp.int32)
+    return train_step_d, weights, moms, auxs, img, label
+
+
+def main():
+    batch = int(os.environ.get("BENCH_BATCH", 128))
+    image = int(os.environ.get("BENCH_IMAGE", 224))
+    steps = int(os.environ.get("BENCH_STEPS", 20))
+    dtype = os.environ.get("BENCH_DTYPE", "float32")
+
+    import jax
+    step, w, m, aux, img, label = build_train_step(batch, image, dtype)
+    lr = jax.numpy.float32(0.05)
+
+    # warmup (compile + 2 steady steps)
+    for _ in range(3):
+        w, m, aux, loss = step(w, m, aux, img, label, lr)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        w, m, aux, loss = step(w, m, aux, img, label, lr)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = batch * steps / dt
+    print(json.dumps({
+        "metric": "resnet50_train_img_per_sec",
+        "value": round(img_per_sec, 2),
+        "unit": "img/sec/chip",
+        "vs_baseline": round(img_per_sec / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

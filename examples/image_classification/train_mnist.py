@@ -1,0 +1,107 @@
+"""Train LeNet/MLP on MNIST via mx.mod.Module — BASELINE config #1
+(reference `example/image-classification/train_mnist.py`).
+
+Uses real MNIST idx files when --data-dir has them; otherwise falls back to
+the deterministic synthetic MNIST stand-in (zero-egress environment).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import sym
+from incubator_mxnet_tpu.io import NDArrayIter, MNISTIter
+
+
+def get_mlp():
+    data = sym.Variable("data")
+    data = sym.Flatten(data)
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=128)
+    act1 = sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = sym.FullyConnected(act1, name="fc2", num_hidden=64)
+    act2 = sym.Activation(fc2, name="relu2", act_type="relu")
+    fc3 = sym.FullyConnected(act2, name="fc3", num_hidden=10)
+    return sym.SoftmaxOutput(fc3, name="softmax")
+
+
+def get_lenet():
+    data = sym.Variable("data")
+    conv1 = sym.Convolution(data, kernel=(5, 5), num_filter=20)
+    tanh1 = sym.Activation(conv1, act_type="tanh")
+    pool1 = sym.Pooling(tanh1, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    conv2 = sym.Convolution(pool1, kernel=(5, 5), num_filter=50)
+    tanh2 = sym.Activation(conv2, act_type="tanh")
+    pool2 = sym.Pooling(tanh2, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    flatten = sym.Flatten(pool2)
+    fc1 = sym.FullyConnected(flatten, num_hidden=500)
+    tanh3 = sym.Activation(fc1, act_type="tanh")
+    fc2 = sym.FullyConnected(tanh3, num_hidden=10)
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def get_iters(args):
+    img = os.path.join(args.data_dir, "train-images-idx3-ubyte")
+    if os.path.exists(img) or os.path.exists(img + ".gz"):
+        train = MNISTIter(image=img,
+                          label=os.path.join(args.data_dir,
+                                             "train-labels-idx1-ubyte"),
+                          batch_size=args.batch_size, shuffle=True)
+        val = MNISTIter(image=os.path.join(args.data_dir,
+                                           "t10k-images-idx3-ubyte"),
+                        label=os.path.join(args.data_dir,
+                                           "t10k-labels-idx1-ubyte"),
+                        batch_size=args.batch_size, shuffle=False)
+        return train, val
+    logging.warning("MNIST files not found in %s; using synthetic data",
+                    args.data_dir)
+    from incubator_mxnet_tpu.test_utils import get_mnist_like
+    X, y = get_mnist_like(4096)
+    train = NDArrayIter(X[:3584], y[:3584], args.batch_size, shuffle=True)
+    val = NDArrayIter(X[3584:], y[3584:], args.batch_size)
+    return train, val
+
+
+def main():
+    parser = argparse.ArgumentParser(description="train mnist")
+    parser.add_argument("--network", default="mlp", choices=["mlp", "lenet"])
+    parser.add_argument("--data-dir", default="data/mnist/")
+    parser.add_argument("--num-epochs", type=int, default=10)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--momentum", type=float, default=0.9)
+    parser.add_argument("--kv-store", default="local")
+    parser.add_argument("--gpus", default=None,
+                        help="comma-separated accelerator ids, e.g. 0 or 0,1")
+    parser.add_argument("--model-prefix", default=None)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    net = get_mlp() if args.network == "mlp" else get_lenet()
+    train, val = get_iters(args)
+    if args.gpus:
+        ctx = [mx.tpu(int(i)) for i in args.gpus.split(",")]
+    else:
+        ctx = mx.cpu()
+    mod = mx.mod.Module(net, context=ctx)
+    cb = [mx.callback.Speedometer(args.batch_size, 50)]
+    ep = [mx.callback.do_checkpoint(args.model_prefix)] \
+        if args.model_prefix else None
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr,
+                              "momentum": args.momentum},
+            initializer=mx.initializer.Xavier(),
+            kvstore=args.kv_store,
+            num_epoch=args.num_epochs,
+            batch_end_callback=cb, epoch_end_callback=ep)
+    score = mod.score(val, "acc")
+    print("final validation accuracy:", score[0][1])
+    return score[0][1]
+
+
+if __name__ == "__main__":
+    main()

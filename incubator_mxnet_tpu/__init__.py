@@ -40,7 +40,8 @@ for _mod_name, _aliases in [
     ("kvstore", ("kv",)), ("callback", ()), ("monitor", ()),
     ("io", ()), ("recordio", ()), ("gluon", ()), ("module", ("mod",)),
     ("model", ()), ("profiler", ()), ("visualization", ("viz",)),
-    ("parallel", ()), ("test_utils", ()), ("image", ()),
+    ("parallel", ()), ("test_utils", ()), ("image", ()), ("operator", ()),
+    ("contrib", ()),
 ]:
     try:
         _m = _importlib.import_module("." + _mod_name, __name__)

@@ -1,0 +1,3 @@
+"""`mx.contrib` (reference `python/mxnet/contrib/`)."""
+from . import quantization  # noqa: F401
+from . import text          # noqa: F401

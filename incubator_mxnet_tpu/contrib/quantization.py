@@ -1,0 +1,141 @@
+"""INT8 model quantization (reference `python/mxnet/contrib/quantization.py`
+`quantize_model:412` + C++ `quantize_graph_pass.cc`).
+
+Graph rewrite: walk a Symbol and replace quantizable FullyConnected nodes
+with quantize → int8 matmul → dequantize chains; weights are pre-quantized
+into the returned params with their ranges.  Calibration: 'none' (dynamic
+per-batch ranges) or 'naive' (min/max over calibration batches).  INT8
+matmuls lower through XLA's integer dot support on TPU.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+
+QUANTIZABLE = {"FullyConnected"}
+
+
+def _collect_calib_ranges(sym, arg_params, aux_params, calib_data,
+                          num_batches, ctx):
+    """fp32 forward over calibration batches, recording per-output min/max."""
+    internals = sym.get_internals()
+    ranges = {}
+    exe = None
+    for i, batch in enumerate(calib_data):
+        if i >= num_batches:
+            break
+        data = batch.data[0]
+        if exe is None:
+            exe = internals.simple_bind(ctx=ctx, grad_req="null",
+                                        data=data.shape)
+            exe.copy_params_from(arg_params, aux_params,
+                                 allow_extra_params=True)
+        outs = exe.forward(is_train=False, data=data)
+        for name, out in zip(internals.list_outputs(), outs):
+            a = out.asnumpy()
+            mn, mx = float(a.min()), float(a.max())
+            if name in ranges:
+                omn, omx = ranges[name]
+                ranges[name] = (min(mn, omn), max(mx, omx))
+            else:
+                ranges[name] = (mn, mx)
+    return ranges
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   label_names=("softmax_label",), ctx=None,
+                   excluded_sym_names=None, calib_mode="none",
+                   calib_data=None, num_calib_examples=None,
+                   quantized_dtype="int8", logger=logging):
+    """Reference `quantization.py:412 quantize_model` →
+    (quantized symbol, new arg_params, aux_params)."""
+    import jax.numpy as jnp
+    from ..symbol.symbol import Symbol, _Node, _sym_apply
+    from ..symbol import Variable
+    from ..ndarray.ndarray import NDArray
+    from ..context import cpu
+
+    excluded = set(excluded_sym_names or [])
+    ctx = ctx or cpu()
+
+    if calib_mode not in ("none", "naive"):
+        raise MXNetError("calib_mode must be 'none' or 'naive' "
+                         "(KL/entropy calibration: future round)")
+    calib_ranges = {}
+    if calib_mode == "naive":
+        if calib_data is None:
+            raise MXNetError("calib_data required for calib_mode='naive'")
+        nb = max(1, (num_calib_examples or 32) // calib_data.batch_size)
+        calib_ranges = _collect_calib_ranges(sym, arg_params, aux_params,
+                                             calib_data, nb, ctx)
+
+    new_args = dict(arg_params)
+    memo = {}
+
+    def transform(node):
+        """Rebuild the graph bottom-up, returning a Symbol per node."""
+        if id(node) in memo:
+            return memo[id(node)]
+        if node.is_variable:
+            out = Symbol([(node, 0)])
+            memo[id(node)] = out
+            return out
+        in_syms = []
+        for src, idx in node.inputs:
+            s = transform(src)
+            in_syms.append(s[idx] if len(s._entries) > 1 else s)
+
+        if node.op.name in QUANTIZABLE and node.name not in excluded:
+            data_s, weight_s = in_syms[0], in_syms[1]
+            bias_s = in_syms[2] if len(in_syms) > 2 else None
+            wname = node.inputs[1][0].name
+            w = arg_params[wname].asnumpy()
+            wmax = float(np.abs(w).max()) or 1e-8
+            qw = np.clip(np.round(w / wmax * 127), -127, 127).astype(np.int8)
+            new_args[wname] = NDArray(jnp.asarray(qw), ctx=ctx)
+            new_args[wname + "_min"] = nd.array([-wmax])
+            new_args[wname + "_max"] = nd.array([wmax])
+
+            qdata = _sym_apply("_contrib_quantize_v2", [data_s],
+                               {"out_type": quantized_dtype,
+                                **_calib_kwargs(calib_ranges, node)})
+            qfc = _sym_apply(
+                "_contrib_quantized_fully_connected",
+                [qdata[0], weight_s, qdata[1], qdata[2],
+                 Variable(wname + "_min"), Variable(wname + "_max")],
+                {"num_hidden": node.attrs["num_hidden"], "no_bias": True,
+                 "flatten": node.attrs.get("flatten", True)})
+            out = _sym_apply("_contrib_dequantize",
+                             [qfc[0], qfc[1], qfc[2]], {})
+            if bias_s is not None:
+                out = out + _sym_apply("Reshape", [bias_s], {"shape": (1, -1)})
+            memo[id(node)] = out
+            return out
+
+        new_node = _Node(node.op, node.name, node.attrs,
+                         [s._entries[0] for s in in_syms])
+        new_node._extra_attrs = dict(node._extra_attrs)
+        nout = new_node.num_outputs()
+        out = Symbol([(new_node, i) for i in range(nout)])
+        memo[id(node)] = out
+        return out
+
+    out_entries = []
+    for node, idx in sym._entries:
+        s = transform(node)
+        out_entries.append(s._entries[min(idx, len(s._entries) - 1)])
+    qsym = Symbol(out_entries)
+    return qsym, new_args, dict(aux_params)
+
+
+def _calib_kwargs(ranges, node):
+    src = node.inputs[0][0]
+    key = f"{src.name}_output"
+    if key in ranges:
+        mn, mx = ranges[key]
+        return {"min_calib_range": mn, "max_calib_range": mx}
+    return {}

@@ -1,0 +1,114 @@
+"""Text vocab/embedding utilities (reference `python/mxnet/contrib/text/`).
+
+Vocabulary + token indexing; pretrained embedding download is unavailable
+(zero egress) but `CustomEmbedding` loads local files.
+"""
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+
+
+class Vocabulary:
+    """Token vocabulary (reference `contrib/text/vocab.py`)."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        assert min_freq > 0
+        self._unknown_token = unknown_token
+        reserved_tokens = list(reserved_tokens or [])
+        self._idx_to_token = [unknown_token] + reserved_tokens
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+        if counter is not None:
+            pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+            if most_freq_count is not None:
+                pairs = pairs[:most_freq_count]
+            for tok, freq in pairs:
+                if freq >= min_freq and tok not in self._token_to_idx:
+                    self._token_to_idx[tok] = len(self._idx_to_token)
+                    self._idx_to_token.append(tok)
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    def to_indices(self, tokens):
+        single = isinstance(tokens, str)
+        if single:
+            tokens = [tokens]
+        out = [self._token_to_idx.get(t, 0) for t in tokens]
+        return out[0] if single else out
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        if single:
+            indices = [indices]
+        out = [self._idx_to_token[i] for i in indices]
+        return out[0] if single else out
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Reference `contrib/text/utils.py count_tokens_from_str`."""
+    if to_lower:
+        source_str = source_str.lower()
+    counter = counter_to_update if counter_to_update is not None \
+        else collections.Counter()
+    for seq in source_str.split(seq_delim):
+        counter.update(t for t in seq.split(token_delim) if t)
+    return counter
+
+
+class CustomEmbedding:
+    """Token embedding from a local file of 'token v1 v2 ...' lines
+    (reference `contrib/text/embedding.py CustomEmbedding`)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ", encoding="utf8",
+                 vocabulary=None):
+        tokens = []
+        vecs = []
+        with open(pretrained_file_path, encoding=encoding) as f:
+            for line in f:
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) < 2:
+                    continue
+                tokens.append(parts[0])
+                vecs.append([float(x) for x in parts[1:]])
+        dim = len(vecs[0])
+        self._token_to_idx = {}
+        rows = [np.zeros(dim, dtype="float32")]  # unk row
+        self._idx_to_token = ["<unk>"]
+        for tok, vec in zip(tokens, vecs):
+            if vocabulary is not None and tok not in vocabulary.token_to_idx:
+                continue
+            self._token_to_idx[tok] = len(self._idx_to_token)
+            self._idx_to_token.append(tok)
+            rows.append(np.asarray(vec, dtype="float32"))
+        self._mat = np.stack(rows)
+
+    @property
+    def vec_len(self):
+        return self._mat.shape[1]
+
+    def get_vecs_by_tokens(self, tokens):
+        single = isinstance(tokens, str)
+        if single:
+            tokens = [tokens]
+        idx = [self._token_to_idx.get(t, 0) for t in tokens]
+        out = nd.array(self._mat[idx])
+        return out[0] if single else out

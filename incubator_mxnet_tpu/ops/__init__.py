@@ -22,3 +22,6 @@ from . import optimizer_ops  # noqa: F401
 from . import linalg_ops    # noqa: F401
 from . import contrib_ops   # noqa: F401
 from . import ctc           # noqa: F401
+from . import detection     # noqa: F401
+from . import spatial       # noqa: F401
+from . import quantization  # noqa: F401

@@ -1,0 +1,362 @@
+"""Object-detection operators (reference `src/operator/contrib/` —
+multibox_prior.cc, multibox_target.cc, multibox_detection.cc,
+bounding_box.cc box_nms/box_iou, roi_align.cc; legacy `roi_pooling.cc`).
+
+These feed the SSD config (BASELINE config #5).  All are jax-traceable with
+static shapes: NMS keeps a fixed-size output with -1 padding (the reference
+does the same), matching semantics over XLA-friendly dense math.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register, REQUIRED
+
+
+def _parse_floats(v, default):
+    if v is None or v == ():
+        return tuple(default)
+    if isinstance(v, (int, float)):
+        return (float(v),)
+    return tuple(float(x) for x in v)
+
+
+@register("_contrib_MultiBoxPrior", aliases=("MultiBoxPrior",),
+          params={"sizes": (1.0,), "ratios": (1.0,), "clip": False,
+                  "steps": (-1.0, -1.0), "offsets": (0.5, 0.5)})
+def _multibox_prior(params, data):
+    """Anchor generation (reference multibox_prior-inl.h): per feature-map
+    cell, anchors for (sizes[0], r) x ratios plus extra sizes at ratio 1."""
+    sizes = _parse_floats(params["sizes"], [1.0])
+    ratios = _parse_floats(params["ratios"], [1.0])
+    offsets = _parse_floats(params["offsets"], [0.5, 0.5])
+    steps = _parse_floats(params["steps"], [-1.0, -1.0])
+    h, w = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+
+    cy = (jnp.arange(h) + offsets[0]) * step_y
+    cx = (jnp.arange(w) + offsets[1]) * step_x
+    cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), axis=-1)  # (h,w,2)
+
+    # anchor list: (size[0], ratio[0]..), then (size[1:], ratio[0])
+    whs = []
+    for r in ratios:
+        sr = np.sqrt(r)
+        whs.append((sizes[0] * sr, sizes[0] / sr))
+    for s in sizes[1:]:
+        sr = np.sqrt(ratios[0])
+        whs.append((s * sr, s / sr))
+    whs = jnp.asarray(whs)  # (A, 2) of (w, h)
+    na = whs.shape[0]
+
+    cxy = jnp.stack([cyx[..., 1], cyx[..., 0]], axis=-1)  # (h, w, 2) x,y
+    cxy = jnp.broadcast_to(cxy[:, :, None, :], (h, w, na, 2))
+    half = jnp.broadcast_to(whs[None, None] / 2, (h, w, na, 2))
+    boxes = jnp.concatenate([cxy - half, cxy + half], axis=-1)
+    boxes = boxes.reshape(1, h * w * na, 4)
+    if params["clip"]:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes.astype(data.dtype)
+
+
+def _box_iou_xyxy(a, b):
+    """IoU between (..., Na, 4) and (..., Nb, 4)."""
+    tl = jnp.maximum(a[..., :, None, :2], b[..., None, :, :2])
+    br = jnp.minimum(a[..., :, None, 2:], b[..., None, :, 2:])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum((a[..., 2] - a[..., 0]) * (a[..., 3] - a[..., 1]), 0)
+    area_b = jnp.maximum((b[..., 2] - b[..., 0]) * (b[..., 3] - b[..., 1]), 0)
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register("_contrib_box_iou", nin=2, params={"format": "corner"})
+def _box_iou(params, lhs, rhs):
+    """Reference bounding_box.cc box_iou."""
+    if params["format"] == "center":
+        def to_corner(b):
+            xy, wh = b[..., :2], b[..., 2:]
+            return jnp.concatenate([xy - wh / 2, xy + wh / 2], -1)
+        lhs, rhs = to_corner(lhs), to_corner(rhs)
+    return _box_iou_xyxy(lhs, rhs)
+
+
+@register("_contrib_MultiBoxTarget", aliases=("MultiBoxTarget",), nin=3,
+          nout=3,
+          params={"overlap_threshold": 0.5, "ignore_label": -1.0,
+                  "negative_mining_ratio": -1.0, "negative_mining_thresh": 0.5,
+                  "minimum_negative_samples": 0,
+                  "variances": (0.1, 0.1, 0.2, 0.2)})
+def _multibox_target(params, anchors, labels, cls_preds):
+    """Anchor matching + target encoding (reference multibox_target-inl.h).
+
+    anchors (1, N, 4); labels (B, M, 5) [cls, x1, y1, x2, y2] padded with -1;
+    cls_preds (B, C+1, N).  Returns (loc_target (B, N*4), loc_mask (B, N*4),
+    cls_target (B, N))."""
+    var = _parse_floats(params["variances"], [0.1, 0.1, 0.2, 0.2])
+    thresh = float(params["overlap_threshold"])
+    anc = anchors[0]                                  # (N, 4)
+    N = anc.shape[0]
+
+    def per_sample(lab):
+        valid = lab[:, 0] >= 0                         # (M,)
+        gt = lab[:, 1:5]
+        ious = _box_iou_xyxy(anc, gt)                  # (N, M)
+        ious = jnp.where(valid[None, :], ious, -1.0)
+        best_gt = jnp.argmax(ious, axis=1)             # (N,)
+        best_iou = jnp.max(ious, axis=1)
+        matched = best_iou >= thresh
+        # force-match: each gt claims its best anchor
+        best_anchor = jnp.argmax(ious, axis=0)         # (M,)
+        forced = jnp.zeros(N, bool).at[best_anchor].set(valid)
+        forced_gt = jnp.zeros(N, jnp.int32).at[best_anchor].set(
+            jnp.arange(lab.shape[0], dtype=jnp.int32))
+        use_forced = forced
+        gt_idx = jnp.where(use_forced, forced_gt, best_gt)
+        pos = matched | forced
+
+        m_gt = gt[gt_idx]                              # (N, 4)
+        acx = (anc[:, 0] + anc[:, 2]) / 2
+        acy = (anc[:, 1] + anc[:, 3]) / 2
+        aw = jnp.maximum(anc[:, 2] - anc[:, 0], 1e-8)
+        ah = jnp.maximum(anc[:, 3] - anc[:, 1], 1e-8)
+        gcx = (m_gt[:, 0] + m_gt[:, 2]) / 2
+        gcy = (m_gt[:, 1] + m_gt[:, 3]) / 2
+        gw = jnp.maximum(m_gt[:, 2] - m_gt[:, 0], 1e-8)
+        gh = jnp.maximum(m_gt[:, 3] - m_gt[:, 1], 1e-8)
+        loc = jnp.stack([(gcx - acx) / aw / var[0],
+                         (gcy - acy) / ah / var[1],
+                         jnp.log(gw / aw) / var[2],
+                         jnp.log(gh / ah) / var[3]], axis=-1)  # (N, 4)
+        mask = pos[:, None].astype(anc.dtype) * jnp.ones((N, 4), anc.dtype)
+        cls_t = jnp.where(pos, lab[gt_idx, 0] + 1, 0.0)
+        return (loc * mask).reshape(-1), mask.reshape(-1), cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(per_sample)(labels)
+    return loc_t, loc_m, cls_t
+
+
+@register("_contrib_MultiBoxDetection", aliases=("MultiBoxDetection",), nin=3,
+          params={"clip": True, "threshold": 0.01, "background_id": 0,
+                  "nms_threshold": 0.5, "force_suppress": False,
+                  "variances": (0.1, 0.1, 0.2, 0.2), "nms_topk": -1})
+def _multibox_detection(params, cls_prob, loc_pred, anchors):
+    """Decode + NMS (reference multibox_detection-inl.h).
+    cls_prob (B, C+1, N), loc_pred (B, N*4), anchors (1, N, 4).
+    Output (B, N, 6) rows [cls_id, score, x1, y1, x2, y2], -1 padded."""
+    var = _parse_floats(params["variances"], [0.1, 0.1, 0.2, 0.2])
+    nms_thresh = float(params["nms_threshold"])
+    score_thresh = float(params["threshold"])
+    B, C1, N = cls_prob.shape
+
+    anc = anchors[0]
+    acx = (anc[:, 0] + anc[:, 2]) / 2
+    acy = (anc[:, 1] + anc[:, 3]) / 2
+    aw = anc[:, 2] - anc[:, 0]
+    ah = anc[:, 3] - anc[:, 1]
+
+    def per_sample(probs, loc):
+        loc = loc.reshape(N, 4)
+        cx = loc[:, 0] * var[0] * aw + acx
+        cy = loc[:, 1] * var[1] * ah + acy
+        w = jnp.exp(loc[:, 2] * var[2]) * aw
+        h = jnp.exp(loc[:, 3] * var[3]) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+        if params["clip"]:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        cls_id = jnp.argmax(probs[1:], axis=0).astype(jnp.float32)  # (N,)
+        score = jnp.max(probs[1:], axis=0)
+        keep = score > score_thresh
+        score = jnp.where(keep, score, 0.0)
+
+        order = jnp.argsort(-score)
+        boxes_o = boxes[order]
+        score_o = score[order]
+        cls_o = cls_id[order]
+        ious = _box_iou_xyxy(boxes_o, boxes_o)
+        same_cls = (cls_o[:, None] == cls_o[None, :]) | \
+            bool(params["force_suppress"])
+        sup = (ious > nms_thresh) & same_cls
+
+        def body(i, alive):
+            row = sup[i] & alive[i] & (jnp.arange(N) > i)
+            return alive & ~row
+
+        alive = jax.lax.fori_loop(0, N, body, score_o > 0)
+        out_cls = jnp.where(alive, cls_o, -1.0)
+        out_score = jnp.where(alive, score_o, 0.0)
+        return jnp.concatenate([out_cls[:, None], out_score[:, None],
+                                boxes_o], axis=-1)
+
+    return jax.vmap(per_sample)(cls_prob, loc_pred)
+
+
+@register("_contrib_box_nms", aliases=("_contrib_box_non_maximum_suppression",),
+          nout=1,
+          params={"overlap_thresh": 0.5, "valid_thresh": 0.0, "topk": -1,
+                  "coord_start": 2, "score_index": 1, "id_index": -1,
+                  "background_id": -1, "force_suppress": False,
+                  "in_format": "corner", "out_format": "corner"})
+def _box_nms(params, data):
+    """Reference bounding_box.cc box_nms: suppressed rows become -1."""
+    cs = int(params["coord_start"])
+    si = int(params["score_index"])
+    ii = int(params["id_index"])
+    thresh = float(params["overlap_thresh"])
+    valid_thresh = float(params["valid_thresh"])
+    orig_shape = data.shape
+    flat = data.reshape((-1,) + data.shape[-2:])  # (B, N, K)
+    N = flat.shape[1]
+
+    def per_batch(rows):
+        score = rows[:, si]
+        boxes = jax.lax.dynamic_slice_in_dim(rows, cs, 4, axis=1)
+        if params["in_format"] == "center":
+            xy, wh = boxes[:, :2], boxes[:, 2:]
+            boxes = jnp.concatenate([xy - wh / 2, xy + wh / 2], -1)
+        valid = score > valid_thresh
+        order = jnp.argsort(-jnp.where(valid, score, -jnp.inf))
+        rows_o = rows[order]
+        boxes_o = boxes[order]
+        valid_o = valid[order]
+        ious = _box_iou_xyxy(boxes_o, boxes_o)
+        if ii >= 0 and not params["force_suppress"]:
+            ids = rows_o[:, ii]
+            same = ids[:, None] == ids[None, :]
+        else:
+            same = jnp.ones((N, N), bool)
+        sup = (ious > thresh) & same
+
+        def body(i, alive):
+            row = sup[i] & alive[i] & (jnp.arange(N) > i)
+            return alive & ~row
+
+        alive = jax.lax.fori_loop(0, N, body, valid_o)
+        return jnp.where(alive[:, None], rows_o, -jnp.ones_like(rows_o))
+
+    out = jax.vmap(per_batch)(flat)
+    return out.reshape(orig_shape)
+
+
+@register("ROIPooling", nin=2,
+          params={"pooled_size": REQUIRED, "spatial_scale": REQUIRED})
+def _roi_pooling(params, data, rois):
+    """Reference `src/operator/roi_pooling.cc`: max-pool each ROI into a
+    fixed (ph, pw) grid.  rois (R, 5): [batch_idx, x1, y1, x2, y2]."""
+    ph, pw = (params["pooled_size"] if not isinstance(params["pooled_size"],
+                                                      int)
+              else (params["pooled_size"],) * 2)
+    scale = float(params["spatial_scale"])
+    B, C, H, W = data.shape
+
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * scale)
+        y1 = jnp.round(roi[2] * scale)
+        x2 = jnp.round(roi[3] * scale)
+        y2 = jnp.round(roi[4] * scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        img = data[bidx]                              # (C, H, W)
+
+        def pool_bin(iy, ix):
+            ys_lo = y1 + iy * bin_h
+            ys_hi = y1 + (iy + 1) * bin_h
+            xs_lo = x1 + ix * bin_w
+            xs_hi = x1 + (ix + 1) * bin_w
+            ymask = (ys >= jnp.floor(ys_lo)) & (ys < jnp.ceil(ys_hi))
+            xmask = (xs >= jnp.floor(xs_lo)) & (xs < jnp.ceil(xs_hi))
+            mask = ymask[:, None] & xmask[None, :]
+            masked = jnp.where(mask[None], img, -jnp.inf)
+            out = jnp.max(masked, axis=(1, 2))
+            return jnp.where(jnp.any(mask), out, 0.0)
+
+        grid = jnp.stack([jnp.stack([pool_bin(iy, ix) for ix in range(pw)],
+                                    axis=-1) for iy in range(ph)], axis=-2)
+        return grid                                    # (C, ph, pw)
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("_contrib_ROIAlign", nin=2,
+          params={"pooled_size": REQUIRED, "spatial_scale": REQUIRED,
+                  "sample_ratio": -1, "position_sensitive": False})
+def _roi_align(params, data, rois):
+    """Reference `contrib/roi_align.cc`: bilinear-sampled average pooling."""
+    ps = params["pooled_size"]
+    ph, pw = (ps, ps) if isinstance(ps, int) else tuple(ps)
+    scale = float(params["spatial_scale"])
+    B, C, H, W = data.shape
+
+    def bilinear(img, y, x):
+        y0 = jnp.clip(jnp.floor(y), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(x), 0, W - 1)
+        y1 = jnp.clip(y0 + 1, 0, H - 1)
+        x1 = jnp.clip(x0 + 1, 0, W - 1)
+        wy = y - y0
+        wx = x - x0
+        y0i, y1i = y0.astype(jnp.int32), y1.astype(jnp.int32)
+        x0i, x1i = x0.astype(jnp.int32), x1.astype(jnp.int32)
+        v = (img[:, y0i, x0i] * (1 - wy) * (1 - wx) +
+             img[:, y1i, x0i] * wy * (1 - wx) +
+             img[:, y0i, x1i] * (1 - wy) * wx +
+             img[:, y1i, x1i] * wy * wx)
+        return v
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = roi[1] * scale, roi[2] * scale, roi[3] * scale, \
+            roi[4] * scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        img = data[bidx]
+        iy = (jnp.arange(ph) + 0.5) * rh / ph + y1
+        ix = (jnp.arange(pw) + 0.5) * rw / pw + x1
+        vals = jax.vmap(lambda y: jax.vmap(lambda x: bilinear(img, y, x))(ix))(iy)
+        return jnp.moveaxis(vals, -1, 0)               # (C, ph, pw)
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("_contrib_bipartite_matching", nin=1, nout=2,
+          params={"is_ascend": False, "threshold": REQUIRED, "topk": -1})
+def _bipartite_matching(params, dist):
+    """Greedy bipartite matching (reference bounding_box.cc)."""
+    thresh = float(params["threshold"])
+    asc = bool(params["is_ascend"])
+
+    def per_batch(mat):
+        n, m = mat.shape
+        score = -mat if asc else mat
+
+        def body(carry, _):
+            s, row_match, col_match = carry
+            idx = jnp.argmax(s)
+            i, j = idx // m, idx % m
+            ok = s[i, j] > (-thresh if asc else thresh)
+            row_match = jnp.where(ok, row_match.at[i].set(j.astype(jnp.float32)),
+                                  row_match)
+            col_match = jnp.where(ok, col_match.at[j].set(i.astype(jnp.float32)),
+                                  col_match)
+            s = jnp.where(ok, s.at[i, :].set(-jnp.inf).at[:, j].set(-jnp.inf),
+                          jnp.full_like(s, -jnp.inf))
+            return (s, row_match, col_match), None
+
+        init = (score, -jnp.ones(n), -jnp.ones(m))
+        (_, rm, cm), _ = jax.lax.scan(body, init, None,
+                                      length=min(n, m))
+        return rm, cm
+
+    if dist.ndim == 2:
+        return per_batch(dist)
+    rm, cm = jax.vmap(per_batch)(dist)
+    return rm, cm

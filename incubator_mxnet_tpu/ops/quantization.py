@@ -1,0 +1,91 @@
+"""Quantization ops (reference `src/operator/quantization/` —
+quantize.cc, dequantize.cc, requantize.cc, quantized_conv/fc/pooling,
+calibration via min/max).
+
+INT8 inference path: values quantized symmetric/affine into int8 with
+min/max ranges carried alongside (the reference's 3-tensor convention).
+Quantized compute ops dequantize-compute-requantize through XLA int8/int32
+matmul where profitable; the graph rewrite lives in
+`incubator_mxnet_tpu/contrib/quantization.py`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, REQUIRED
+
+
+@register("_contrib_quantize", nin=3, nout=3, params={"out_type": "int8"},
+          aliases=("quantize",))
+def _quantize(params, data, min_range, max_range):
+    """Reference quantize.cc: float -> int8 with given range."""
+    q_min, q_max = -127.0, 127.0
+    scale = jnp.maximum(jnp.maximum(jnp.abs(min_range), jnp.abs(max_range)),
+                        1e-8)
+    out = jnp.clip(jnp.round(data / scale * q_max), q_min, q_max) \
+        .astype(jnp.int8)
+    return out, -scale, scale
+
+
+@register("_contrib_quantize_v2", nin=1, nout=3,
+          params={"out_type": "int8", "min_calib_range": None,
+                  "max_calib_range": None})
+def _quantize_v2(params, data):
+    if params["min_calib_range"] is not None:
+        mn = jnp.asarray(params["min_calib_range"], jnp.float32)
+        mx = jnp.asarray(params["max_calib_range"], jnp.float32)
+    else:
+        mn = jnp.min(data).astype(jnp.float32)
+        mx = jnp.max(data).astype(jnp.float32)
+    scale = jnp.maximum(jnp.maximum(jnp.abs(mn), jnp.abs(mx)), 1e-8)
+    out = jnp.clip(jnp.round(data / scale * 127.0), -127, 127).astype(jnp.int8)
+    return out, -scale, scale
+
+
+@register("_contrib_dequantize", nin=3, params={"out_type": "float32"},
+          aliases=("dequantize",))
+def _dequantize(params, data, min_range, max_range):
+    scale = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    return data.astype(jnp.float32) * scale / 127.0
+
+
+@register("_contrib_requantize", nin=3, nout=3,
+          params={"out_type": "int8", "min_calib_range": None,
+                  "max_calib_range": None})
+def _requantize(params, data, min_range, max_range):
+    """int32 accumulators -> int8 (reference requantize.cc)."""
+    real = data.astype(jnp.float32) * jnp.maximum(
+        jnp.abs(min_range), jnp.abs(max_range)) / (127.0 * 127.0)
+    if params["min_calib_range"] is not None:
+        mn = jnp.asarray(params["min_calib_range"], jnp.float32)
+        mx = jnp.asarray(params["max_calib_range"], jnp.float32)
+    else:
+        mn = jnp.min(real)
+        mx = jnp.max(real)
+    scale = jnp.maximum(jnp.maximum(jnp.abs(mn), jnp.abs(mx)), 1e-8)
+    out = jnp.clip(jnp.round(real / scale * 127.0), -127, 127).astype(jnp.int8)
+    return out, -scale, scale
+
+
+@register("_contrib_quantized_fully_connected", nin=-1, nout=3,
+          params={"num_hidden": REQUIRED, "no_bias": False, "flatten": True})
+def _quantized_fc(params, *args):
+    """int8 x int8 -> int32 matmul (reference quantized_fully_connected.cc).
+    Inputs: data, weight, [bias], min/max for each."""
+    no_bias = bool(params["no_bias"])
+    if no_bias:
+        data, weight, dmin, dmax, wmin, wmax = args
+        bias = None
+    else:
+        data, weight, bias, dmin, dmax, wmin, wmax, bmin, bmax = args
+    x = data.astype(jnp.int32)
+    if params["flatten"]:
+        x = x.reshape(x.shape[0], -1)
+    out = jax.lax.dot(x, weight.astype(jnp.int32).T)
+    if bias is not None:
+        out = out + bias.astype(jnp.int32)
+    d_scale = jnp.maximum(jnp.abs(dmin), jnp.abs(dmax)) / 127.0
+    w_scale = jnp.maximum(jnp.abs(wmin), jnp.abs(wmax)) / 127.0
+    out_range = d_scale * w_scale * 127.0 * 127.0
+    return out, -out_range, out_range

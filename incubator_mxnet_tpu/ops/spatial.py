@@ -1,0 +1,136 @@
+"""Spatial-warp operators (reference `src/operator/bilinear_sampler.cc`,
+`grid_generator.cc`, `spatial_transformer.cc`, `correlation.cc`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, REQUIRED
+from ..base import MXNetError
+
+
+def _bilinear_sample(img, gy, gx):
+    """img (C, H, W); gy/gx normalized [-1, 1] grids of shape (Ho, Wo)."""
+    C, H, W = img.shape
+    y = (gy + 1) * (H - 1) / 2
+    x = (gx + 1) * (W - 1) / 2
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy = y - y0
+    wx = x - x0
+
+    def at(yi, xi):
+        inb = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        v = img[:, yc, xc]
+        return jnp.where(inb[None], v, 0.0)
+
+    out = (at(y0, x0) * (1 - wy) * (1 - wx) +
+           at(y0 + 1, x0) * wy * (1 - wx) +
+           at(y0, x0 + 1) * (1 - wy) * wx +
+           at(y0 + 1, x0 + 1) * wy * wx)
+    return out
+
+
+@register("BilinearSampler", nin=2, params={"cudnn_off": False})
+def _bilinear_sampler(params, data, grid):
+    """Reference bilinear_sampler.cc: grid (B, 2, Ho, Wo) with (x, y) in
+    [-1, 1]."""
+    def per(img, g):
+        return _bilinear_sample(img, g[1], g[0])
+    return jax.vmap(per)(data, grid)
+
+
+@register("GridGenerator", nin=1,
+          params={"transform_type": REQUIRED, "target_shape": (0, 0)})
+def _grid_generator(params, data):
+    """Reference grid_generator.cc: affine (B, 6) -> sampling grid, or warp
+    flow (B, 2, H, W) -> grid."""
+    tt = params["transform_type"]
+    th, tw = tuple(params["target_shape"])
+    if tt == "affine":
+        B = data.shape[0]
+        ys = jnp.linspace(-1, 1, th)
+        xs = jnp.linspace(-1, 1, tw)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx.reshape(-1), gy.reshape(-1), ones.reshape(-1)])
+
+        def per(theta):
+            m = theta.reshape(2, 3)
+            out = m @ base                   # (2, th*tw)
+            return out.reshape(2, th, tw)
+
+        return jax.vmap(per)(data)
+    if tt == "warp":
+        B, _, H, W = data.shape
+        ys = jnp.arange(H, dtype=data.dtype)
+        xs = jnp.arange(W, dtype=data.dtype)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        x = (data[:, 0] + gx[None]) * 2 / jnp.maximum(W - 1, 1) - 1
+        y = (data[:, 1] + gy[None]) * 2 / jnp.maximum(H - 1, 1) - 1
+        return jnp.stack([x, y], axis=1)
+    raise MXNetError(f"GridGenerator: bad transform_type {tt}")
+
+
+@register("SpatialTransformer", nin=2,
+          params={"target_shape": (0, 0), "transform_type": "affine",
+                  "sampler_type": "bilinear", "cudnn_off": False})
+def _spatial_transformer(params, data, loc):
+    """Reference spatial_transformer.cc: affine theta (B, 6) + bilinear."""
+    th, tw = tuple(params["target_shape"])
+    grid = _grid_generator({"transform_type": "affine",
+                            "target_shape": (th, tw)}, loc)
+
+    def per(img, g):
+        return _bilinear_sample(img, g[1], g[0])
+
+    return jax.vmap(per)(data, grid)
+
+
+@register("Correlation", nin=2,
+          params={"kernel_size": 1, "max_displacement": 1, "stride1": 1,
+                  "stride2": 1, "pad_size": 0, "is_multiply": True})
+def _correlation(params, data1, data2):
+    """Reference correlation.cc (FlowNet-style cost volume)."""
+    k = int(params["kernel_size"])
+    md = int(params["max_displacement"])
+    s1 = int(params["stride1"])
+    s2 = int(params["stride2"])
+    pad = int(params["pad_size"])
+    mult = bool(params["is_multiply"])
+    B, C, H, W = data1.shape
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    d_range = range(-md, md + 1, s2)
+    outs = []
+    Hp, Wp = H + 2 * pad, W + 2 * pad
+    for dy in d_range:
+        for dx in d_range:
+            a = p1[:, :, md:Hp - md, md:Wp - md]
+            b = p2[:, :, md + dy:Hp - md + dy, md + dx:Wp - md + dx]
+            if mult:
+                corr = jnp.mean(a * b, axis=1)
+            else:
+                corr = jnp.mean(jnp.abs(a - b), axis=1)
+            outs.append(corr[:, ::s1, ::s1])
+    return jnp.stack(outs, axis=1)
+
+
+@register("Crop", nin=-1,
+          params={"num_args": 1, "offset": (0, 0), "h_w": (0, 0),
+                  "center_crop": False}, variadic_param="num_args")
+def _crop_op(params, *args):
+    """Reference crop.cc: crop first input to second's spatial size (or h_w)."""
+    data = args[0]
+    if len(args) > 1:
+        h, w = args[1].shape[2], args[1].shape[3]
+    else:
+        h, w = tuple(params["h_w"])
+    if params["center_crop"]:
+        oy = (data.shape[2] - h) // 2
+        ox = (data.shape[3] - w) // 2
+    else:
+        oy, ox = tuple(params["offset"])
+    return data[:, :, oy:oy + h, ox:ox + w]
