@@ -414,13 +414,15 @@ class HybridBlock(Block):
                 all_params[name].shape = shape
 
     def _finish_deferred(self, *args):
-        for p in self.collect_params().values():
-            if p._deferred_init:
-                try:
-                    p._finish_deferred_init()
-                except AssertionError:
-                    self._deferred_infer_shape(*args)
-                    p._finish_deferred_init()
+        from .. import engine as _engine
+        with _engine.bulk(64):
+            for p in self.collect_params().values():
+                if p._deferred_init:
+                    try:
+                        p._finish_deferred_init()
+                    except AssertionError:
+                        self._deferred_infer_shape(*args)
+                        p._finish_deferred_init()
 
     def _build_cache(self, *args):
         inputs = [a for a in args if isinstance(a, NDArray)]
@@ -435,10 +437,12 @@ class HybridBlock(Block):
                 params = {name: p.data(ctx)
                           for name, p in self._reg_params.items()}
             except DeferredInitializationError:
+                from .. import engine as _engine
                 self._deferred_infer_shape(x, *args)
-                for p in self.collect_params().values():
-                    if p._deferred_init:
-                        p._finish_deferred_init()
+                with _engine.bulk(64):
+                    for p in self.collect_params().values():
+                        if p._deferred_init:
+                            p._finish_deferred_init()
                 params = {name: p.data(ctx)
                           for name, p in self._reg_params.items()}
 
@@ -456,12 +460,14 @@ class HybridBlock(Block):
         pending = [p for p in self.collect_params().values()
                    if p._data is None]
         if pending:
+            from .. import engine as _engine
             self._deferred_infer_shape(*inputs)
-            for p in pending:
-                if p._deferred_init:
-                    p._finish_deferred_init()
-                else:
-                    p.initialize(ctx=inputs[0].context)
+            with _engine.bulk(64):
+                for p in pending:
+                    if p._deferred_init:
+                        p._finish_deferred_init()
+                    else:
+                        p.initialize(ctx=inputs[0].context)
         if self._cached_graph is None:
             self._build_cache(*args)
         cg = self._cached_graph

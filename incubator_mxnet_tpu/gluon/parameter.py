@@ -161,6 +161,10 @@ class Parameter:
     def _init_impl(self, data, ctx_list):
         self._ctx_list = list(ctx_list)
         self._data = [data.copyto(c) for c in self._ctx_list]
+        # `data` is a scratch buffer; under bulk staging don't ship it to its
+        # (cpu) device at flush — only the per-context copies matter
+        from .. import engine as _engine
+        _engine.unstage(data)
         self._init_grad()
 
     def _init_grad(self):
@@ -369,8 +373,13 @@ class ParameterDict:
                    force_reinit=False):
         if init is None:
             init = init_mod.Uniform()
-        for _, v in self.items():
-            v.initialize(None, ctx, init, force_reinit=force_reinit)
+        # bulk scope: initializers run host-side in numpy; the scope exit
+        # performs one batched transfer per device instead of one dispatch
+        # per parameter (reference bulk mode, include/mxnet/engine.h:308)
+        from .. import engine as _engine
+        with _engine.bulk(len(self._params) or 1):
+            for _, v in self.items():
+                v.initialize(None, ctx, init, force_reinit=force_reinit)
 
     def zero_grad(self):
         for v in self.values():

@@ -79,8 +79,15 @@ class Initializer:
             self._init_default(name, arr)
 
     def _set(self, arr, np_values):
+        from . import engine as _engine
+        vals = np.asarray(np_values).astype(np.dtype(arr.dtype), copy=False)
+        if _engine.bulk_active():
+            # host-stage; the engine flush batches the device transfer
+            arr._data = vals
+            _engine.stage(arr)
+            return
         import jax.numpy as jnp
-        arr._data = jnp.asarray(np_values.astype(np.dtype(arr.dtype)))
+        arr._data = jnp.asarray(vals)
 
     def _init_zero(self, _, arr):
         self._set(arr, np.zeros(arr.shape))

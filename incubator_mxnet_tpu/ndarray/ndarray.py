@@ -143,6 +143,12 @@ class NDArray:
         """Cross-device copy (reference `CopyFromTo`, `src/ndarray/ndarray.cc:1147`)."""
         import jax
         if isinstance(other, Context):
+            if isinstance(self._data, _np.ndarray) and _engine.bulk_active():
+                # bulk mode: keep host-staged, retarget the context; the
+                # engine flush performs one batched transfer per device
+                out = NDArray(self._data, ctx=other)
+                _engine.stage(out)
+                return out
             out = NDArray(jax.device_put(self._data, other.jax_device), ctx=other)
             return out
         if isinstance(other, NDArray):
@@ -223,7 +229,11 @@ class NDArray:
         return NDArray(self._data[jkey], ctx=self._ctx)
 
     def __setitem__(self, key, value):
+        import jax
         import jax.numpy as jnp
+        if isinstance(self._data, _np.ndarray):  # host-staged buffer
+            _engine.unstage(self)
+            self._data = jax.device_put(self._data, self._ctx.jax_device)
         if isinstance(value, NDArray):
             value = value._data
         value = jnp.asarray(value, dtype=self._data.dtype)
@@ -517,6 +527,15 @@ def array(source_array, ctx=None, dtype=None):
     return NDArray(jax.device_put(jnp.asarray(np_arr), ctx.jax_device), ctx=ctx)
 
 
+def _staged(np_arr, ctx):
+    """Host-staged NDArray under engine bulk mode: the buffer lives in host
+    memory until the engine flush batches all pending transfers
+    (reference bulk-execution fusion, `include/mxnet/engine.h:308-313`)."""
+    out = NDArray(np_arr, ctx=ctx or current_context())
+    _engine.stage(out)
+    return out
+
+
 def empty(shape, ctx=None, dtype=None):
     return zeros(shape, ctx=ctx, dtype=dtype)
 
@@ -524,6 +543,8 @@ def empty(shape, ctx=None, dtype=None):
 def zeros(shape, ctx=None, dtype=None, **kwargs):
     if isinstance(shape, int):
         shape = (shape,)
+    if _engine.bulk_active():
+        return _staged(_np.zeros(shape, np_dtype(dtype or "float32")), ctx)
     return _apply_op("_zeros", [], {"shape": shape, "dtype": dtype_name(dtype or "float32"),
                                     "ctx": ctx or current_context()})
 
@@ -531,6 +552,8 @@ def zeros(shape, ctx=None, dtype=None, **kwargs):
 def ones(shape, ctx=None, dtype=None, **kwargs):
     if isinstance(shape, int):
         shape = (shape,)
+    if _engine.bulk_active():
+        return _staged(_np.ones(shape, np_dtype(dtype or "float32")), ctx)
     return _apply_op("_ones", [], {"shape": shape, "dtype": dtype_name(dtype or "float32"),
                                    "ctx": ctx or current_context()})
 
@@ -538,6 +561,8 @@ def ones(shape, ctx=None, dtype=None, **kwargs):
 def full(shape, val, ctx=None, dtype=None, out=None):
     if isinstance(shape, int):
         shape = (shape,)
+    if _engine.bulk_active() and out is None:
+        return _staged(_np.full(shape, val, np_dtype(dtype or "float32")), ctx)
     return _apply_op("_full", [], {"shape": shape, "value": val,
                                    "dtype": dtype_name(dtype or "float32"),
                                    "ctx": ctx or current_context()}, out=out)

@@ -30,13 +30,14 @@ from ..base import MXNetError
           params={"num_hidden": REQUIRED, "no_bias": False, "flatten": True},
           input_names=lambda p: ["data", "weight"] + ([] if p.get("no_bias") else ["bias"]))
 def _fully_connected(params, x, weight, *rest):
+    weight = weight.astype(x.dtype)  # mixed-precision: params may be fp32
     if params["flatten"]:
         x2 = x.reshape(x.shape[0], -1)
         out = jnp.dot(x2, weight.T)
     else:
         out = jnp.dot(x, weight.T)
     if not params["no_bias"]:
-        bias = rest[0]
+        bias = rest[0].astype(out.dtype)
         out = out + bias
     return out
 
@@ -81,14 +82,14 @@ def _convolution(params, x, weight, *rest):
     pad = _tup(params["pad"], nd, 0)
     dn = jax.lax.conv_dimension_numbers(x.shape, weight.shape, _conv_dims(kernel))
     out = jax.lax.conv_general_dilated(
-        x, weight, window_strides=stride,
+        x, weight.astype(x.dtype), window_strides=stride,
         padding=[(p, p) for p in pad],
         lhs_dilation=(1,) * nd, rhs_dilation=dilate,
         dimension_numbers=dn,
         feature_group_count=int(params["num_group"]),
         preferred_element_type=None)
     if not params["no_bias"]:
-        bias = rest[0]
+        bias = rest[0].astype(out.dtype)
         out = out + bias.reshape((1, -1) + (1,) * nd)
     return out
 
@@ -229,15 +230,19 @@ def _batch_norm(params, x, gamma, beta, moving_mean, moving_var):
     bshape = [1] * x.ndim
     bshape[axis] = x.shape[axis]
 
+    # statistics in float32 even for low-precision activations (matches the
+    # reference's cuDNN path which accumulates in fp32)
+    xs = x.astype(jnp.float32) if x.dtype != jnp.float32 else x
     if train:
-        mean = jnp.mean(x, axis=red_axes)
-        var = jnp.mean(jnp.square(x - mean.reshape(bshape)), axis=red_axes)
+        mean = jnp.mean(xs, axis=red_axes)
+        var = jnp.mean(jnp.square(xs - mean.reshape(bshape)), axis=red_axes)
     else:
         mean, var = moving_mean, moving_var
 
     inv = jax.lax.rsqrt(var + eps).reshape(bshape)
-    out = (x - mean.reshape(bshape)) * inv * gamma.reshape(bshape) \
+    out = (xs - mean.reshape(bshape)) * inv * gamma.reshape(bshape) \
         + beta.reshape(bshape)
+    out = out.astype(x.dtype)
 
     outs = (out,)
     if params["output_mean_var"]:
