@@ -191,7 +191,6 @@ def _pure_jax_resnet50(batch, image, dtype):
         h = jax.nn.relu(bn(h, p, aux, "stem", new_aux))
         h = lax.reduce_window(h, -jnp.inf, lax.max, (1, 1, 3, 3),
                               (1, 1, 2, 2), "SAME")
-        cin = 64
         for si, (n, (cm, cout)) in enumerate(zip(layers, chans)):
             for bi in range(n):
                 pre = f"s{si}b{bi}"
@@ -206,7 +205,6 @@ def _pure_jax_resnet50(batch, image, dtype):
                     idn = bn(conv(h, p[pre + ".ds.w"], stride),
                              p, aux, pre + ".ds", new_aux)
                 h = jax.nn.relu(o + idn)
-            cin = cout
         h = h.mean((2, 3)).astype(jnp.float32)
         return h @ p["fc.w"].astype(jnp.float32).T + p["fc.b"], new_aux
 
@@ -214,7 +212,6 @@ def _pure_jax_resnet50(batch, image, dtype):
         return a.astype(dtype) if a.dtype == np.float32 and \
             dtype != "float32" else a
 
-    import jax
     w = {k: jnp.asarray(cast(v)) for k, v in params.items()}
     m = {k: jnp.zeros_like(v) for k, v in w.items()}
     aux = {k: jnp.asarray(v) for k, v in auxs.items()}

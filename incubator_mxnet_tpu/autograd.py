@@ -29,6 +29,7 @@ def _st():
         _state.recording = False
         _state.training = False
         _state.tape = []
+        _state.scope_depth = 0  # nesting of record()/pause() scopes
     return _state
 
 
@@ -63,13 +64,22 @@ class _RecordingStateScope:
         self._prev_train_mode = None
 
     def __enter__(self):
+        st = _st()
         if self._enter_is_record is not None:
+            # a fresh outermost record() starts a new graph: drop stale tape
+            # entries from earlier scopes whose backward was never taken
+            # (otherwise forward-only record scopes leak entries — and pin
+            # their input snapshots — indefinitely)
+            if self._enter_is_record and st.scope_depth == 0 and st.tape:
+                st.tape = []
+            st.scope_depth += 1
             self._prev_is_record = set_recording(self._enter_is_record)
         if self._enter_train_mode is not None:
             self._prev_train_mode = set_training(self._enter_train_mode)
 
     def __exit__(self, ptype, value, trace):
         if self._enter_is_record is not None:
+            _st().scope_depth -= 1
             set_recording(self._prev_is_record)
         if self._enter_train_mode is not None:
             set_training(self._prev_train_mode)
@@ -169,8 +179,22 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     if head_grads is None:
         head_grads = [None] * len(heads)
 
-    # collect marked variables reachable on the tape
+    # loud failure instead of silent zero-grads: a head that is neither on
+    # the current tape nor a grad-attached leaf was recorded in an earlier
+    # record() scope whose graph has been discarded
     st = _st()
+    tape_out_ids = {id(o) for e in st.tape for o in e.outputs}
+    for h in heads:
+        if getattr(h, "_requires_grad", False) and id(h) not in tape_out_ids \
+                and h._grad is None:
+            raise MXNetError(
+                "backward() head is not on the current autograd tape: it was "
+                "recorded in an earlier record() scope whose graph was "
+                "discarded when a new outermost record() scope started "
+                "(tape-based autograd keeps one graph); call backward before "
+                "opening the next record scope")
+
+    # collect marked variables reachable on the tape
     marked = []
     seen = set()
     for entry in st.tape:

@@ -170,12 +170,14 @@ class NDArrayIter(DataIter):
         end = self.cursor + self.batch_size
         if end <= self.num_data:
             sel = self.idx[self.cursor:end]
-            return [array(v[sel]) for _, v in data_source]
+            # keep the source dtype so batches match provide_data/provide_label
+            # (reference converts once at construction)
+            return [array(v[sel], dtype=v.dtype) for _, v in data_source]
         if self.last_batch_handle == "discard":
             raise StopIteration
         pad = end - self.num_data
         sel = _np.concatenate([self.idx[self.cursor:], self.idx[:pad]])
-        return [array(v[sel]) for _, v in data_source]
+        return [array(v[sel], dtype=v.dtype) for _, v in data_source]
 
     def getdata(self):
         return self._getdata(self.data)

@@ -228,7 +228,9 @@ class Module(BaseModule):
         (kvstore, update_on_kvstore) = _create_kvstore(
             kvstore, len(self._context), self._arg_params)
         batch_size = self._exec_group.batch_size
-        if kvstore and "dist" in kvstore.type and "_async" in kvstore.type:
+        if kvstore and "dist" in kvstore.type and "_sync" in kvstore.type:
+            # sync distributed training averages over the global batch
+            # (reference module.py:504)
             batch_size *= kvstore.num_workers
         rescale_grad = 1.0 / batch_size
 

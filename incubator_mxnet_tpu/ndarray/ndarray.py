@@ -430,6 +430,14 @@ def invoke(op, data, kwargs, out=None):
     if op.mode_dependent:
         params["_train"] = _autograd.is_training()
 
+    # promote host-staged inputs to their claimed device first, so the op
+    # result is committed to the right device and the output ctx is honest
+    for d in data:
+        if isinstance(d, NDArray) and isinstance(d._data, _np.ndarray):
+            import jax
+            _engine.unstage(d)
+            d._data = jax.device_put(d._data, d._ctx.jax_device)
+
     in_arrays = [d._data if isinstance(d, NDArray) else d for d in data]
     n_aux = op.num_aux(params)
 

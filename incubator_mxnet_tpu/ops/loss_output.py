@@ -36,9 +36,14 @@ def _softmax_output(params, data, label):
     normalization = params["normalization"]
     smooth = float(params["smooth_alpha"])
 
+    orig_shape = data.shape
+    flattened = False
     if not multi and not preserve and data.ndim > 2:
-        # reference flattens trailing dims onto batch for the default mode
-        pass
+        # reference default mode flattens trailing dims into one class axis:
+        # data is treated as (batch, prod(rest)) (softmax_output-inl.h)
+        data = data.reshape(orig_shape[0], -1)
+        label = label.reshape(orig_shape[0])
+        flattened = True
 
     @jax.custom_vjp
     def f(d, l):
@@ -76,7 +81,10 @@ def _softmax_output(params, data, label):
         return grad, jnp.zeros_like(l)
 
     f.defvjp(fwd, bwd)
-    return f(data, label)
+    out = f(data, label)
+    if flattened:
+        out = out.reshape(orig_shape)
+    return out
 
 
 def _regression(link, grad_fn):

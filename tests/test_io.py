@@ -132,3 +132,14 @@ def test_image_record_iter(tmp_path):
     assert n == 3
     it.reset()
     assert len(list(it)) == 3
+
+
+def test_ndarray_iter_preserves_dtype():
+    """Delivered batch dtype must match provide_data/provide_label."""
+    X = np.random.randn(10, 3).astype("f4")
+    y = np.arange(10, dtype="int32")
+    it = mx.io.NDArrayIter(X, y, batch_size=5)
+    batch = next(iter(it))
+    assert batch.label[0].dtype == np.int32
+    assert batch.data[0].dtype == np.float32
+    assert it.provide_label[0].dtype == np.int32

@@ -333,3 +333,17 @@ def test_sequence_ops():
     rev = nd.SequenceReverse(nd.array(x), seqlen, use_sequence_length=True)
     np.testing.assert_allclose(rev.asnumpy()[0, 0], x[1, 0])
     np.testing.assert_allclose(rev.asnumpy()[2, 0], x[2, 0])
+
+
+def test_softmax_output_default_mode_flattens():
+    """Default mode (not multi_output, not preserve_shape) flattens trailing
+    dims onto one class axis (reference softmax_output-inl.h)."""
+    data = np.random.randn(2, 3, 4).astype("f4")
+    out = nd.SoftmaxOutput(nd.array(data), nd.zeros((2,))).asnumpy()
+    assert out.shape == (2, 3, 4)
+    ref = np.exp(data.reshape(2, -1))
+    ref = (ref / ref.sum(1, keepdims=True)).reshape(2, 3, 4)
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+    # softmax over the flattened axis sums to 1 per batch row
+    np.testing.assert_allclose(out.reshape(2, -1).sum(1), [1.0, 1.0],
+                               rtol=1e-5)
