@@ -146,10 +146,12 @@ def _compute_gradients(heads, head_grads, retain_graph=False):
         key = id(h)
         grad_map[key] = grad_map[key] + g if key in grad_map else g
 
+    visited = set()
     for entry in reversed(tape):
         out_ids = [id(o) for o in entry.outputs]
         if not any(oid in grad_map for oid in out_ids):
             continue
+        visited.add(id(entry))
         cotangents = []
         for o, oid in zip(entry.outputs, out_ids):
             g = grad_map.get(oid)
@@ -166,7 +168,11 @@ def _compute_gradients(heads, head_grads, retain_graph=False):
             key = id(inp)
             grad_map[key] = grad_map[key] + ig if key in grad_map else ig
     if not retain_graph:
-        st.tape = []
+        # consume only the subgraph this backward walked; entries feeding
+        # other heads (e.g. per-device losses in a DP step, each backward'd
+        # in turn — the reference's per-graph semantics) stay live until
+        # their own backward or the next outermost record() scope
+        st.tape = [e for e in tape if id(e) not in visited]
     return grad_map
 
 

@@ -230,25 +230,120 @@ def _updater_key(k):
 
 class KVStoreTPU(KVStore):
     """`kvstore='tpu'` — push/pull as one fused all-reduce over the device
-    mesh (BASELINE north star).  For list-of-device-arrays pushes the reduce
-    runs as a single donated XLA computation on the participating devices."""
+    mesh (BASELINE north star; replaces `comm.h:451 CommDevice` /
+    `kvstore_nccl.h:285-402`).
 
-    def __init__(self):
-        super().__init__("tpu")
+    Push: the per-device gradient shards are assembled into one global
+    `jax.Array` sharded over a mesh of the participating devices, and a
+    cached jitted `shard_map(psum)` performs a single XLA all-reduce over
+    the ICI links — no host staging, no lead-device funnel.
+
+    Pull: the stored value is broadcast with one `device_put` onto a
+    replicated `NamedSharding` over the same mesh (XLA's broadcast
+    collective), and each target takes its local shard — again a single
+    collective rather than N point-to-point copies.
+    """
+
+    def __init__(self, kind="tpu"):
+        super().__init__(kind)
+        self._meshes = {}        # tuple(device ids) -> Mesh
+        self._allreduce_jit = {}  # n_devices -> jitted shard_map psum
+        # last mesh a key was pushed over; lets pull() reuse the same devices
+        self._key_mesh = {}
+
+    def _mesh_for(self, devices):
+        ids = tuple(d.id for d in devices)
+        mesh = self._meshes.get(ids)
+        if mesh is None:
+            import numpy as np
+            from jax.sharding import Mesh
+            mesh = Mesh(np.asarray(devices), ("dev",))
+            self._meshes[ids] = mesh
+        return mesh
+
+    def _allreduce(self, mesh):
+        """One jitted all-reduce over the mesh: (N, *s) sharded on 'dev'
+        → summed (*s), replicated on every participating device."""
+        n = mesh.devices.size
+        fn = self._allreduce_jit.get(n)
+        if fn is None:
+            import jax
+            from jax.sharding import PartitionSpec as P
+            shard_map = getattr(jax, "shard_map", None)
+            if shard_map is None:                     # older jax
+                from jax.experimental.shard_map import shard_map
+
+            def _psum(shards):           # shards: (1, *s) local block
+                return jax.lax.psum(shards[0], "dev")
+
+            fn = jax.jit(shard_map(_psum, mesh=mesh,
+                                   in_specs=P("dev"), out_specs=P()))
+            self._allreduce_jit[n] = fn
+        return fn
 
     def _reduce(self, vals):
         if len(vals) == 1:
             return vals[0]
         import jax
-        import jax.numpy as jnp
-        # single fused computation: stack shards host-free via device transfer
-        # then tree-sum on the lead device; XLA schedules ICI transfers
-        dev = vals[0].context.jax_device
-        parts = [jax.device_put(v._data, dev) for v in vals]
-        acc = parts[0]
-        for p in parts[1:]:
-            acc = acc + p
-        return NDArray(acc, ctx=vals[0].context)
+        devices = [v.context.jax_device for v in vals]
+        if len({d.id for d in devices}) != len(devices):
+            # duplicate devices (e.g. all values on one chip): plain sum
+            acc = vals[0]._data
+            for v in vals[1:]:
+                acc = acc + jax.device_put(v._data, devices[0])
+            return NDArray(acc, ctx=vals[0].context)
+        mesh = self._mesh_for(devices)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        shape = vals[0].shape
+        global_arr = jax.make_array_from_single_device_arrays(
+            (len(vals),) + shape,
+            NamedSharding(mesh, P("dev")),
+            [v._data.reshape((1,) + shape) for v in vals])
+        summed = self._allreduce(mesh)(global_arr)   # replicated on mesh
+        # collapse to the lead device's shard so downstream single-device
+        # updater math sees an ordinary committed array (the pull path
+        # re-broadcasts with one collective)
+        lead = vals[0].context.jax_device.id
+        local = next(s.data for s in summed.addressable_shards
+                     if s.device.id == lead)
+        return NDArray(local, ctx=vals[0].context)
+
+    def push(self, key, value, priority=0):
+        keys, values = _normalize_push(key, value)
+        for k, vals in zip(keys, values):
+            if len(vals) > 1:
+                devs = [v.context.jax_device for v in vals]
+                if len({d.id for d in devs}) == len(devs):
+                    self._key_mesh[_key(k)] = self._mesh_for(devs)
+        super().push(key, value, priority)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if out is None:
+            raise MXNetError("pull requires out=")
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        keys, outs = _normalize_push(key, out)
+        for k, tgt_list in zip(keys, outs):
+            sk = _key(k)
+            if sk not in self._store:
+                raise MXNetError(f"Key {k} has not been initialized")
+            src = self._store[sk]
+            mesh = self._key_mesh.get(sk)
+            tgt_devs = {t.context.jax_device.id for t in tgt_list}
+            mesh_devs = ({d.id for d in mesh.devices.flat}
+                         if mesh is not None else set())
+            if mesh is not None and len(tgt_list) > 1 and \
+                    tgt_devs <= mesh_devs:
+                # one broadcast collective over the mesh, then local shards
+                rep = jax.device_put(src._data.astype(tgt_list[0].dtype),
+                                     NamedSharding(mesh, P()))
+                by_dev = {s.device.id: s.data for s in rep.addressable_shards}
+                for tgt in tgt_list:
+                    tgt._set_data(by_dev[tgt.context.jax_device.id]
+                                  .astype(tgt.dtype))
+            else:
+                for tgt in tgt_list:
+                    src.copyto(tgt)
 
 
 def _normalize(key, value):
@@ -286,10 +381,13 @@ def create(name="local"):
         raise TypeError("name must be a string")
     if name == "tpu":
         return KVStoreTPU()
-    if name in ("local", "local_allreduce_cpu", "local_allreduce_device",
-                "device", "nccl"):
-        return KVStore("device" if name.endswith("device") or
-                       name in ("device", "nccl") else "local")
+    if name in ("device", "nccl", "local_allreduce_device"):
+        # device-side reduce: same single-collective engine as 'tpu'
+        # (reference comm.h CommDevice / kvstore_nccl.h both lower to one
+        # all-reduce; so do we)
+        return KVStoreTPU("device")
+    if name in ("local", "local_allreduce_cpu"):
+        return KVStore("local")
     if name in ("dist_sync", "dist_async", "dist_device_sync", "dist"):
         store = KVStore(name)
         return store

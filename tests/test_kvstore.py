@@ -53,6 +53,51 @@ def test_aggregate_multi_device():
         np.testing.assert_allclose(o.asnumpy(), sum(range(1, ndev + 1)))
 
 
+def test_tpu_reduce_is_one_collective():
+    """kvstore='tpu' must lower the multi-device reduce to ONE XLA
+    all-reduce over the participating devices (reference comm.h:451
+    CommDevice / kvstore_nccl.h:285 ncclAllReduce), not serial
+    device-to-device adds."""
+    import jax
+    ndev = min(8, len(jax.devices()))
+    if ndev < 2:
+        pytest.skip("needs >=2 devices")
+    kv = mx.kv.create("tpu")
+    devices = [mx.tpu(i).jax_device for i in range(ndev)]
+    mesh = kv._mesh_for(devices)
+    fn = kv._allreduce(mesh)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import jax.numpy as jnp
+    x = jax.device_put(jnp.ones((ndev,) + SHAPE),
+                       NamedSharding(mesh, P("dev")))
+    hlo = fn.lower(x).compile().as_text()
+    assert "all-reduce" in hlo, "expected an all-reduce collective in HLO"
+
+
+def test_tpu_training_step_matches_single_device():
+    """DP-8 training through kvstore='tpu' == the same step on one device."""
+    import jax
+    ndev = min(8, len(jax.devices()))
+    if ndev < 2:
+        pytest.skip("needs >=2 devices")
+    lr = 0.1
+    w0 = np.random.RandomState(0).randn(*SHAPE).astype(np.float32)
+    grads = [np.random.RandomState(i + 1).randn(*SHAPE).astype(np.float32)
+             for i in range(ndev)]
+
+    # single-device reference step: w -= lr * sum(grads)
+    expect = w0 - lr * np.sum(grads, axis=0)
+
+    kv = mx.kv.create("tpu")
+    kv.init("w", nd.array(w0))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=lr, rescale_grad=1.0))
+    kv.push("w", [nd.array(g, ctx=mx.tpu(i)) for i, g in enumerate(grads)])
+    outs = [nd.zeros(SHAPE, ctx=mx.tpu(i)) for i in range(ndev)]
+    kv.pull("w", out=outs)
+    for o in outs:
+        np.testing.assert_allclose(o.asnumpy(), expect, rtol=1e-5, atol=1e-5)
+
+
 def test_updater():
     kv = mx.kv.create("local")
     kv.init(3, nd.ones(SHAPE))
