@@ -134,6 +134,111 @@ def _record_op(op, params, inputs, input_values, outputs, n_vis):
                                 list(outputs), n_vis))
 
 
+def _grad_opdef(base_name):
+    """Get/create the differentiable gradient-op for a base operator.
+
+    ``_grad_of_<op>`` computes the base op's input gradients from
+    (inputs..., cotangents...) via jax.vjp — and, being an ordinary
+    registered op, is itself differentiable, which is what makes
+    ``create_graph=True`` (higher-order grad, reference `autograd.py:270`)
+    compose for free under JAX.
+    """
+    from .ops import registry as _reg
+    name = "_grad_of_" + base_name
+    op = _reg.maybe_get(name)
+    if op is not None:
+        return op
+
+    def fn(params, *args):
+        import jax
+        import jax.numpy as jnp
+        base = _reg.get(params["_base"])
+        bparams = dict(params["_bparams"])
+        n_in = params["_n_in"]
+        arrays, cts = args[:n_in], args[n_in:]
+
+        def fwd(*xs):
+            out = base.fn(bparams, *xs)
+            return out if isinstance(out, tuple) else (out,)
+
+        primals, vjp = jax.vjp(fwd, *arrays)
+        cts_p = tuple(cts) + tuple(
+            jnp.zeros_like(p) for p in primals[len(cts):])
+        return tuple(vjp(cts_p))
+
+    op = _reg.OpDef(name, fn, nin=-1, nout=lambda p: p["_n_in"],
+                    params={"_base": base_name, "_bparams": (),
+                            "_n_in": _reg.REQUIRED, "_n_ct": 0})
+    _reg.register_opdef(op)
+    return op
+
+
+def _compute_gradients_recorded(heads, head_grads, retain_graph):
+    """create_graph=True walk: gradients are NDArrays and every vjp is
+    re-recorded on the tape, so the returned grads support further backward."""
+    import jax.numpy as jnp
+    from .ndarray.ndarray import NDArray
+    from .ops import registry as _reg
+
+    st = _st()
+    tape = st.tape
+    grad_map = {}
+    prev_rec = set_recording(True)
+    try:
+        for h, hg in zip(heads, head_grads):
+            if hg is None:
+                hg = NDArray(jnp.ones(h.shape, dtype=h._data.dtype),
+                             ctx=h.context)
+            key = id(h)
+            grad_map[key] = grad_map[key] + hg if key in grad_map else hg
+
+        visited = set()
+        for entry in list(reversed(tape)):
+            out_ids = [id(o) for o in entry.outputs]
+            if not any(oid in grad_map for oid in out_ids):
+                continue
+            visited.add(id(entry))
+            cts = []
+            for o, oid in zip(entry.outputs, out_ids):
+                g = grad_map.get(oid)
+                cts.append(g if g is not None else
+                           NDArray(jnp.zeros(o.shape, dtype=o._data.dtype),
+                                   ctx=o.context))
+            if isinstance(entry, _FunctionTapeEntry):
+                igrads = entry.func.backward(*cts)  # recording stays on
+                if not isinstance(igrads, (list, tuple)):
+                    igrads = [igrads]
+                nd_igrads = [g if (g is None or isinstance(g, NDArray))
+                             else NDArray(g) for g in igrads]
+            else:
+                gop = _grad_opdef(entry.op.name)
+                gparams = {"_base": entry.op.name,
+                           "_bparams": tuple(sorted(entry.params.items())),
+                           "_n_in": len(entry.input_values),
+                           "_n_ct": len(cts)}
+                in_vals = list(entry.input_values) + [c._data for c in cts]
+                results = _reg.eager_call(gop, gparams, in_vals)
+                nd_igrads = [NDArray(r) for r in results]
+                pad = len(entry.input_values) - len(entry.inputs)
+                nd_inputs = list(entry.inputs) + [None] * pad + list(cts)
+                _record_op(gop, gparams, nd_inputs, in_vals, nd_igrads,
+                           len(nd_igrads))
+                for o in nd_igrads:
+                    o._requires_grad = True
+            for inp, ig in zip(entry.inputs, nd_igrads):
+                if inp is None or ig is None:
+                    continue
+                if not getattr(inp, "_requires_grad", False):
+                    continue
+                key = id(inp)
+                grad_map[key] = grad_map[key] + ig if key in grad_map else ig
+    finally:
+        set_recording(prev_rec)
+    if not retain_graph:
+        st.tape = [e for e in st.tape if id(e) not in visited]
+    return grad_map
+
+
 def _compute_gradients(heads, head_grads, retain_graph=False):
     """Reverse tape walk; returns dict id(NDArray) -> jax grad array."""
     import jax.numpy as jnp
@@ -232,9 +337,6 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
          train_mode=True):
     """Return gradients as new arrays instead of writing `.grad`
     (reference `autograd.py:270 grad`)."""
-    if create_graph:
-        raise MXNetError("create_graph=True (higher-order eager grad) is not yet "
-                         "supported; use hybridized blocks + symbolic grad instead")
     if not isinstance(heads, (list, tuple)):
         heads = [heads]
     single = not isinstance(variables, (list, tuple))
@@ -245,15 +347,26 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
     elif not isinstance(head_grads, (list, tuple)):
         head_grads = [head_grads]
     retain = bool(retain_graph) if retain_graph is not None else create_graph
-    grad_map = _compute_gradients(heads, head_grads, retain)
     from .ndarray.ndarray import NDArray
     out = []
-    for v in variables:
-        g = grad_map.get(id(v))
-        if g is None:
-            raise MXNetError("Some variables are not used by or not reachable "
-                             "from the heads")
-        out.append(NDArray(g, ctx=v.context))
+    if create_graph:
+        grad_map = _compute_gradients_recorded(heads, head_grads, retain)
+        for v in variables:
+            g = grad_map.get(id(v))
+            if g is None:
+                raise MXNetError("Some variables are not used by or not "
+                                 "reachable from the heads")
+            # return the tape-recorded NDArray itself so later backward
+            # passes can differentiate through it
+            out.append(g)
+    else:
+        grad_map = _compute_gradients(heads, head_grads, retain)
+        for v in variables:
+            g = grad_map.get(id(v))
+            if g is None:
+                raise MXNetError("Some variables are not used by or not "
+                                 "reachable from the heads")
+            out.append(NDArray(g, ctx=v.context))
     return out[0] if single else out
 
 

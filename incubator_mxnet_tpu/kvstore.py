@@ -247,7 +247,7 @@ class KVStoreTPU(KVStore):
     def __init__(self, kind="tpu"):
         super().__init__(kind)
         self._meshes = {}        # tuple(device ids) -> Mesh
-        self._allreduce_jit = {}  # n_devices -> jitted shard_map psum
+        self._allreduce_jit = {}  # tuple(device ids) -> jitted shard_map psum
         # last mesh a key was pushed over; lets pull() reuse the same devices
         self._key_mesh = {}
 
@@ -264,8 +264,8 @@ class KVStoreTPU(KVStore):
     def _allreduce(self, mesh):
         """One jitted all-reduce over the mesh: (N, *s) sharded on 'dev'
         → summed (*s), replicated on every participating device."""
-        n = mesh.devices.size
-        fn = self._allreduce_jit.get(n)
+        ids = tuple(d.id for d in mesh.devices.flat)
+        fn = self._allreduce_jit.get(ids)
         if fn is None:
             import jax
             from jax.sharding import PartitionSpec as P
@@ -278,7 +278,7 @@ class KVStoreTPU(KVStore):
 
             fn = jax.jit(shard_map(_psum, mesh=mesh,
                                    in_specs=P("dev"), out_specs=P()))
-            self._allreduce_jit[n] = fn
+            self._allreduce_jit[ids] = fn
         return fn
 
     def _reduce(self, vals):
@@ -335,8 +335,7 @@ class KVStoreTPU(KVStore):
             if mesh is not None and len(tgt_list) > 1 and \
                     tgt_devs <= mesh_devs:
                 # one broadcast collective over the mesh, then local shards
-                rep = jax.device_put(src._data.astype(tgt_list[0].dtype),
-                                     NamedSharding(mesh, P()))
+                rep = jax.device_put(src._data, NamedSharding(mesh, P()))
                 by_dev = {s.device.id: s.data for s in rep.addressable_shards}
                 for tgt in tgt_list:
                     tgt._set_data(by_dev[tgt.context.jax_device.id]

@@ -203,3 +203,28 @@ def test_tape_cleared_on_new_record_scope():
     assert len(_st().tape) == 2  # only the last scope's entries survive
     y.backward()  # standard pattern: backward after scope exit still works
     np.testing.assert_allclose(x.grad.asnumpy(), [2.0, 2.0])
+
+
+def test_higher_order_grad_through_backward():
+    # d/dx of (dy/dx)^2 where y = x^3: dy/dx = 3x^2, z = 9x^4, dz/dx = 36x^3
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        dy_dx = autograd.grad(y, [x], create_graph=True, retain_graph=True)[0]
+        z = nd.sum(dy_dx * dy_dx)
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 36 * x.asnumpy() ** 3,
+                               rtol=1e-5)
+
+
+def test_second_derivative_two_grad_calls():
+    # d2/dx2 sin(x) = -sin(x)
+    x = nd.array([0.3, 1.1, -0.7])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.sin(x)
+        g1 = autograd.grad(y, [x], create_graph=True, retain_graph=True)[0]
+        g2 = autograd.grad(g1, [x], create_graph=False, retain_graph=False)[0]
+    np.testing.assert_allclose(g1.asnumpy(), np.cos(x.asnumpy()), rtol=1e-5)
+    np.testing.assert_allclose(g2.asnumpy(), -np.sin(x.asnumpy()), rtol=1e-5)
