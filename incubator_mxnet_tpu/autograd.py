@@ -205,11 +205,14 @@ def _compute_gradients_recorded(heads, head_grads, retain_graph):
                            NDArray(jnp.zeros(o.shape, dtype=o._data.dtype),
                                    ctx=o.context))
             if isinstance(entry, _FunctionTapeEntry):
-                igrads = entry.func.backward(*cts)  # recording stays on
-                if not isinstance(igrads, (list, tuple)):
-                    igrads = [igrads]
-                nd_igrads = [g if (g is None or isinstance(g, NDArray))
-                             else NDArray(g) for g in igrads]
+                # Function.forward runs under pause(), so tensors it saved
+                # for backward are off-tape — second-order grads through the
+                # user's backward would be silently wrong; refuse loudly
+                raise MXNetError(
+                    "create_graph=True cannot differentiate through a custom "
+                    "autograd.Function (its forward intermediates are not on "
+                    "the tape); express the op with registered operators or "
+                    "take first-order gradients only")
             else:
                 gop = _grad_opdef(entry.op.name)
                 gparams = {"_base": entry.op.name,
@@ -348,25 +351,19 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
         head_grads = [head_grads]
     retain = bool(retain_graph) if retain_graph is not None else create_graph
     from .ndarray.ndarray import NDArray
-    out = []
     if create_graph:
         grad_map = _compute_gradients_recorded(heads, head_grads, retain)
-        for v in variables:
-            g = grad_map.get(id(v))
-            if g is None:
-                raise MXNetError("Some variables are not used by or not "
-                                 "reachable from the heads")
-            # return the tape-recorded NDArray itself so later backward
-            # passes can differentiate through it
-            out.append(g)
     else:
         grad_map = _compute_gradients(heads, head_grads, retain)
-        for v in variables:
-            g = grad_map.get(id(v))
-            if g is None:
-                raise MXNetError("Some variables are not used by or not "
-                                 "reachable from the heads")
-            out.append(NDArray(g, ctx=v.context))
+    out = []
+    for v in variables:
+        g = grad_map.get(id(v))
+        if g is None:
+            raise MXNetError("Some variables are not used by or not "
+                             "reachable from the heads")
+        # create_graph returns the tape-recorded NDArray itself so later
+        # backward passes can differentiate through it
+        out.append(g if isinstance(g, NDArray) else NDArray(g, ctx=v.context))
     return out[0] if single else out
 
 

@@ -1,0 +1,36 @@
+"""Multi-process distributed training.
+
+TPU-native re-expression of the reference's ps-lite stack
+(`src/kvstore/kvstore_dist.h:44-412` worker, `kvstore_dist_server.h:155-559`
+server, `ps-lite/` transport, `tools/launch.py:71` launcher):
+
+* `transport`  — length-prefixed message framing over TCP sockets (the
+  ps-lite Van/Customer roles collapsed to one framed request/response
+  channel; localhost and DCN both work).
+* `server`     — the parameter-server process: aggregates sync pushes from
+  all workers, applies the optimizer server-side when one is attached
+  (`kvstore_dist_server.h` DataHandleDefault), and answers versioned pulls.
+* `kvstore_dist` — the worker-side KVStore: reduces local device shards
+  with the single-collective engine (kvstore.KVStoreTPU), then pushes one
+  merged array per key over the wire.
+* `collective` — `jax.distributed` bootstrap for real multi-host TPU pods,
+  where push/pull lower to XLA collectives over ICI/DCN instead of the
+  socket server (the NCCL/MPI replacement).
+
+Env contract (names kept from the reference's dmlc tracker so existing
+launch tooling maps 1:1): DMLC_ROLE, DMLC_PS_ROOT_URI, DMLC_PS_ROOT_PORT,
+DMLC_NUM_WORKER, DMLC_NUM_SERVER, DMLC_RANK.
+"""
+from . import collective, transport
+from .kvstore_dist import KVStoreDist
+
+__all__ = ["collective", "transport", "KVStoreDist", "ParameterServer"]
+
+
+def __getattr__(name):
+    # lazy: `python -m incubator_mxnet_tpu.dist.server` would otherwise
+    # import server via the package first (runpy double-import warning)
+    if name == "ParameterServer":
+        from .server import ParameterServer
+        return ParameterServer
+    raise AttributeError(name)

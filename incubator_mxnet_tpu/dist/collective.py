@@ -1,0 +1,54 @@
+"""Multi-host collective bootstrap (`jax.distributed`).
+
+On a real TPU pod the data plane does NOT go through the parameter server:
+gradients all-reduce over ICI/DCN via XLA collectives, which is the
+reference's NCCL/MPI role (`kvstore_nccl.h`, `mxnet.kvstore` dist device
+modes) done the TPU way.  This module wires the process group so that
+`jax.process_index()/process_count()` and cross-host `psum` work; the
+sharded train step itself comes from `incubator_mxnet_tpu.parallel`.
+
+Env: DMLC_PS_ROOT_URI/PORT double as the JAX coordinator address when
+JAX_COORDINATOR_ADDRESS is unset, so one launcher config drives both the
+socket control plane and the XLA data plane.
+"""
+from __future__ import annotations
+
+import os
+
+_initialized = False
+
+
+def init_process_group(coordinator=None, num_processes=None, process_id=None):
+    """Idempotent `jax.distributed.initialize` from the dmlc-style env."""
+    global _initialized
+    if _initialized:
+        return True
+    import jax
+    coordinator = coordinator or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS",
+        "%s:%s" % (os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
+                   int(os.environ.get("DMLC_PS_ROOT_PORT", 9091)) + 1))
+    num_processes = int(num_processes if num_processes is not None
+                        else os.environ.get("DMLC_NUM_WORKER", 1))
+    process_id = int(process_id if process_id is not None
+                     else os.environ.get("DMLC_RANK", 0))
+    if num_processes <= 1:
+        _initialized = True
+        return True
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+    return True
+
+
+def finalize():
+    global _initialized
+    if not _initialized:
+        return
+    import jax
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass
+    _initialized = False

@@ -1,0 +1,131 @@
+"""Worker-side distributed KVStore.
+
+The `src/kvstore/kvstore_dist.h:44-412` role: locally reduce the per-device
+gradient shards (one XLA all-reduce over the chip mesh — KVStoreTPU's
+engine), then exchange ONE merged array per key with the parameter server
+over the socket transport.  `dist_sync` aggregates a round across all
+workers before anyone observes it; `dist_async` applies pushes immediately.
+
+The reference encodes worker identity via the dmlc tracker env
+(DMLC_RANK/DMLC_NUM_WORKER etc.); the same names are honored here so
+`tools/launch.py` and existing cluster scripts port directly.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+from ..base import MXNetError
+from ..kvstore import KVStoreTPU, _normalize, _normalize_push, _key
+from .transport import Channel
+
+
+class KVStoreDist(KVStoreTPU):
+    def __init__(self, kind="dist_sync"):
+        super().__init__(kind)
+        self._sync = "async" not in kind
+        host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+        port = int(os.environ.get("DMLC_PS_ROOT_PORT", 9091))
+        self._chan = Channel(host, port)
+        env_rank = os.environ.get("DMLC_RANK")
+        reply = self._chan.request(
+            {"cmd": "register", "role": "worker",
+             "rank": int(env_rank) if env_rank is not None else None})
+        self._rank = reply["rank"]
+        self._num_workers = reply["num_workers"]
+        self._push_count = {}    # key -> completed sync pushes by this worker
+        self._update_on_kvstore = False
+
+    # -- identity ------------------------------------------------------------
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._num_workers
+
+    # -- data plane ----------------------------------------------------------
+    def init(self, key, value):
+        """Rank 0 ships initial weights to the server; everyone barriers so
+        no worker pulls before the key exists (reference `kvstore_dist.h`
+        InitImpl pushes only on worker 0, then Barrier)."""
+        keys, values = _normalize(key, value)
+        if self._rank == 0:
+            reply = self._chan.request(
+                {"cmd": "init", "keys": [_key(k) for k in keys],
+                 "values": [v.asnumpy() for v in values]})
+            _check(reply)
+        self._barrier()
+        # keep a local copy so pull() can place results on local devices
+        for k, v in zip(keys, values):
+            self._store[_key(k)] = v.copyto(self._store_ctx)
+
+    def push(self, key, value, priority=0):
+        keys, values = _normalize_push(key, value)
+        for k, vals in zip(keys, values):
+            sk = _key(k)
+            if sk not in self._store:
+                raise MXNetError(f"Key {k} has not been initialized")
+            merged = self._reduce(vals)      # one collective over local chips
+            if self._compression is not None:
+                merged = self._compress(sk, merged)
+            reply = self._chan.request(
+                {"cmd": "push", "key": sk, "value": merged.asnumpy(),
+                 "sync": self._sync})
+            _check(reply)
+            if self._sync:
+                self._push_count[sk] = self._push_count.get(sk, 0) + 1
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if out is None:
+            raise MXNetError("pull requires out=")
+        keys, outs = _normalize_push(key, out)
+        for k, tgt_list in zip(keys, outs):
+            sk = _key(k)
+            reply = self._chan.request(
+                {"cmd": "pull", "key": sk,
+                 "min_version": self._push_count.get(sk, 0)})
+            _check(reply)
+            src = self._store.get(sk)
+            if src is None or src.shape != reply["value"].shape:
+                from ..ndarray.ndarray import array
+                self._store[sk] = array(reply["value"], ctx=self._store_ctx)
+            else:
+                src._set_data(src._data * 0 + reply["value"].astype(src.dtype))
+            # local fan-out reuses the single-collective broadcast engine
+            super().pull(k, out=tgt_list)
+
+    # -- control plane -------------------------------------------------------
+    def set_optimizer(self, optimizer):
+        """Ship the optimizer to the server (reference pickles it through
+        MXKVStoreSendCommmandToServers, `python/mxnet/kvstore.py:535`)."""
+        self._optimizer = optimizer
+        self._update_on_kvstore = True
+        if self._rank == 0:
+            reply = self._chan.request(
+                {"cmd": "set_optimizer",
+                 "optimizer": pickle.dumps(optimizer)})
+            _check(reply)
+        self._barrier()
+
+    def _barrier(self):
+        _check(self._chan.request({"cmd": "barrier"}))
+
+    def close(self):
+        try:
+            self._chan.request({"cmd": "stop"})
+        finally:
+            self._chan.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def _check(reply):
+    if "error" in reply:
+        raise MXNetError(reply["error"])
+    return reply

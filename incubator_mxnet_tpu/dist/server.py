@@ -1,0 +1,210 @@
+"""Parameter-server process.
+
+The role of `src/kvstore/kvstore_dist_server.h:155-559` (KVStoreDistServer):
+holds the authoritative copy of every key, merges sync pushes from all
+workers, runs the optimizer server-side when one has been shipped over
+(`DataHandleDefault`, the `MXNET_KVSTORE_BIGARRAY_BOUND` sharding of the
+reference is unnecessary — one server suffices for control-plane traffic
+because gradient all-reduce rides the TPU ICI mesh, not this socket path).
+
+Sync semantics (`dist_sync`): each key carries a version counter equal to
+the number of completed aggregation rounds.  A push contributes to the
+current round; the round applies (updater or overwrite-with-sum) when all
+`num_workers` contributions arrive.  A worker's pull waits until the
+version reaches its own completed-push count, which reproduces the
+reference guarantee that a pull issued after a push observes the round
+that push joined (`kvstore_dist_server.h` DataHandleDefault + Response).
+
+Async (`dist_async`): every push applies immediately (`DataHandleAsync`).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socketserver
+import threading
+
+import numpy as np
+
+from .transport import recv_msg, send_msg
+
+
+class _State:
+    def __init__(self, num_workers):
+        self.num_workers = num_workers
+        self.cond = threading.Condition()
+        self.store = {}          # key -> np.ndarray
+        self.version = {}        # key -> completed rounds
+        self.agg = {}            # key -> [sum, count] for the open round
+        self.updater = None
+        self.multi_precision = {}  # key -> fp32 master copy (server-side)
+        self.barrier_count = 0
+        self.barrier_gen = 0
+        self.next_rank = 0
+        self.stopped = 0
+
+
+class ParameterServer:
+    """Threaded TCP parameter server; one handler thread per worker."""
+
+    def __init__(self, host="127.0.0.1", port=0, num_workers=None):
+        self.num_workers = int(num_workers if num_workers is not None
+                               else os.environ.get("DMLC_NUM_WORKER", 1))
+        self._state = _State(self.num_workers)
+        state = self._state
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        msg = recv_msg(self.request)
+                    except (EOFError, ConnectionError, OSError):
+                        break
+                    reply = outer._dispatch(msg)
+                    send_msg(self.request, reply)
+                    if msg.get("cmd") == "stop":
+                        break
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self):
+        self.start()
+        st = self._state
+        with st.cond:
+            st.cond.wait_for(lambda: st.stopped >= st.num_workers)
+        self.shutdown()
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+    # -- request dispatch ----------------------------------------------------
+    def _dispatch(self, msg):
+        cmd = msg.get("cmd")
+        st = self._state
+        if cmd == "register":
+            with st.cond:
+                rank = msg.get("rank")
+                if rank is None:
+                    rank = st.next_rank
+                st.next_rank = max(st.next_rank, rank + 1)
+            return {"rank": rank, "num_workers": st.num_workers}
+
+        if cmd == "init":
+            with st.cond:
+                for k, v in zip(msg["keys"], msg["values"]):
+                    if k not in st.store:
+                        st.store[k] = np.asarray(v)
+                        st.version[k] = 0
+                st.cond.notify_all()
+            return {"ok": True}
+
+        if cmd == "push":
+            k, v, sync = msg["key"], np.asarray(msg["value"]), msg["sync"]
+            with st.cond:
+                if k not in st.store:
+                    return {"error": f"Key {k} has not been initialized"}
+                if sync:
+                    ent = st.agg.setdefault(k, [np.zeros_like(st.store[k],
+                                                              dtype=v.dtype),
+                                                0])
+                    ent[0] = ent[0] + v
+                    ent[1] += 1
+                    if ent[1] >= st.num_workers:
+                        self._apply(k, ent[0])
+                        del st.agg[k]
+                        st.version[k] += 1
+                        st.cond.notify_all()
+                else:
+                    self._apply(k, v)
+                    st.version[k] += 1
+                    st.cond.notify_all()
+                return {"version": st.version[k]}
+
+        if cmd == "pull":
+            k = msg["key"]
+            min_version = msg.get("min_version", 0)
+            with st.cond:
+                if k not in st.store:
+                    return {"error": f"Key {k} has not been initialized"}
+                ok = st.cond.wait_for(
+                    lambda: st.version.get(k, 0) >= min_version, timeout=300)
+                if not ok:
+                    return {"error": f"pull({k}) timed out waiting for "
+                                     f"version {min_version}"}
+                return {"value": st.store[k], "version": st.version[k]}
+
+        if cmd == "barrier":
+            with st.cond:
+                st.barrier_count += 1
+                gen = st.barrier_gen
+                if st.barrier_count >= st.num_workers:
+                    st.barrier_count = 0
+                    st.barrier_gen += 1
+                    st.cond.notify_all()
+                else:
+                    st.cond.wait_for(lambda: st.barrier_gen > gen,
+                                     timeout=300)
+            return {"ok": True}
+
+        if cmd == "set_optimizer":
+            # reference ships the optimizer with MXKVStoreSendCommmandToServers
+            # (kvstore_dist.h SendCommandToServers → server CommandHandle)
+            from .. import optimizer as opt
+            optimizer = pickle.loads(msg["optimizer"])
+            with st.cond:
+                st.updater = opt.get_updater(optimizer)
+            return {"ok": True}
+
+        if cmd == "stop":
+            with st.cond:
+                st.stopped += 1
+                st.cond.notify_all()
+            return {"ok": True}
+
+        return {"error": f"unknown command {cmd!r}"}
+
+    def _apply(self, k, merged):
+        """Apply one completed round: server-side optimizer step, or store
+        the aggregated gradient for worker-side updates (update_on_kvstore
+        False — reference `kvstore_dist_server.h` both paths)."""
+        st = self._state
+        if st.updater is None:
+            st.store[k] = np.asarray(merged)
+            return
+        from ..ndarray.ndarray import NDArray, array
+        weight = array(st.store[k])
+        grad = array(np.asarray(merged, dtype=st.store[k].dtype))
+        ukey = int(k) if str(k).isdigit() else k
+        st.updater(ukey, grad, weight)
+        st.store[k] = weight.asnumpy()
+
+
+def main():
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")  # servers never touch chips
+    except Exception:
+        pass
+    port = int(os.environ.get("DMLC_PS_ROOT_PORT", 9091))
+    host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+    server = ParameterServer(host=host, port=port)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,90 @@
+"""Framed message transport for the parameter-server protocol.
+
+The ps-lite Van (`ps-lite/src/van.cc`) moves zero-copy protobuf messages
+over ZMQ; here a message is one length-prefixed frame on a TCP stream:
+
+    [8-byte big-endian length][payload]
+
+The payload is a small header dict plus raw ndarray bytes, serialized with
+pickle protocol 5 (out-of-band buffers keep large arrays as single
+memoryview copies — the practical equivalent of ps-lite's zero-copy SArray
+for a localhost/DCN transport).  The channel is trusted (same security
+model as ps-lite: the training cluster is a private network).
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+
+_LEN = struct.Struct(">Q")
+
+
+def send_msg(sock: socket.socket, obj) -> None:
+    buffers = []
+    payload = pickle.dumps(obj, protocol=5, buffer_callback=buffers.append)
+    raws = [b.raw() for b in buffers]
+    # frame: payload length, out-of-band buffer count, payload, then each
+    # buffer prefixed with its own length
+    sock.sendall(_LEN.pack(len(payload)))
+    sock.sendall(_LEN.pack(len(raws)))
+    sock.sendall(payload)
+    for r in raws:
+        sock.sendall(_LEN.pack(len(r)))
+        sock.sendall(r)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise EOFError("peer closed")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def recv_msg(sock: socket.socket):
+    plen = _LEN.unpack(_recv_exact(sock, 8))[0]
+    nbuf = _LEN.unpack(_recv_exact(sock, 8))[0]
+    payload = _recv_exact(sock, plen)
+    bufs = []
+    for _ in range(nbuf):
+        blen = _LEN.unpack(_recv_exact(sock, 8))[0]
+        bufs.append(_recv_exact(sock, blen))
+    return pickle.loads(payload, buffers=bufs)
+
+
+class Channel:
+    """One request/response channel to the server (worker side).
+
+    Connection retries cover the server's startup window — workers and
+    server launch concurrently (the reference tracker has the same race and
+    the same answer: ps-lite nodes retry until the scheduler is up).
+    """
+
+    def __init__(self, host: str, port: int, timeout: float = 120.0,
+                 connect_wait: float = 90.0):
+        import time
+        deadline = time.monotonic() + connect_wait
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port),
+                                                      timeout=timeout)
+                break
+            except (ConnectionRefusedError, socket.timeout, OSError):
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.3)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def request(self, obj):
+        send_msg(self._sock, obj)
+        return recv_msg(self._sock)
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass

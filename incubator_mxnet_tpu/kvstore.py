@@ -387,7 +387,25 @@ def create(name="local"):
         return KVStoreTPU("device")
     if name in ("local", "local_allreduce_cpu"):
         return KVStore("local")
-    if name in ("dist_sync", "dist_async", "dist_device_sync", "dist"):
-        store = KVStore(name)
-        return store
+    if name in ("dist_sync", "dist_async", "dist_device_sync",
+                "dist_sync_device", "dist"):
+        import os
+        role = os.environ.get("DMLC_ROLE")
+        if role in ("server", "scheduler"):
+            # the reference runs the same user script on server hosts; the
+            # process becomes the server and never returns to user code
+            # (python/mxnet/kvstore_server.py _init_kvstore_server_module)
+            import sys
+            from .dist.server import ParameterServer
+            ParameterServer(
+                host=os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
+                port=int(os.environ.get("DMLC_PS_ROOT_PORT", 9091)),
+            ).serve_forever()
+            sys.exit(0)
+        if os.environ.get("DMLC_PS_ROOT_URI") or role == "worker":
+            from .dist.kvstore_dist import KVStoreDist
+            return KVStoreDist(name)
+        # no tracker env: single-process stand-in with dist bookkeeping
+        # (how the reference's unit tests run dist kvstores too)
+        return KVStore(name)
     raise MXNetError(f"Unknown KVStore type {name}")

@@ -1,0 +1,155 @@
+"""Multi-process distributed kvstore tests.
+
+The reference exercises dist kvstores by launching real localhost worker
+processes against a parameter server (`tests/nightly/dist_sync_kvstore.py:30-60`
+via `tools/launch.py`); this does the same with small tensors so it runs in
+CI: every worker pushes rank-dependent values and asserts the aggregated
+result is identical everywhere.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import os, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+kv = mx.kv.create("dist_sync")
+rank, nw = kv.rank, kv.num_workers
+assert nw == int(os.environ["DMLC_NUM_WORKER"]), (rank, nw)
+
+# round-trip 1: plain aggregation (no optimizer -> pull returns the sum)
+kv.init("3", nd.zeros((4, 2)))
+kv.push("3", nd.ones((4, 2)) * (rank + 1))
+out = nd.zeros((4, 2))
+kv.pull("3", out=out)
+expect = np.full((4, 2), sum(r + 1 for r in range(nw)), "f4")
+np.testing.assert_allclose(out.asnumpy(), expect)
+
+# round-trip 2: versioned second round must not mix with round 1
+kv.push("3", nd.ones((4, 2)) * 10 * (rank + 1))
+out2 = nd.zeros((4, 2))
+kv.pull("3", out=out2)
+np.testing.assert_allclose(out2.asnumpy(), 10 * expect)
+
+# multi-device push: per-device shards reduce locally before the wire
+devs = [mx.cpu(i) for i in range(min(4, len(jax.devices())))]
+kv.init("md", nd.zeros((2, 2)))
+kv.push("md", [nd.ones((2, 2), ctx=d) for d in devs])
+md = nd.zeros((2, 2))
+kv.pull("md", out=md)
+np.testing.assert_allclose(md.asnumpy(), len(devs) * nw)
+
+# server-side optimizer: weight = w0 - lr * sum(grads) each round
+kv.init("w", nd.ones((3,)))
+kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0 / nw))
+for step in range(3):
+    kv.push("w", nd.ones((3,)) * (rank + 1))
+    w = nd.zeros((3,))
+    kv.pull("w", out=w)
+    grad_mean = sum(r + 1 for r in range(nw)) / nw
+    np.testing.assert_allclose(
+        w.asnumpy(), 1.0 - 0.1 * grad_mean * (step + 1), rtol=1e-5)
+
+kv._barrier()
+kv.close()
+print("worker %d OK" % rank)
+"""
+
+
+@pytest.mark.parametrize("n_workers", [2, 4])
+def test_dist_sync_multiprocess(tmp_path, n_workers):
+    from incubator_mxnet_tpu.dist.server import ParameterServer
+
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    server = ParameterServer(num_workers=n_workers).start()
+    env = dict(os.environ,
+               DMLC_PS_ROOT_URI="127.0.0.1",
+               DMLC_PS_ROOT_PORT=str(server.port),
+               DMLC_NUM_WORKER=str(n_workers),
+               DMLC_ROLE="worker",
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=4",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    procs = [subprocess.Popen([sys.executable, str(script)],
+                              env=dict(env, DMLC_RANK=str(r)),
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT)
+             for r in range(n_workers)]
+    outs = [p.communicate(timeout=240)[0].decode() for p in procs]
+    server.shutdown()
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {r} failed:\n{out}"
+        assert f"worker {r} OK" in out
+
+
+def test_launcher(tmp_path):
+    """tools/launch.py spawns server+workers and propagates exit codes."""
+    script = tmp_path / "trivial.py"
+    script.write_text(
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import incubator_mxnet_tpu as mx\n"
+        "from incubator_mxnet_tpu import nd\n"
+        "kv = mx.kv.create('dist_sync')\n"
+        "kv.init('0', nd.zeros((2,)))\n"
+        "kv.push('0', nd.ones((2,)))\n"
+        "o = nd.zeros((2,))\n"
+        "kv.pull('0', out=o)\n"
+        "assert o.asnumpy()[0] == kv.num_workers\n"
+        "kv.close()\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    rc = subprocess.call(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", sys.executable, str(script)],
+        env=env, timeout=240)
+    assert rc == 0
+
+
+def test_async_push_applies_immediately():
+    """dist_async: a push applies without waiting for the other worker
+    (two in-process clients; only rank 0 pushes)."""
+    import threading
+
+    from incubator_mxnet_tpu.dist.server import ParameterServer
+    from incubator_mxnet_tpu.dist.kvstore_dist import KVStoreDist
+    from incubator_mxnet_tpu import nd
+
+    server = ParameterServer(num_workers=2).start()
+    old = {k: os.environ.get(k) for k in
+           ("DMLC_PS_ROOT_URI", "DMLC_PS_ROOT_PORT", "DMLC_RANK")}
+    os.environ.update(DMLC_PS_ROOT_URI="127.0.0.1",
+                      DMLC_PS_ROOT_PORT=str(server.port), DMLC_RANK="0")
+    try:
+        kv0 = KVStoreDist("dist_async")
+        os.environ["DMLC_RANK"] = "1"
+        kv1 = KVStoreDist("dist_async")
+        # init barriers across all workers: run rank 1's from a thread
+        t = threading.Thread(target=kv1.init, args=("k", nd.zeros((2,))))
+        t.start()
+        kv0.init("k", nd.zeros((2,)))
+        t.join(timeout=60)
+        assert not t.is_alive()
+        kv0.push("k", nd.ones((2,)))   # rank 1 never pushes
+        out = nd.zeros((2,))
+        kv0.pull("k", out=out)
+        np.testing.assert_allclose(out.asnumpy(), 1.0)
+        kv0.close()
+        kv1.close()
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        server.shutdown()

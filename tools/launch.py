@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Localhost/multi-node job launcher.
+
+CLI-compatible subset of the reference launcher (`tools/launch.py:71`):
+
+    python tools/launch.py -n 4 [-s 1] [--launcher local] python train.py ...
+
+Spawns the parameter server and N worker processes with the dmlc tracker
+env (DMLC_ROLE/DMLC_PS_ROOT_URI/DMLC_PS_ROOT_PORT/DMLC_NUM_WORKER/
+DMLC_NUM_SERVER/DMLC_RANK) set, waits for the workers, then tears the
+server down.  Only the `local` launcher is implemented — `ssh`/`mpi`/
+`yarn`/`sge` cluster modes are out of scope for a single-image build; the
+env contract is identical, so any external tracker that sets these
+variables works unchanged.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Launch a distributed job (reference tools/launch.py)")
+    parser.add_argument("-n", "--num-workers", type=int, required=True)
+    parser.add_argument("-s", "--num-servers", type=int, default=1,
+                        help="only 1 supported (single-server control plane)")
+    parser.add_argument("--launcher", default="local",
+                        choices=["local"],
+                        help="cluster launchers: set the DMLC_* env with "
+                             "your own tracker instead")
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.error("no command given")
+    if args.num_servers != 1:
+        print("warning: only 1 server is used; gradient traffic rides the "
+              "TPU mesh, the server is control-plane only", file=sys.stderr)
+
+    port = _free_port()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pypath = repo_root + os.pathsep + os.environ.get("PYTHONPATH", "")
+    base_env = dict(os.environ,
+                    PYTHONPATH=pypath.rstrip(os.pathsep),
+                    DMLC_PS_ROOT_URI="127.0.0.1",
+                    DMLC_PS_ROOT_PORT=str(port),
+                    DMLC_NUM_WORKER=str(args.num_workers),
+                    DMLC_NUM_SERVER="1")
+
+    server = subprocess.Popen(
+        [sys.executable, "-m", "incubator_mxnet_tpu.dist.server"],
+        env=dict(base_env, DMLC_ROLE="server"))
+
+    workers = []
+    for rank in range(args.num_workers):
+        workers.append(subprocess.Popen(
+            args.command,
+            env=dict(base_env, DMLC_ROLE="worker", DMLC_RANK=str(rank))))
+
+    rc = 0
+    for w in workers:
+        rc = w.wait() or rc
+    try:
+        # a clean run ends when every worker has sent its stop command; on
+        # worker failure the server never hears them all, so time out and kill
+        server.wait(timeout=15 if rc else 60)
+    except subprocess.TimeoutExpired:
+        server.terminate()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
