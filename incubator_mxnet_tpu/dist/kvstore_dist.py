@@ -72,10 +72,16 @@ class KVStoreDist(KVStoreTPU):
                 merged = self._compress(sk, merged)
             reply = self._chan.request(
                 {"cmd": "push", "key": sk, "value": merged.asnumpy(),
-                 "sync": self._sync})
+                 "sync": self._sync, "rank": self._rank})
             _check(reply)
             if self._sync:
                 self._push_count[sk] = self._push_count.get(sk, 0) + 1
+            # remember the device set so pull() can use the one-collective
+            # broadcast instead of per-target copies
+            if len(vals) > 1:
+                devs = [v.context.jax_device for v in vals]
+                if len({d.id for d in devs}) == len(devs):
+                    self._key_mesh[sk] = self._mesh_for(devs)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         if out is None:

@@ -35,9 +35,12 @@ class _State:
         self.cond = threading.Condition()
         self.store = {}          # key -> np.ndarray
         self.version = {}        # key -> completed rounds
-        self.agg = {}            # key -> [sum, count] for the open round
+        # key -> list of open rounds, each {"sum": array, "got": set(ranks)};
+        # a worker's nth push joins round n (ps-lite timestamp semantics:
+        # two pushes from one worker are two rounds, each still waiting for
+        # every other worker)
+        self.agg = {}
         self.updater = None
-        self.multi_precision = {}  # key -> fp32 master copy (server-side)
         self.barrier_count = 0
         self.barrier_gen = 0
         self.next_rank = 0
@@ -115,20 +118,29 @@ class ParameterServer:
 
         if cmd == "push":
             k, v, sync = msg["key"], np.asarray(msg["value"]), msg["sync"]
+            rank = msg.get("rank", 0)
             with st.cond:
                 if k not in st.store:
                     return {"error": f"Key {k} has not been initialized"}
                 if sync:
-                    ent = st.agg.setdefault(k, [np.zeros_like(st.store[k],
-                                                              dtype=v.dtype),
-                                                0])
-                    ent[0] = ent[0] + v
-                    ent[1] += 1
-                    if ent[1] >= st.num_workers:
-                        self._apply(k, ent[0])
-                        del st.agg[k]
+                    rounds = st.agg.setdefault(k, [])
+                    # this worker's next round: first it hasn't contributed to
+                    ent = next((r for r in rounds if rank not in r["got"]),
+                               None)
+                    if ent is None:
+                        ent = {"sum": np.zeros_like(st.store[k],
+                                                    dtype=v.dtype),
+                               "got": set()}
+                        rounds.append(ent)
+                    ent["sum"] = ent["sum"] + v
+                    ent["got"].add(rank)
+                    # apply completed rounds in order from the head
+                    while rounds and len(rounds[0]["got"]) >= st.num_workers:
+                        self._apply(k, rounds.pop(0)["sum"])
                         st.version[k] += 1
                         st.cond.notify_all()
+                    if not rounds:
+                        del st.agg[k]
                 else:
                     self._apply(k, v)
                     st.version[k] += 1
@@ -157,8 +169,15 @@ class ParameterServer:
                     st.barrier_gen += 1
                     st.cond.notify_all()
                 else:
-                    st.cond.wait_for(lambda: st.barrier_gen > gen,
-                                     timeout=300)
+                    ok = st.cond.wait_for(lambda: st.barrier_gen > gen,
+                                          timeout=300)
+                    if not ok:
+                        # withdraw this arrival so the generation count
+                        # stays consistent, and fail loudly: a missing
+                        # worker must not let the others "pass" the barrier
+                        st.barrier_count -= 1
+                        return {"error": "barrier timed out waiting for "
+                                         "all workers"}
             return {"ok": True}
 
         if cmd == "set_optimizer":
@@ -200,9 +219,10 @@ def main():
         jax.config.update("jax_platforms", "cpu")  # servers never touch chips
     except Exception:
         pass
+    # bind all interfaces: DMLC_PS_ROOT_URI names this host as workers see
+    # it, which need not be a locally bindable address on multi-homed hosts
     port = int(os.environ.get("DMLC_PS_ROOT_PORT", 9091))
-    host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
-    server = ParameterServer(host=host, port=port)
+    server = ParameterServer(host="", port=port)
     server.serve_forever()
 
 

@@ -64,19 +64,24 @@ class Channel:
     the same answer: ps-lite nodes retry until the scheduler is up).
     """
 
-    def __init__(self, host: str, port: int, timeout: float = 120.0,
+    def __init__(self, host: str, port: int, timeout: float | None = None,
                  connect_wait: float = 90.0):
         import time
         deadline = time.monotonic() + connect_wait
         while True:
             try:
                 self._sock = socket.create_connection((host, port),
-                                                      timeout=timeout)
+                                                      timeout=10.0)
                 break
             except (ConnectionRefusedError, socket.timeout, OSError):
                 if time.monotonic() >= deadline:
                     raise
                 time.sleep(0.3)
+        # requests block until the server answers: server-side waits (sync
+        # rounds, barriers) own the timeout policy — a client-side socket
+        # timeout shorter than those would cut a frame mid-stream and desync
+        # the channel
+        self._sock.settimeout(timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
     def request(self, obj):

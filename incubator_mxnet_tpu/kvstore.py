@@ -391,16 +391,27 @@ def create(name="local"):
                 "dist_sync_device", "dist"):
         import os
         role = os.environ.get("DMLC_ROLE")
-        if role in ("server", "scheduler"):
+        if role == "server":
             # the reference runs the same user script on server hosts; the
             # process becomes the server and never returns to user code
-            # (python/mxnet/kvstore_server.py _init_kvstore_server_module)
+            # (python/mxnet/kvstore_server.py _init_kvstore_server_module).
+            # Bind all local interfaces: DMLC_PS_ROOT_URI names this host
+            # as seen by WORKERS, which need not be a bindable local addr.
+            # Constraint vs the reference: one server, colocated with the
+            # root URI host (gradient traffic rides the TPU mesh, the
+            # server is control-plane only).
             import sys
             from .dist.server import ParameterServer
             ParameterServer(
-                host=os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
+                host="",
                 port=int(os.environ.get("DMLC_PS_ROOT_PORT", 9091)),
             ).serve_forever()
+            sys.exit(0)
+        if role == "scheduler":
+            # no scheduler in this architecture (no rendezvous needed: the
+            # single server's address is static); exit cleanly so external
+            # trackers that spawn one are satisfied
+            import sys
             sys.exit(0)
         if os.environ.get("DMLC_PS_ROOT_URI") or role == "worker":
             from .dist.kvstore_dist import KVStoreDist

@@ -22,4 +22,5 @@ from .collectives import (all_reduce, all_gather, reduce_scatter, ppermute,
 from .data_parallel import data_parallel_step, replicate, unreplicate
 from .tensor_parallel import shard_params, ShardingRules
 from .ring_attention import ring_attention, blockwise_attention
-from .pipeline import pipeline_step
+from .pipeline import pipeline_step, pipeline_train_step
+from .zero import zero_train_step, zero_update, zero_init_state

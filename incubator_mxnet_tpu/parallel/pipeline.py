@@ -59,3 +59,46 @@ def pipeline_step(stage_fn, n_microbatches, axis_name="pp"):
         return outputs
 
     return fwd
+
+
+def pipeline_train_step(stage_fn, loss_fn, n_microbatches, optimizer_update,
+                        axis_name="pp", remat=True):
+    """GPipe training over `axis_name`: forward all microbatches through the
+    stage pipeline, one fused backward, per-stage parameter update.
+
+    TPU-native design note: the forward schedule (`pipeline_step`) is an
+    ordinary differentiable scan-of-ppermute program, so the reverse
+    schedule — activations flowing backward through the inverse permutation,
+    gradients accumulating per stage across microbatch ticks — is *derived
+    by XLA* from the same program, instead of a hand-maintained backward
+    pass (what `kvstore_dist_server.h`-era frameworks schedule by hand).
+    `remat=True` rematerializes each stage in the backward pass (GPipe's
+    activation checkpointing), trading FLOPs for HBM.
+
+    stage_fn(stage_params, x) -> y            this rank's stage
+    loss_fn(outputs, targets) -> scalar       computed on the (broadcast)
+                                              pipeline outputs
+    optimizer_update(p, g) -> new_p           per-leaf update
+
+    Returns step(stage_params, microbatches, targets) -> (new_params, loss)
+    to be wrapped in shard_map with params sharded over `axis_name` (leading
+    stage dim) and microbatches/targets replicated or dp-sharded.
+    """
+    staged = jax.checkpoint(stage_fn) if remat else stage_fn
+    fwd = pipeline_step(staged, n_microbatches, axis_name)
+
+    def step(stage_params, microbatches, targets):
+        def loss_of(p):
+            out = fwd(p, microbatches)
+            return loss_fn(out, targets)
+        loss, grads = jax.value_and_grad(loss_of)(stage_params)
+        # every rank evaluates the same replicated loss, and the transpose of
+        # the output-broadcast psum sums all ranks' (identical) cotangents —
+        # normalize so grads match the non-pipelined composition exactly
+        n_stages = jax.lax.psum(1, axis_name)
+        grads = jax.tree_util.tree_map(lambda g: g / n_stages, grads)
+        new_params = jax.tree_util.tree_map(optimizer_update, stage_params,
+                                            grads)
+        return new_params, loss
+
+    return step

@@ -41,6 +41,14 @@ out2 = nd.zeros((4, 2))
 kv.pull("3", out=out2)
 np.testing.assert_allclose(out2.asnumpy(), 10 * expect)
 
+# two pushes before a pull: ps-lite timestamp semantics — each push joins
+# its own round, rounds aggregate across all workers in order
+kv.push("3", nd.ones((4, 2)) * 100 * (rank + 1))
+kv.push("3", nd.ones((4, 2)) * 1000 * (rank + 1))
+out3 = nd.zeros((4, 2))
+kv.pull("3", out=out3)
+np.testing.assert_allclose(out3.asnumpy(), 1000 * expect)
+
 # multi-device push: per-device shards reduce locally before the wire
 devs = [mx.cpu(i) for i in range(min(4, len(jax.devices())))]
 kv.init("md", nd.zeros((2, 2)))
