@@ -1,0 +1,12 @@
+"""Byte-level compatibility with reference MXNet artifacts.
+
+* `mxnet_params` — the dmlc binary NDArray container (`.params` files,
+  `src/ndarray/ndarray.cc:1531-1761`): read AND write, dense + row_sparse
+  + csr, including the pre-0.8 legacy per-array headers.
+* `legacy_json` — the versioned symbol-JSON upgrade passes
+  (`src/nnvm/legacy_json_util.cc:49-219`) re-expressed over the JSON dict.
+"""
+from . import legacy_json, mxnet_params
+from .mxnet_params import load_params, save_params
+
+__all__ = ["mxnet_params", "legacy_json", "load_params", "save_params"]

@@ -16,14 +16,106 @@ import numpy as np
 from ..base import MXNetError
 from .. import ndarray as nd
 
-QUANTIZABLE = {"FullyConnected"}
+QUANTIZABLE = {"FullyConnected", "Convolution", "Pooling"}
+
+
+def _smooth_distribution(d, eps=0.0001):
+    """Move epsilon mass onto zero bins so KL stays finite (the reference's
+    `_smooth_distribution`, itself the TensorRT calibration recipe)."""
+    is_zero = d == 0
+    n_zero = int(is_zero.sum())
+    n_nonzero = d.size - n_zero
+    if n_nonzero == 0:
+        return None
+    d = d.astype(np.float64)
+    if n_zero:
+        d[is_zero] = eps
+        d[~is_zero] -= eps * n_zero / n_nonzero
+        if (d[~is_zero] <= 0).any():
+            return None
+    return d / d.sum()
+
+
+_NUM_BINS = 8001
+
+
+def _merge_histograms(parts):
+    """Rebin per-batch histograms (each over its own symmetric range) onto
+    the widest range.  Bin centers are reassigned by linear index scaling —
+    the small rebinned blur is irrelevant to a threshold search."""
+    absmax = max(a for _, a in parts)
+    total = np.zeros(_NUM_BINS, np.int64)
+    for hist, a in parts:
+        if a == absmax:
+            total += hist
+            continue
+        centers = (np.arange(_NUM_BINS) + 0.5) / _NUM_BINS * 2 * a - a
+        idx = np.clip(((centers + absmax) / (2 * absmax)
+                       * _NUM_BINS).astype(int), 0, _NUM_BINS - 1)
+        np.add.at(total, idx, hist)
+    return total, absmax
+
+
+def _kl_optimal_threshold(arr, num_bins=_NUM_BINS, num_quantized_bins=255):
+    """Minimum-KL clipping threshold for one layer's activations."""
+    arr = np.asarray(arr).ravel()
+    absmax = float(np.abs(arr).max()) or 1e-8
+    hist, _ = np.histogram(arr, bins=num_bins, range=(-absmax, absmax))
+    return _kl_threshold_from_hist(hist, absmax, num_quantized_bins)
+
+
+def _kl_threshold_from_hist(hist, absmax, num_quantized_bins=255):
+    """Minimum-KL clipping threshold from a symmetric histogram.
+
+    The reference's entropy calibration (`python/mxnet/contrib/
+    quantization.py _get_optimal_threshold`, after TensorRT's KL recipe):
+    for each candidate symmetric threshold, measure the KL divergence
+    between the clipped fp32 histogram P and its int8-requantized
+    reconstruction Q; keep the threshold that loses the least information.
+    """
+    num_bins = len(hist)
+    edges = np.linspace(-absmax, absmax, num_bins + 1)
+    zero = num_bins // 2
+    best_kl, best_thr = None, absmax
+    for i in range(num_quantized_bins // 2, zero + 1,
+                   max(1, zero // 128)):
+        lo, hi = zero - i, zero + i + 1
+        sliced = hist[lo:hi].astype(np.float64)
+        p = sliced.copy()
+        p[0] += hist[:lo].sum()            # outliers clamp to the edges
+        p[-1] += hist[hi:].sum()
+        # requantize the slice into the int8 bin count, then expand back
+        factor = len(sliced) / num_quantized_bins
+        q = np.zeros_like(p)
+        for j in range(num_quantized_bins):
+            a = int(np.floor(j * factor))
+            b = int(np.ceil((j + 1) * factor))
+            chunk = sliced[a:b]
+            count = (chunk != 0).sum()
+            if count:
+                q[a:b][chunk != 0] = chunk[chunk != 0].sum() / count
+        p = _smooth_distribution(p)
+        q = _smooth_distribution(q)
+        if p is None or q is None:
+            continue
+        kl = float(np.sum(p * np.log(p / q)))
+        if best_kl is None or kl < best_kl:
+            best_kl = kl
+            best_thr = float(edges[hi]) if hi < len(edges) else absmax
+    return best_thr
 
 
 def _collect_calib_ranges(sym, arg_params, aux_params, calib_data,
-                          num_batches, ctx):
-    """fp32 forward over calibration batches, recording per-output min/max."""
+                          num_batches, ctx, mode="naive"):
+    """fp32 forward over calibration batches.
+
+    'naive': per-output running min/max (reference _LayerOutputMinMax
+    collector).  'entropy': keep the activations and compute the
+    minimum-KL threshold per layer (reference _LayerHistogramCollector +
+    _get_optimal_threshold)."""
     internals = sym.get_internals()
     ranges = {}
+    samples = {}
     exe = None
     for i, batch in enumerate(calib_data):
         if i >= num_batches:
@@ -37,12 +129,26 @@ def _collect_calib_ranges(sym, arg_params, aux_params, calib_data,
         outs = exe.forward(is_train=False, data=data)
         for name, out in zip(internals.list_outputs(), outs):
             a = out.asnumpy()
+            if mode == "entropy":
+                # fold each batch into a fixed-size histogram so memory is
+                # O(layers x bins), not O(activations) — the reference's
+                # _LayerHistogramCollector strategy
+                absmax = float(np.abs(a).max()) or 1e-8
+                hist, _ = np.histogram(a, bins=_NUM_BINS,
+                                       range=(-absmax, absmax))
+                samples.setdefault(name, []).append((hist, absmax))
+                continue
             mn, mx = float(a.min()), float(a.max())
             if name in ranges:
                 omn, omx = ranges[name]
                 ranges[name] = (min(mn, omn), max(mx, omx))
             else:
                 ranges[name] = (mn, mx)
+    if mode == "entropy":
+        for name, parts in samples.items():
+            hist, absmax = _merge_histograms(parts)
+            thr = _kl_threshold_from_hist(hist, absmax)
+            ranges[name] = (-thr, thr)
     return ranges
 
 
@@ -62,16 +168,17 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
     excluded = set(excluded_sym_names or [])
     ctx = ctx or cpu()
 
-    if calib_mode not in ("none", "naive"):
-        raise MXNetError("calib_mode must be 'none' or 'naive' "
-                         "(KL/entropy calibration: future round)")
+    if calib_mode not in ("none", "naive", "entropy"):
+        raise MXNetError("calib_mode must be 'none', 'naive' or 'entropy'")
     calib_ranges = {}
-    if calib_mode == "naive":
+    if calib_mode in ("naive", "entropy"):
         if calib_data is None:
-            raise MXNetError("calib_data required for calib_mode='naive'")
+            raise MXNetError(f"calib_data required for calib_mode="
+                             f"'{calib_mode}'")
         nb = max(1, (num_calib_examples or 32) // calib_data.batch_size)
         calib_ranges = _collect_calib_ranges(sym, arg_params, aux_params,
-                                             calib_data, nb, ctx)
+                                             calib_data, nb, ctx,
+                                             mode=calib_mode)
 
     new_args = dict(arg_params)
     memo = {}
@@ -89,9 +196,33 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
             s = transform(src)
             in_syms.append(s[idx] if len(s._entries) > 1 else s)
 
-        if node.op.name in QUANTIZABLE and node.name not in excluded:
-            data_s, weight_s = in_syms[0], in_syms[1]
+        if node.op.name in QUANTIZABLE and node.name not in excluded \
+                and _supported(node):
+            qdata = _sym_apply("_contrib_quantize_v2", [in_syms[0]],
+                               {"out_type": quantized_dtype,
+                                **_calib_kwargs(calib_ranges, node)})
+
+            if node.op.name == "Pooling":
+                qp = _sym_apply("_contrib_quantized_pooling",
+                                [qdata[0], qdata[1], qdata[2]],
+                                {k: node.attrs[k] for k in
+                                 ("kernel", "pool_type", "stride", "pad",
+                                  "global_pool", "pooling_convention")
+                                 if k in node.attrs})
+                out = _sym_apply("_contrib_dequantize",
+                                 [qp[0], qp[1], qp[2]], {})
+                memo[id(node)] = out
+                return out
+
+            weight_s = in_syms[1]
             bias_s = in_syms[2] if len(in_syms) > 2 else None
+            if bias_s is not None:
+                # the rewritten graph feeds bias into a plain Reshape, which
+                # has no weight-shape solver rule — pin the known shape
+                bnode = node.inputs[2][0]
+                if bnode.name in arg_params:
+                    bnode._extra_attrs.setdefault(
+                        "__shape__", tuple(arg_params[bnode.name].shape))
             wname = node.inputs[1][0].name
             w = arg_params[wname].asnumpy()
             wmax = float(np.abs(w).max()) or 1e-8
@@ -100,19 +231,33 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
             new_args[wname + "_min"] = nd.array([-wmax])
             new_args[wname + "_max"] = nd.array([wmax])
 
-            qdata = _sym_apply("_contrib_quantize_v2", [data_s],
-                               {"out_type": quantized_dtype,
-                                **_calib_kwargs(calib_ranges, node)})
-            qfc = _sym_apply(
-                "_contrib_quantized_fully_connected",
-                [qdata[0], weight_s, qdata[1], qdata[2],
-                 Variable(wname + "_min"), Variable(wname + "_max")],
-                {"num_hidden": node.attrs["num_hidden"], "no_bias": True,
-                 "flatten": node.attrs.get("flatten", True)})
-            out = _sym_apply("_contrib_dequantize",
-                             [qfc[0], qfc[1], qfc[2]], {})
-            if bias_s is not None:
-                out = out + _sym_apply("Reshape", [bias_s], {"shape": (1, -1)})
+            if node.op.name == "Convolution":
+                qc = _sym_apply(
+                    "_contrib_quantized_conv",
+                    [qdata[0], weight_s, qdata[1], qdata[2],
+                     Variable(wname + "_min"), Variable(wname + "_max")],
+                    {**{k: node.attrs[k] for k in
+                        ("kernel", "stride", "pad", "dilate", "num_filter",
+                         "num_group", "layout") if k in node.attrs},
+                     "no_bias": True})
+                out = _sym_apply("_contrib_dequantize",
+                                 [qc[0], qc[1], qc[2]], {})
+                if bias_s is not None:
+                    out = _sym_apply("broadcast_add", [
+                        out, _sym_apply("Reshape", [bias_s],
+                                        {"shape": (1, -1, 1, 1)})], {})
+            else:  # FullyConnected
+                qfc = _sym_apply(
+                    "_contrib_quantized_fully_connected",
+                    [qdata[0], weight_s, qdata[1], qdata[2],
+                     Variable(wname + "_min"), Variable(wname + "_max")],
+                    {"num_hidden": node.attrs["num_hidden"], "no_bias": True,
+                     "flatten": node.attrs.get("flatten", True)})
+                out = _sym_apply("_contrib_dequantize",
+                                 [qfc[0], qfc[1], qfc[2]], {})
+                if bias_s is not None:
+                    out = out + _sym_apply("Reshape", [bias_s],
+                                           {"shape": (1, -1)})
             memo[id(node)] = out
             return out
 
@@ -130,6 +275,28 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
         out_entries.append(s._entries[min(idx, len(s._entries) - 1)])
     qsym = Symbol(out_entries)
     return qsym, new_args, dict(aux_params)
+
+
+def _supported(node):
+    """Only rewrite configurations the int8 ops implement; anything else
+    stays fp32 (the reference's quantize_graph_pass likewise skips
+    unsupported nodes rather than failing)."""
+    p = node.attrs
+    if node.op.name == "Pooling":
+        if p.get("pool_type", "max") not in ("max", "avg"):
+            return False
+        if p.get("pooling_convention", "valid") != "valid":
+            return False
+        kernel = tuple(p.get("kernel") or ())
+        if not p.get("global_pool") and len(kernel) != 2:
+            return False
+        if p.get("count_include_pad") is False:
+            return False
+        return True
+    if node.op.name == "Convolution":
+        kernel = tuple(p.get("kernel") or ())
+        return len(kernel) == 2 and p.get("layout", "NCHW") == "NCHW"
+    return True
 
 
 def _calib_kwargs(ranges, node):

@@ -76,12 +76,7 @@ class KVStoreDist(KVStoreTPU):
             _check(reply)
             if self._sync:
                 self._push_count[sk] = self._push_count.get(sk, 0) + 1
-            # remember the device set so pull() can use the one-collective
-            # broadcast instead of per-target copies
-            if len(vals) > 1:
-                devs = [v.context.jax_device for v in vals]
-                if len({d.id for d in devs}) == len(devs):
-                    self._key_mesh[sk] = self._mesh_for(devs)
+            self._record_key_mesh(sk, vals)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         if out is None:

@@ -73,7 +73,16 @@ class ParameterServer:
             allow_reuse_address = True
             daemon_threads = True
 
-        self._server = Server((host, port), Handler)
+        try:
+            self._server = Server((host, port), Handler)
+        except OSError:
+            if not host:
+                raise
+            # multi-homed host: the root URI names this machine as workers
+            # see it, which may not be locally bindable — fall back to all
+            # interfaces only then (the transport is unauthenticated pickle,
+            # ps-lite's trust model: never widen the bind surface by default)
+            self._server = Server(("", port), Handler)
         self.port = self._server.server_address[1]
         self._thread = None
 
@@ -219,10 +228,9 @@ def main():
         jax.config.update("jax_platforms", "cpu")  # servers never touch chips
     except Exception:
         pass
-    # bind all interfaces: DMLC_PS_ROOT_URI names this host as workers see
-    # it, which need not be a locally bindable address on multi-homed hosts
     port = int(os.environ.get("DMLC_PS_ROOT_PORT", 9091))
-    server = ParameterServer(host="", port=port)
+    server = ParameterServer(
+        host=os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"), port=port)
     server.serve_forever()
 
 

@@ -64,7 +64,7 @@ class Channel:
     the same answer: ps-lite nodes retry until the scheduler is up).
     """
 
-    def __init__(self, host: str, port: int, timeout: float | None = None,
+    def __init__(self, host: str, port: int, timeout: float | None = 330.0,
                  connect_wait: float = 90.0):
         import time
         deadline = time.monotonic() + connect_wait
@@ -77,10 +77,9 @@ class Channel:
                 if time.monotonic() >= deadline:
                     raise
                 time.sleep(0.3)
-        # requests block until the server answers: server-side waits (sync
-        # rounds, barriers) own the timeout policy — a client-side socket
-        # timeout shorter than those would cut a frame mid-stream and desync
-        # the channel
+        # the timeout must exceed the server's longest internal wait (300s
+        # sync-round/barrier waits): shorter would cut a frame mid-stream
+        # and desync the channel; it still bounds a dead/partitioned server
         self._sock.settimeout(timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 

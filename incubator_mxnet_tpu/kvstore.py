@@ -308,13 +308,18 @@ class KVStoreTPU(KVStore):
                      if s.device.id == lead)
         return NDArray(local, ctx=vals[0].context)
 
+    def _record_key_mesh(self, sk, vals):
+        """Remember the device set a key was pushed over so pull() can use
+        the one-collective broadcast instead of per-target copies."""
+        if len(vals) > 1:
+            devs = [v.context.jax_device for v in vals]
+            if len({d.id for d in devs}) == len(devs):
+                self._key_mesh[sk] = self._mesh_for(devs)
+
     def push(self, key, value, priority=0):
         keys, values = _normalize_push(key, value)
         for k, vals in zip(keys, values):
-            if len(vals) > 1:
-                devs = [v.context.jax_device for v in vals]
-                if len({d.id for d in devs}) == len(devs):
-                    self._key_mesh[_key(k)] = self._mesh_for(devs)
+            self._record_key_mesh(_key(k), vals)
         super().push(key, value, priority)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
@@ -395,15 +400,13 @@ def create(name="local"):
             # the reference runs the same user script on server hosts; the
             # process becomes the server and never returns to user code
             # (python/mxnet/kvstore_server.py _init_kvstore_server_module).
-            # Bind all local interfaces: DMLC_PS_ROOT_URI names this host
-            # as seen by WORKERS, which need not be a bindable local addr.
             # Constraint vs the reference: one server, colocated with the
             # root URI host (gradient traffic rides the TPU mesh, the
             # server is control-plane only).
             import sys
             from .dist.server import ParameterServer
             ParameterServer(
-                host="",
+                host=os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
                 port=int(os.environ.get("DMLC_PS_ROOT_PORT", 9091)),
             ).serve_forever()
             sys.exit(0)

@@ -1,16 +1,16 @@
 """NDArray save/load (reference `python/mxnet/ndarray/utils.py:149-222`,
 binary container `src/ndarray/ndarray.cc:1537`).
 
-Format: the reference's container is a dmlc binary stream with a magic word,
-an NDArray list and a name list.  We write the same *logical* content —
-(names, arrays) — as an uncompressed ``.npz``-style zip with a magic entry, so
-checkpoints are portable and inspectable.  `load` also accepts real numpy
-``.npz`` files.  Byte-compatibility with reference `.params` files is provided
-by `incubator_mxnet_tpu.compat.mxnet_params` (reader).
+`save` writes the reference's dmlc binary container byte-for-byte
+(`incubator_mxnet_tpu.compat.mxnet_params`), so checkpoints interchange
+with reference MXNet in both directions.  `load` reads that container plus
+two legacy fallbacks: this framework's earlier zip format and plain numpy
+``.npz`` files.
 """
 from __future__ import annotations
 
 import io
+import struct
 import zipfile
 
 import numpy as np
@@ -22,40 +22,45 @@ _MAGIC = "__incubator_mxnet_tpu_v1__"
 
 
 def save(fname, data):
-    """Save NDArrays (reference `mx.nd.save`): list or dict of arrays."""
+    """Save NDArrays (reference `mx.nd.save`): list or dict of arrays.
+
+    Lists are saved unnamed (loading yields a list), dicts named — the
+    reference's exact semantics.
+    """
+    from ..compat.mxnet_params import save_params
     if isinstance(data, NDArray):
         data = [data]
-    if isinstance(data, dict):
-        names = list(data.keys())
-        arrays = [data[k] for k in names]
-    elif isinstance(data, (list, tuple)):
-        names = [str(i) for i in range(len(data))]
-        arrays = list(data)
-    else:
+    if not isinstance(data, (dict, list, tuple)):
         raise MXNetError("save: data must be NDArray, list, or dict")
-    npys = {}
-    for n, a in zip(names, arrays):
-        npys[n] = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
-    with zipfile.ZipFile(fname, "w", zipfile.ZIP_STORED) as zf:
-        zf.writestr(_MAGIC, b"1")
-        meta_is_list = isinstance(data, (list, tuple))
-        zf.writestr("__meta__", b"list" if meta_is_list else b"dict")
-        for n, arr in npys.items():
-            buf = io.BytesIO()
-            np.save(buf, arr, allow_pickle=False)
-            zf.writestr(n + ".npy", buf.getvalue())
+    save_params(fname, data)
 
 
 def load(fname, ctx=None):
-    """Load NDArrays saved by `save` (reference `mx.nd.load`)."""
+    """Load NDArrays saved by `save` or by reference MXNet (`mx.nd.load`)."""
+    with open(fname, "rb") as f:
+        head = f.read(8)
+    if len(head) == 8 and struct.unpack("<Q", head)[0] == 0x112:
+        from ..compat.mxnet_params import load_params
+        out = load_params(fname)
+        if ctx is not None:
+            if isinstance(out, dict):
+                out = {k: v.as_in_context(ctx) for k, v in out.items()}
+            else:
+                out = [v.as_in_context(ctx) for v in out]
+        return out
+    return _load_zip(fname, ctx)
+
+
+def _load_zip(fname, ctx=None):
+    """Legacy formats: this framework's v1 zip container and numpy .npz."""
     with zipfile.ZipFile(fname, "r") as zf:
         names = zf.namelist()
         if _MAGIC not in names:
-            # plain npz fallback
             out = {}
             for n in names:
                 if n.endswith(".npy"):
-                    out[n[:-4]] = array(np.load(io.BytesIO(zf.read(n))), ctx=ctx)
+                    out[n[:-4]] = array(np.load(io.BytesIO(zf.read(n))),
+                                        ctx=ctx)
             return out
         meta = zf.read("__meta__").decode()
         out = {}

@@ -46,8 +46,12 @@ def _quantize_v2(params, data):
 @register("_contrib_dequantize", nin=3, params={"out_type": "float32"},
           aliases=("dequantize",))
 def _dequantize(params, data, min_range, max_range):
+    """int8 carries real = q * range/127; int32 accumulators from quantized
+    matmul/conv carry real = q * range/127^2 (reference dequantizes int32
+    through requantize first — this op accepts both directly)."""
     scale = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
-    return data.astype(jnp.float32) * scale / 127.0
+    q_max = 127.0 if data.dtype == jnp.int8 else 127.0 * 127.0
+    return data.astype(jnp.float32) * scale / q_max
 
 
 @register("_contrib_requantize", nin=3, nout=3,
@@ -89,3 +93,77 @@ def _quantized_fc(params, *args):
     w_scale = jnp.maximum(jnp.abs(wmin), jnp.abs(wmax)) / 127.0
     out_range = d_scale * w_scale * 127.0 * 127.0
     return out, -out_range, out_range
+
+
+def _pair(v, default=None):
+    t = (v, v) if isinstance(v, int) else tuple(v)
+    return t if t else (default or (1, 1))
+
+
+@register("_contrib_quantized_conv", nin=-1, nout=3,
+          params={"kernel": REQUIRED, "stride": (1, 1), "pad": (0, 0),
+                  "dilate": (1, 1), "num_filter": REQUIRED, "num_group": 1,
+                  "no_bias": False, "layout": "NCHW"})
+def _quantized_conv(params, *args):
+    """int8 conv -> int32 accumulators (reference quantized_conv.cc).
+
+    Arithmetic runs in f32 and is rounded back: int8 products are <= 127^2
+    and partial sums stay inside f32's exact-integer window for any
+    practical kernel volume, and f32 convs map onto the TPU MXU where
+    int accumulation would not.
+    """
+    no_bias = bool(params["no_bias"])
+    if no_bias:
+        data, weight, dmin, dmax, wmin, wmax = args
+        bias = None
+    else:
+        data, weight, bias, dmin, dmax, wmin, wmax, bmin, bmax = args
+    stride = _pair(params["stride"])
+    pad = _pair(params["pad"], (0, 0))
+    dilate = _pair(params["dilate"])
+    out = jax.lax.conv_general_dilated(
+        data.astype(jnp.float32), weight.astype(jnp.float32),
+        window_strides=stride,
+        padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+        rhs_dilation=dilate,
+        feature_group_count=int(params["num_group"]),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    out = jnp.round(out).astype(jnp.int32)
+    if bias is not None:
+        out = out + bias.astype(jnp.int32).reshape(1, -1, 1, 1)
+    d_scale = jnp.maximum(jnp.abs(dmin), jnp.abs(dmax)) / 127.0
+    w_scale = jnp.maximum(jnp.abs(wmin), jnp.abs(wmax)) / 127.0
+    out_range = d_scale * w_scale * 127.0 * 127.0
+    return out, -out_range, out_range
+
+
+@register("_contrib_quantized_pooling", nin=3, nout=3,
+          params={"kernel": REQUIRED, "pool_type": "max", "stride": (1, 1),
+                  "pad": (0, 0), "global_pool": False,
+                  "pooling_convention": "valid"})
+def _quantized_pooling(params, data, min_range, max_range):
+    """Pooling on int8 values; ranges pass through unchanged
+    (reference quantized_pooling.cc: pooling is range-preserving)."""
+    ptype = params["pool_type"]
+    if params["global_pool"]:
+        kernel = data.shape[2:]
+        stride = (1, 1)
+        pad = (0, 0)
+    else:
+        kernel = _pair(params["kernel"])
+        stride = _pair(params["stride"])
+        pad = _pair(params["pad"], (0, 0))
+    x = data.astype(jnp.float32)
+    dims = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    padding = ((0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1]))
+    if ptype == "max":
+        out = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, dims, strides,
+                                    padding)
+    elif ptype == "avg":
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides, padding)
+        out = s / float(kernel[0] * kernel[1])
+    else:
+        raise ValueError(f"quantized_pooling: pool_type {ptype}")
+    out = jnp.clip(jnp.round(out), -127, 127).astype(data.dtype)
+    return out, min_range, max_range

@@ -488,7 +488,8 @@ def load(fname):
 def load_json(json_str):
     """Rebuild a Symbol from graph JSON (reference `symbol.py:2566 load`,
     versioned loader `src/nnvm/legacy_json_util.cc:197-222`)."""
-    g = json.loads(json_str)
+    from ..compat.legacy_json import upgrade_json
+    g = upgrade_json(json_str)
     nodes = []
     for jn in g["nodes"]:
         attrs = {k: v for k, v in jn.get("attrs", jn.get("param", {})).items()}
@@ -703,6 +704,32 @@ def _solve_param_shapes(node, env):
         setvar(1, (nf, d[1] // g) + kernel)
         if not p.get("no_bias"):
             setvar(2, (nf,))
+    elif op_name == "_contrib_quantized_conv":
+        nf = int(p["num_filter"])
+        g = int(p.get("num_group", 1))
+        kernel = tuple(p["kernel"])
+        setvar(1, (nf, d[1] // g) + kernel, _np.int8)
+        first_minmax = 2
+        if not p.get("no_bias"):
+            setvar(2, (nf,), _np.int8)
+            first_minmax = 3
+        for i in range(first_minmax, len(ins)):
+            setvar(i, (1,))
+    elif op_name == "_contrib_quantized_fully_connected":
+        num_hidden = int(p["num_hidden"])
+        in_units = 1
+        if p.get("flatten", True):
+            for s in d[1:]:
+                in_units *= s
+        else:
+            in_units = d[-1]
+        setvar(1, (num_hidden, in_units), _np.int8)
+        first_minmax = 2
+        if not p.get("no_bias"):
+            setvar(2, (num_hidden,), _np.int8)
+            first_minmax = 3
+        for i in range(first_minmax, len(ins)):
+            setvar(i, (1,))
     elif op_name == "Deconvolution":
         nf = int(p["num_filter"])
         g = int(p.get("num_group", 1))

@@ -1,0 +1,152 @@
+"""Byte-compatibility tests: reference `.params` container and legacy
+symbol JSON (reference formats: `src/ndarray/ndarray.cc:1531-1761`,
+`src/nnvm/legacy_json_util.cc:49-219`)."""
+import struct
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.compat import load_params, save_params
+from incubator_mxnet_tpu.compat.legacy_json import upgrade_json
+
+
+def _ref_bytes_one_f4(name, arr):
+    """Hand-pack a reference-format file, independent of the writer."""
+    arr = np.asarray(arr, "<f4")
+    out = struct.pack("<QQ", 0x112, 0)          # list magic + reserved
+    out += struct.pack("<Q", 1)                 # one array
+    out += struct.pack("<I", 0xF993FAC9)        # NDARRAY_V2_MAGIC
+    out += struct.pack("<i", 0)                 # dense stype
+    out += struct.pack("<I", arr.ndim) + struct.pack(
+        f"<{arr.ndim}q", *arr.shape)            # TShape: u32 ndim + i64s
+    out += struct.pack("<ii", 1, 0)             # Context cpu(0)
+    out += struct.pack("<i", 0)                 # kFloat32
+    out += arr.tobytes()
+    out += struct.pack("<Q", 1)                 # one name
+    b = name.encode()
+    out += struct.pack("<Q", len(b)) + b
+    return out
+
+
+def test_load_synthesized_reference_file(tmp_path):
+    arr = np.arange(12, dtype="<f4").reshape(3, 4)
+    f = tmp_path / "ref.params"
+    f.write_bytes(_ref_bytes_one_f4("conv0_weight", arr))
+    out = load_params(str(f))
+    assert list(out) == ["conv0_weight"]
+    np.testing.assert_array_equal(out["conv0_weight"].asnumpy(), arr)
+
+
+def test_writer_matches_reference_layout():
+    arr = np.arange(6, dtype="<f4").reshape(2, 3)
+    blob = save_params(None, {"w": nd.array(arr)})
+    assert blob == _ref_bytes_one_f4("w", arr)
+
+
+def test_roundtrip_dtypes_and_list(tmp_path):
+    data = {
+        "f4": nd.array(np.random.rand(2, 3).astype("f4")),
+        "f8": nd.array(np.random.rand(4).astype("f8"), dtype="float64"),
+        "u1": nd.array(np.arange(5, dtype="u1"), dtype="uint8"),
+        "i4": nd.array(np.arange(5, dtype="i4"), dtype="int32"),
+        "i8": nd.array(np.arange(3, dtype="i8"), dtype="int64"),
+    }
+    f = str(tmp_path / "mixed.params")
+    save_params(f, data)
+    out = load_params(f)
+    for k, v in data.items():
+        np.testing.assert_array_equal(out[k].asnumpy(), v.asnumpy())
+        assert out[k].dtype == v.dtype, k
+    # unnamed list round trip
+    save_params(f, [nd.ones((2, 2)), nd.zeros((3,))])
+    out = load_params(f)
+    assert isinstance(out, list) and len(out) == 2
+    np.testing.assert_array_equal(out[0].asnumpy(), np.ones((2, 2), "f4"))
+
+
+def test_roundtrip_sparse(tmp_path):
+    from incubator_mxnet_tpu.ndarray import sparse as sp
+    rs = sp.RowSparseNDArray(data=np.ones((2, 4), "f4"),
+                             indices=[1, 3], shape=(5, 4))
+    csr = sp.CSRNDArray(data=np.array([1.0, 2.0, 3.0], "f4"),
+                        indices=[0, 2, 1], indptr=[0, 2, 2, 3],
+                        shape=(3, 3))
+    f = str(tmp_path / "sparse.params")
+    save_params(f, {"rs": rs, "csr": csr})
+    out = load_params(f)
+    np.testing.assert_array_equal(out["rs"].asnumpy(), rs.asnumpy())
+    np.testing.assert_array_equal(out["csr"].asnumpy(), csr.asnumpy())
+    assert type(out["rs"]).__name__ == "RowSparseNDArray"
+    assert type(out["csr"]).__name__ == "CSRNDArray"
+
+
+def test_load_legacy_v1_and_prev1_headers(tmp_path):
+    arr = np.arange(4, dtype="<f4").reshape(2, 2)
+    # V1 per-array header: V1 magic + i64 shape, no stype section
+    body_v1 = struct.pack("<I", 0xF993FAC8)
+    body_v1 += struct.pack("<I", 2) + struct.pack("<2q", 2, 2)
+    body_v1 += struct.pack("<ii", 1, 0) + struct.pack("<i", 0) + arr.tobytes()
+    # pre-V1: leading u32 IS the ndim, u32 dims
+    body_v0 = struct.pack("<I", 2) + struct.pack("<2I", 2, 2)
+    body_v0 += struct.pack("<ii", 1, 0) + struct.pack("<i", 0) + arr.tobytes()
+    blob = struct.pack("<QQQ", 0x112, 0, 2) + body_v1 + body_v0
+    blob += struct.pack("<Q", 0)            # no names -> list
+    out = load_params(blob)
+    assert isinstance(out, list) and len(out) == 2
+    for o in out:
+        np.testing.assert_array_equal(o.asnumpy(), arr)
+
+
+def test_nd_save_load_is_reference_format(tmp_path):
+    f = str(tmp_path / "x.params")
+    nd.save(f, {"a": nd.ones((2, 2))})
+    head = open(f, "rb").read(8)
+    assert struct.unpack("<Q", head)[0] == 0x112
+    out = nd.load(f)
+    np.testing.assert_array_equal(out["a"].asnumpy(), np.ones((2, 2), "f4"))
+
+
+def test_legacy_json_upgrade_aux_vars_and_hidden_keys():
+    # an 0.8-era graph: BatchNorm missing its aux inputs, `param` attr key,
+    # lr_mult stored as a plain attr
+    g = {
+        "nodes": [
+            {"op": "null", "name": "data", "param": {}, "inputs": []},
+            {"op": "null", "name": "fc_weight",
+             "param": {"lr_mult": "2.0"}, "inputs": []},
+            {"op": "FullyConnected", "name": "fc",
+             "param": {"num_hidden": "8"},
+             "inputs": [[0, 0, 0], [1, 0, 0]]},
+            {"op": "BatchNorm", "name": "bn", "param": {},
+             "inputs": [[2, 0, 0]]},
+        ],
+        "arg_nodes": [0, 1],
+        "heads": [[3, 0, 0]],
+    }
+    up = upgrade_json(dict(g))
+    names = [n["name"] for n in up["nodes"]]
+    # FC grew its bias var; BatchNorm grew gamma/beta + moving stats vars
+    assert "fc_bias" in names
+    assert {"bn_gamma", "bn_beta", "bn_moving_mean",
+            "bn_moving_var"} <= set(names)
+    fc_w = next(n for n in up["nodes"] if n["name"] == "fc_weight")
+    assert fc_w["attrs"].get("__lr_mult__") == "2.0"
+    # and the upgraded graph actually loads as a Symbol
+    import json as _json
+    sym = mx.sym.load_json(_json.dumps(up))
+    assert "fc_bias" in sym.list_arguments()
+    assert set(sym.list_auxiliary_states()) == {"bn_moving_mean",
+                                                "bn_moving_var"}
+
+
+def test_legacy_json_argmax_axis():
+    g = {"nodes": [
+            {"op": "null", "name": "data", "attrs": {}, "inputs": []},
+            {"op": "argmax", "name": "am", "attrs": {"axis": "-1"},
+             "inputs": [[0, 0, 0]]}],
+         "arg_nodes": [0], "heads": [[1, 0, 0]],
+         "attrs": {"mxnet_version": ["int", 904]}}
+    up = upgrade_json(g)
+    assert "axis" not in up["nodes"][1]["attrs"]

@@ -1,0 +1,92 @@
+"""INT8 quantization tests (reference tests/python/quantization/
+test_quantization.py strategy: quantized graph stays close to fp32)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.contrib.quantization import (quantize_model,
+                                                      _kl_optimal_threshold)
+
+
+def _convnet():
+    data = mx.sym.Variable("data")
+    c = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                           name="conv0")
+    c = mx.sym.Activation(c, act_type="relu")
+    p = mx.sym.Pooling(c, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                       name="pool0")
+    f = mx.sym.Flatten(p)
+    out = mx.sym.FullyConnected(f, num_hidden=10, name="fc0")
+    return out
+
+
+def _init_params(sym, data_shape):
+    arg_shapes, _, aux_shapes = sym.infer_shape(data=data_shape)
+    rng = np.random.RandomState(0)
+    args = {}
+    for name, s in zip(sym.list_arguments(), arg_shapes):
+        if name == "data":
+            continue
+        args[name] = nd.array(rng.normal(0, 0.5, s).astype("f4"))
+    auxs = {name: nd.zeros(s) for name, s in
+            zip(sym.list_auxiliary_states(), aux_shapes)}
+    return args, auxs
+
+
+def _fp32_out(sym, args, auxs, x):
+    exe = sym.simple_bind(ctx=mx.cpu(), grad_req="null", data=x.shape)
+    exe.copy_params_from(args, auxs)
+    return exe.forward(is_train=False, data=nd.array(x))[0].asnumpy()
+
+
+def _q_out(qsym, qargs, auxs, x):
+    exe = qsym.simple_bind(ctx=mx.cpu(), grad_req="null", data=x.shape)
+    exe.copy_params_from(qargs, auxs, allow_extra_params=True)
+    return exe.forward(is_train=False, data=nd.array(x))[0].asnumpy()
+
+
+def test_quantized_convnet_close_to_fp32():
+    sym = _convnet()
+    x = np.random.RandomState(1).normal(0, 1, (4, 3, 8, 8)).astype("f4")
+    args, auxs = _init_params(sym, x.shape)
+    ref = _fp32_out(sym, args, auxs, x)
+
+    qsym, qargs, qauxs = quantize_model(sym, args, auxs, calib_mode="none")
+    out = _q_out(qsym, qargs, qauxs, x)
+    # int8 tolerance: relative to the dynamic range of the output
+    scale = np.abs(ref).max()
+    assert np.abs(out - ref).max() / scale < 0.1, \
+        (np.abs(out - ref).max(), scale)
+    # int8 logits keep the argmax on most samples
+    agree = (out.argmax(1) == ref.argmax(1)).mean()
+    assert agree >= 0.75, agree
+
+
+def test_quantized_calibrated_modes():
+    sym = _convnet()
+    rng = np.random.RandomState(2)
+    x = rng.normal(0, 1, (4, 3, 8, 8)).astype("f4")
+    args, auxs = _init_params(sym, x.shape)
+    ref = _fp32_out(sym, args, auxs, x)
+    calib = mx.io.NDArrayIter(rng.normal(0, 1, (16, 3, 8, 8)).astype("f4"),
+                              batch_size=4)
+    for mode in ("naive", "entropy"):
+        calib.reset()
+        qsym, qargs, qauxs = quantize_model(
+            sym, args, auxs, calib_mode=mode, calib_data=calib,
+            num_calib_examples=16)
+        out = _q_out(qsym, qargs, qauxs, x)
+        scale = np.abs(ref).max()
+        assert np.abs(out - ref).max() / scale < 0.15, mode
+        # calibrated graphs carry static ranges: no dynamic min/max in sym
+        js = qsym.tojson()
+        assert "min_calib_range" in js, mode
+
+
+def test_kl_threshold_clips_outliers():
+    rng = np.random.RandomState(3)
+    arr = rng.normal(0, 1, 20000)
+    arr[0] = 100.0    # one extreme outlier
+    thr = _kl_optimal_threshold(arr)
+    assert thr < 50.0, thr       # the KL optimum clips the outlier
+    assert thr > 1.0, thr        # but keeps the bulk of the distribution
