@@ -218,11 +218,16 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
             bias_s = in_syms[2] if len(in_syms) > 2 else None
             if bias_s is not None:
                 # the rewritten graph feeds bias into a plain Reshape, which
-                # has no weight-shape solver rule — pin the known shape
+                # has no weight-shape solver rule — pin the known shape on a
+                # FRESH variable node (same name) so the caller's fp32 graph
+                # is not mutated
                 bnode = node.inputs[2][0]
-                if bnode.name in arg_params:
-                    bnode._extra_attrs.setdefault(
-                        "__shape__", tuple(arg_params[bnode.name].shape))
+                if bnode.is_variable and bnode.name in arg_params:
+                    nb = _Node(None, bnode.name, {}, [])
+                    nb._extra_attrs.update(bnode._extra_attrs)
+                    nb._extra_attrs["__shape__"] = tuple(
+                        arg_params[bnode.name].shape)
+                    bias_s = Symbol([(nb, 0)])
             wname = node.inputs[1][0].name
             w = arg_params[wname].asnumpy()
             wmax = float(np.abs(w).max()) or 1e-8

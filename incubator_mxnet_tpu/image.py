@@ -10,6 +10,7 @@ num_parts` sharding matches the reference's multi-worker input splitting.
 """
 from __future__ import annotations
 
+import ctypes
 import os
 import random as _pyrandom
 import threading
@@ -20,6 +21,7 @@ import numpy as np
 from .base import MXNetError
 from .io import DataIter, DataBatch, DataDesc
 from .ndarray.ndarray import NDArray, array
+from . import native as _native
 from . import recordio as _recordio
 
 
@@ -354,71 +356,263 @@ class ImageIter(DataIter):
 
 class ImageRecordIterImpl(DataIter):
     """Param-compatible `ImageRecordIter` (reference
-    `iter_image_recordio_2.cc:727` registration): threaded decode pool +
-    prefetch queue over RecordIO shards."""
+    `iter_image_recordio_2.cc:727` registration).
+
+    Throughput design (same shape as the reference's C++ iterator): the
+    whole .rec is mapped into memory and indexed in one native scan
+    (`src/io_native.cc mxtpu_recordio_index`); `preprocess_threads`
+    workers each build complete batches — cv2 JPEG decode and the native
+    crop/mirror/normalize/HWC->CHW kernel both release the GIL, so the
+    pool scales — and a reorder buffer hands batches out in order.
+    """
 
     def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
                  shuffle=False, rand_crop=False, rand_mirror=False,
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
                  std_b=1.0, resize=0, part_index=0, num_parts=1,
                  preprocess_threads=4, prefetch_buffer=4, round_batch=True,
-                 data_name="data", label_name="softmax_label", **kwargs):
+                 data_name="data", label_name="softmax_label", seed=0,
+                 **kwargs):
         super().__init__(batch_size)
-        mean = None
-        if mean_r or mean_g or mean_b:
-            mean = np.array([mean_r, mean_g, mean_b], dtype="float32")
-        std = None
-        if std_r != 1.0 or std_g != 1.0 or std_b != 1.0:
-            std = np.array([std_r, std_g, std_b], dtype="float32")
-        aug = CreateAugmenter(data_shape, resize=resize, rand_crop=rand_crop,
-                              rand_mirror=rand_mirror, mean=mean, std=std)
-        self._iter = ImageIter(batch_size, data_shape, label_width,
-                               path_imgrec=path_imgrec, shuffle=shuffle,
-                               part_index=part_index, num_parts=num_parts,
-                               aug_list=aug, data_name=data_name,
-                               label_name=label_name)
-        self._queue = _queue.Queue(maxsize=int(prefetch_buffer))
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self._shuffle = shuffle
+        self._rand_crop = rand_crop
+        self._rand_mirror = rand_mirror
+        self._resize = resize
+        self._mean = np.array([mean_r, mean_g, mean_b], dtype="float32")
+        self._stdinv = 1.0 / np.array([std_r, std_g, std_b], dtype="float32")
         self._threads = max(1, int(preprocess_threads))
-        self._stop = threading.Event()
-        self._worker = None
-        self._start()
+        self._prefetch = max(2, int(prefetch_buffer))
+        self._data_name = data_name
+        self._label_name = label_name
+        self._seed = seed
+        self._rng = np.random.RandomState(seed)
+        self._epoch = 0
+        self._round_batch = round_batch
+
+        import mmap
+        self._file = open(path_imgrec, "rb")
+        self._buf = mmap.mmap(self._file.fileno(), 0,
+                              access=mmap.ACCESS_READ)
+        self._records = _index_records(self._buf)
+        if num_parts > 1:
+            n = len(self._records) // num_parts
+            self._records = self._records[part_index * n:
+                                          (part_index + 1) * n]
+        self._order = np.arange(len(self._records))
+        self._pool = None
+        self.reset()
 
     @property
     def provide_data(self):
-        return self._iter.provide_data
+        return [DataDesc(self._data_name,
+                         (self.batch_size,) + self.data_shape)]
 
     @property
     def provide_label(self):
-        return self._iter.provide_label
-
-    def _producer(self):
-        while not self._stop.is_set():
-            try:
-                batch = self._iter.next()
-            except StopIteration:
-                self._queue.put(None)
-                return
-            self._queue.put(batch)
-
-    def _start(self):
-        self._stop.clear()
-        self._worker = threading.Thread(target=self._producer, daemon=True)
-        self._worker.start()
+        shape = (self.batch_size,) if self.label_width == 1 else \
+            (self.batch_size, self.label_width)
+        return [DataDesc(self._label_name, shape)]
 
     def reset(self):
-        self._stop.set()
+        if self._pool is not None:
+            self._pool.stop()
+        if self._shuffle:
+            self._rng.shuffle(self._order)
+        self._epoch += 1
+        # reference round_batch semantics: the tail partial batch wraps
+        # around to the epoch start and reports the wrapped count as pad
+        n = len(self._order)
+        n_batches = (-(-n // self.batch_size) if self._round_batch and
+                     n % self.batch_size else n // self.batch_size)
+        self._pool = _BatchPool(self._build_batch, n_batches, self._threads,
+                                self._prefetch)
+
+    def close(self):
+        if self._pool is not None:
+            self._pool.stop()
+            self._pool = None
+
+    def __del__(self):
         try:
-            while True:
-                self._queue.get_nowait()
-        except _queue.Empty:
+            self.close()
+            self._buf.close()
+            self._file.close()
+        except Exception:
             pass
-        if self._worker is not None:
-            self._worker.join(timeout=5)
-        self._iter.reset()
-        self._start()
+
+    def _build_batch(self, bidx):
+        import cv2
+        c, h, w = self.data_shape
+        data = np.empty((self.batch_size, c, h, w), dtype="float32")
+        label = np.zeros((self.batch_size, self.label_width),
+                         dtype="float32")
+        nat = _native.lib()
+        base = bidx * self.batch_size
+        n_rec = len(self._order)
+        pad = max(0, base + self.batch_size - n_rec)
+        # a per-batch stream keeps augmentation reproducible under any
+        # thread schedule: (seed, epoch, batch) fully determines the draws
+        rng = np.random.RandomState(
+            (self._seed * 1000003 + self._epoch * 8191 + bidx) % (2**31))
+        for i in range(self.batch_size):
+            off, length = self._records[self._order[(base + i) % n_rec]]
+            header, payload = _recordio.unpack(
+                self._buf[off:off + length])
+            img = cv2.imdecode(np.frombuffer(payload, np.uint8),
+                               cv2.IMREAD_COLOR)  # BGR HWC
+            if img is None:
+                raise MXNetError(
+                    f"ImageRecordIter: record {int(self._order[(base + i) % n_rec])} "
+                    "is not a decodable image")
+            img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+            if self._resize:
+                ih, iw = img.shape[:2]
+                if ih > iw:
+                    img = cv2.resize(img, (self._resize,
+                                           int(ih * self._resize / iw)))
+                else:
+                    img = cv2.resize(img, (int(iw * self._resize / ih),
+                                           self._resize))
+            ih, iw = img.shape[:2]
+            if ih < h or iw < w:
+                img = cv2.resize(img, (max(iw, w), max(ih, h)))
+                ih, iw = img.shape[:2]
+            if self._rand_crop:
+                y0 = rng.randint(0, ih - h + 1)
+                x0 = rng.randint(0, iw - w + 1)
+            else:
+                y0, x0 = (ih - h) // 2, (iw - w) // 2
+            mirror = int(self._rand_mirror and rng.rand() < 0.5)
+            if nat is not None:
+                img = np.ascontiguousarray(img)
+                nat.mxtpu_augment_to_chw(
+                    img.ctypes.data_as(ctypes.c_void_p), ih, iw, c, y0, x0,
+                    h, w, mirror,
+                    self._mean.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_float)),
+                    self._stdinv.ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_float)),
+                    data[i].ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            else:
+                crop = img[y0:y0 + h, x0:x0 + w]
+                if mirror:
+                    crop = crop[:, ::-1]
+                data[i] = ((crop.astype("float32") - self._mean)
+                           * self._stdinv).transpose(2, 0, 1)
+            lab = np.asarray(header.label, dtype="float32").reshape(-1)
+            label[i, :min(len(lab), self.label_width)] = \
+                lab[:self.label_width]
+        label_out = label[:, 0] if self.label_width == 1 else label
+        return DataBatch(data=[array(data)], label=[array(label_out)],
+                         pad=pad, provide_data=self.provide_data,
+                         provide_label=self.provide_label)
 
     def next(self):
-        batch = self._queue.get()
+        batch = self._pool.next()
         if batch is None:
             raise StopIteration
         return batch
+
+
+class _WorkerError:
+    """A worker exception in transit to the consumer thread."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+class _BatchPool:
+    """N workers building whole batches; results handed out in order."""
+
+    def __init__(self, build, n_batches, n_threads, prefetch):
+        self._build = build
+        self._n = n_batches
+        self._stop_evt = threading.Event()
+        self._results = {}
+        self._cond = threading.Condition()
+        self._next_out = 0
+        self._max_ahead = max(prefetch, n_threads + 1)
+        self._task = iter(range(n_batches))
+        self._task_lock = threading.Lock()
+        self._threads = [threading.Thread(target=self._work, daemon=True)
+                         for _ in range(n_threads)]
+        for t in self._threads:
+            t.start()
+
+    def _work(self):
+        while not self._stop_evt.is_set():
+            with self._task_lock:
+                bidx = next(self._task, None)
+            if bidx is None:
+                return
+            with self._cond:
+                # bounded read-ahead keeps memory flat
+                self._cond.wait_for(
+                    lambda: self._stop_evt.is_set()
+                    or bidx < self._next_out + self._max_ahead)
+                if self._stop_evt.is_set():
+                    return
+            try:
+                out = self._build(bidx)
+            except BaseException as e:   # deliver to the consumer, always
+                out = _WorkerError(e)
+            with self._cond:
+                self._results[bidx] = out
+                self._cond.notify_all()
+
+    def next(self):
+        if self._next_out >= self._n:
+            return None
+        with self._cond:
+            self._cond.wait_for(lambda: self._next_out in self._results)
+            out = self._results.pop(self._next_out)
+            self._next_out += 1
+            self._cond.notify_all()
+        if isinstance(out, _WorkerError):
+            self.stop()
+            raise out.exc
+        return out
+
+    def stop(self):
+        self._stop_evt.set()
+        with self._cond:
+            self._cond.notify_all()
+        for t in self._threads:
+            t.join(timeout=5)
+
+
+def _index_records(buf):
+    """Offsets+lengths of every record payload — native scan when the
+    library is built, struct-walk fallback otherwise."""
+    nat = _native.lib()
+    if nat is not None:
+        cap = max(1024, len(buf) // 12)
+        offs = np.empty(cap, dtype=np.int64)
+        lens = np.empty(cap, dtype=np.int64)
+        # zero-copy view works for bytes and (read-only) mmap alike
+        view = np.frombuffer(buf, dtype=np.uint8)
+        n = nat.mxtpu_recordio_index(
+            view.ctypes.data_as(ctypes.c_void_p), len(buf),
+            offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), cap)
+        if n == -1:
+            raise MXNetError("Invalid RecordIO magic")
+        if n >= 0:
+            return list(zip(offs[:n].tolist(), lens[:n].tolist()))
+    import struct as _struct
+    out = []
+    pos = 0
+    while pos + 8 <= len(buf):
+        magic, lrec = _struct.unpack_from("<II", buf, pos)
+        if magic != 0xced7230a:
+            raise MXNetError("Invalid RecordIO magic")
+        length = lrec & ((1 << 29) - 1)
+        if pos + 8 + length > len(buf):
+            break
+        out.append((pos + 8, length))
+        pos += 8 + length + (4 - length % 4) % 4
+    return out

@@ -1,0 +1,67 @@
+"""ctypes loader for the native IO library (`src/io_native.cc`).
+
+The reference ships its data plane in C++ (`src/io/`); here the hot
+kernels live in `libmxtpu_io.so`, built lazily with the in-image
+toolchain on first use and cached beside the sources.  Everything using
+this module must keep a numpy fallback: `lib()` returns None when no
+compiler is available or `MXNET_USE_NATIVE_IO=0`.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "src")
+_LIB_PATH = os.path.join(_SRC_DIR, "libmxtpu_io.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _configure(lib):
+    i64 = ctypes.c_int64
+    lib.mxtpu_recordio_index.restype = i64
+    lib.mxtpu_recordio_index.argtypes = [
+        ctypes.c_void_p, i64, ctypes.POINTER(i64), ctypes.POINTER(i64), i64]
+    lib.mxtpu_augment_to_chw.restype = None
+    lib.mxtpu_augment_to_chw.argtypes = [
+        ctypes.c_void_p, i64, i64, i64, i64, i64, i64, i64, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float)]
+    lib.mxtpu_augment_batch.restype = None
+    lib.mxtpu_augment_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(i64),
+        ctypes.POINTER(i64), i64, ctypes.POINTER(i64), ctypes.POINTER(i64),
+        i64, i64, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float), i64]
+    return lib
+
+
+def lib():
+    """The loaded native library, building it if needed; None if
+    unavailable (callers fall back to numpy)."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("MXNET_USE_NATIVE_IO", "1") == "0":
+            return None
+        try:
+            if not os.path.exists(_LIB_PATH) or (
+                    os.path.getmtime(_LIB_PATH) <
+                    os.path.getmtime(os.path.join(_SRC_DIR,
+                                                  "io_native.cc"))):
+                subprocess.run(["make", "-C", _SRC_DIR, "-s"], check=True,
+                               capture_output=True, timeout=120)
+            _lib = _configure(ctypes.CDLL(_LIB_PATH))
+        except Exception:
+            _lib = None
+        return _lib

@@ -532,7 +532,10 @@ def array(source_array, ctx=None, dtype=None):
     # (reference `python/mxnet/ndarray/ndarray.py array()`)
     np_arr = _np.asarray(source_array,
                          dtype=np_dtype(dtype) if dtype is not None else _np.float32)
-    return NDArray(jax.device_put(jnp.asarray(np_arr), ctx.jax_device), ctx=ctx)
+    # put the host buffer straight onto the target device: routing through
+    # jnp.asarray first would land it on the DEFAULT device (the TPU) and
+    # then copy back — a full round trip over the chip link for cpu arrays
+    return NDArray(jax.device_put(np_arr, ctx.jax_device), ctx=ctx)
 
 
 def _staged(np_arr, ctx):

@@ -1,0 +1,81 @@
+// Native IO hot paths (the role of the reference's C++ data plane:
+// dmlc-core recordio parsing + src/io/iter_image_recordio_2.cc's
+// decode/augment inner loops).  Python orchestrates (threads, cv2 JPEG
+// decode which releases the GIL); these kernels do the byte work without
+// the interpreter: record scanning, and the crop/mirror/normalize/
+// HWC->CHW finish that dominates post-decode time.
+//
+// Built as a plain shared library, bound via ctypes (no pybind11 in this
+// image).  ctypes releases the GIL for the duration of every call, so N
+// worker threads get true parallelism here.
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// dmlc recordio framing: [u32 magic 0xced7230a][u32 cflag<<29|len][payload]
+// padded to 4 bytes (python/mxnet/recordio.py, dmlc-core/recordio.h).
+// Fills payload offsets+lengths; returns record count, or -1 on a bad
+// magic (corrupt file), -2 if max_n too small.
+int64_t mxtpu_recordio_index(const uint8_t* buf, int64_t len,
+                             int64_t* offsets, int64_t* lengths,
+                             int64_t max_n) {
+  static const uint32_t kMagic = 0xced7230a;
+  int64_t pos = 0, n = 0;
+  while (pos + 8 <= len) {
+    uint32_t magic, lrec;
+    std::memcpy(&magic, buf + pos, 4);
+    std::memcpy(&lrec, buf + pos + 4, 4);
+    if (magic != kMagic) return -1;
+    int64_t dlen = lrec & ((1u << 29) - 1);
+    if (pos + 8 + dlen > len) break;  // truncated tail record
+    if (n >= max_n) return -2;
+    offsets[n] = pos + 8;
+    lengths[n] = dlen;
+    ++n;
+    int64_t pad = (4 - dlen % 4) % 4;
+    pos += 8 + dlen + pad;
+  }
+  return n;
+}
+
+// Crop + optional horizontal mirror + per-channel normalize + HWC u8 ->
+// CHW f32.  `stdinv` is 1/std (precomputed; multiply beats divide).
+// The three channel planes are written contiguously: dst[(c)(out_h)(out_w)].
+void mxtpu_augment_to_chw(const uint8_t* src, int64_t h, int64_t w,
+                          int64_t c, int64_t crop_y, int64_t crop_x,
+                          int64_t out_h, int64_t out_w, int mirror,
+                          const float* mean, const float* stdinv,
+                          float* dst) {
+  (void)h;
+  const int64_t plane = out_h * out_w;
+  for (int64_t y = 0; y < out_h; ++y) {
+    const uint8_t* row = src + ((crop_y + y) * w + crop_x) * c;
+    float* drow = dst + y * out_w;
+    for (int64_t x = 0; x < out_w; ++x) {
+      int64_t sx = mirror ? (out_w - 1 - x) : x;
+      const uint8_t* px = row + sx * c;
+      for (int64_t ch = 0; ch < c; ++ch) {
+        drow[ch * plane + x] = (static_cast<float>(px[ch]) - mean[ch])
+                               * stdinv[ch];
+      }
+    }
+  }
+}
+
+// Batched variant: one call finishes a whole batch with OpenMP threads.
+void mxtpu_augment_batch(const uint8_t** srcs, const int64_t* hs,
+                         const int64_t* ws, int64_t c,
+                         const int64_t* crop_ys, const int64_t* crop_xs,
+                         int64_t out_h, int64_t out_w, const int* mirrors,
+                         const float* mean, const float* stdinv, float* dst,
+                         int64_t n) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    mxtpu_augment_to_chw(srcs[i], hs[i], ws[i], c, crop_ys[i], crop_xs[i],
+                         out_h, out_w, mirrors[i], mean, stdinv,
+                         dst + i * c * out_h * out_w);
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Input-pipeline throughput bench (reference counterpart:
+`src/io/iter_image_recordio_2.cc` threaded decode, measured by
+`tests/python/train` pipelines).
+
+Builds a synthetic JPEG corpus packed into a .rec file, then measures
+ImageRecordIter img/s across thread counts.  Prints one JSON line.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_corpus(path, n=1024, size=256, quality=90):
+    import cv2
+    from incubator_mxnet_tpu import recordio
+    rng = np.random.RandomState(0)
+    rec = recordio.MXRecordIO(path, "w")
+    for i in range(n):
+        img = rng.randint(0, 255, (size, size, 3), dtype=np.uint8)
+        # random noise compresses badly; blur for realistic jpeg sizes
+        img = cv2.GaussianBlur(img, (9, 9), 4)
+        ok, enc = cv2.imencode(".jpg", img,
+                               [cv2.IMWRITE_JPEG_QUALITY, quality])
+        assert ok
+        rec.write(recordio.pack(
+            recordio.IRHeader(0, float(i % 10), i, 0), enc.tobytes()))
+    rec.close()
+
+
+def measure(path, batch_size, shape, threads, epochs=1):
+    from incubator_mxnet_tpu import io as mxio
+    it = mxio.ImageRecordIter(
+        path_imgrec=path, data_shape=shape, batch_size=batch_size,
+        rand_crop=True, rand_mirror=True,
+        mean_r=123.68, mean_g=116.78, mean_b=103.94,
+        std_r=58.4, std_g=57.1, std_b=57.4,
+        preprocess_threads=threads, prefetch_buffer=8)
+    for i, batch in enumerate(it):      # warmup: jax init + jit caches
+        if i >= 2:
+            break
+    n_img = 0
+    t0 = time.perf_counter()
+    for _ in range(epochs):
+        it.reset()
+        for batch in it:
+            n_img += batch.data[0].shape[0]
+    dt = time.perf_counter() - t0
+    return n_img / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1024)
+    ap.add_argument("--size", type=int, default=256)
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--crop", type=int, default=224)
+    ap.add_argument("--threads", type=int, nargs="+", default=[1, 4, 8, 16])
+    args = ap.parse_args()
+
+    with tempfile.TemporaryDirectory() as td:
+        rec = os.path.join(td, "corpus.rec")
+        build_corpus(rec, n=args.n, size=args.size)
+        from incubator_mxnet_tpu import native
+        results = {}
+        for t in args.threads:
+            results[f"threads_{t}"] = round(
+                measure(rec, args.batch, (3, args.crop, args.crop), t), 1)
+        best = max(results.values())
+        print(json.dumps({
+            "metric": "image_record_iter_img_per_sec",
+            "value": best, "unit": "img/sec",
+            "native": native.lib() is not None,
+            **results}))
+
+
+if __name__ == "__main__":
+    main()
