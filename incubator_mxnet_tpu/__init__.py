@@ -41,7 +41,8 @@ for _mod_name, _aliases in [
     ("io", ()), ("recordio", ()), ("gluon", ()), ("module", ("mod",)),
     ("model", ()), ("profiler", ()), ("visualization", ("viz",)),
     ("parallel", ()), ("test_utils", ()), ("image", ()), ("operator", ()),
-    ("contrib", ()),
+    ("contrib", ()), ("rnn", ()), ("compat", ()), ("dist", ()),
+    ("native", ()),
 ]:
     try:
         _m = _importlib.import_module("." + _mod_name, __name__)

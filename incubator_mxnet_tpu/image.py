@@ -397,9 +397,12 @@ class ImageRecordIterImpl(DataIter):
                               access=mmap.ACCESS_READ)
         self._records = _index_records(self._buf)
         if num_parts > 1:
-            n = len(self._records) // num_parts
-            self._records = self._records[part_index * n:
-                                          (part_index + 1) * n]
+            # contiguous shards; the remainder spreads over the first
+            # parts so every record belongs to exactly one part
+            n, rem = divmod(len(self._records), num_parts)
+            start = part_index * n + min(part_index, rem)
+            stop = start + n + (1 if part_index < rem else 0)
+            self._records = self._records[start:stop]
         self._order = np.arange(len(self._records))
         self._pool = None
         self.reset()

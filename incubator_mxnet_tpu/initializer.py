@@ -75,6 +75,15 @@ class Initializer:
             self._init_zero(name, arr)
         elif name.endswith("min") or name.endswith("max"):
             self._init_zero(name, arr)
+        elif name.endswith("parameters"):
+            # FusedRNNCell's flat 1-D parameter block: honor the concrete
+            # initializer when it can handle a vector (Zero/Constant/
+            # Uniform); fan-in schemes like Xavier cannot, so fall back to
+            # small uniform (the reference's FusedRNN default)
+            try:
+                self._init_weight(name, arr)
+            except Exception:
+                self._set(arr, np.random.uniform(-0.07, 0.07, arr.shape))
         else:
             self._init_default(name, arr)
 

@@ -132,20 +132,22 @@ class Module(BaseModule):
                                dtype=self._exec_group.execs[0].aux_dict[name].dtype)
                 for name in self._aux_names}
 
-        def _impl(name, arr, cache):
+        def _impl(desc, arr, cache):
+            # desc carries the variable's attr dict (__init__ etc.) — the
+            # initializer dispatches on it, so it must not be rebuilt bare
             if cache is not None:
-                if name in cache:
-                    cache_arr = cache[name]
+                if str(desc) in cache:
+                    cache_arr = cache[str(desc)]
                     if cache_arr is not arr:
                         cache_arr.copyto(arr)
                 else:
                     if not allow_missing:
-                        raise RuntimeError(f"{name} is not presented")
+                        raise RuntimeError(f"{desc} is not presented")
                     if initializer is not None:
-                        initializer(InitDesc(name), arr)
+                        initializer(desc, arr)
             else:
                 if initializer is not None:
-                    initializer(InitDesc(name), arr)
+                    initializer(desc, arr)
 
         attrs = self._symbol.attr_dict()
         for name, arr in sorted(self._arg_params.items()):

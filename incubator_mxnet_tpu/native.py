@@ -55,12 +55,19 @@ def lib():
         if os.environ.get("MXNET_USE_NATIVE_IO", "1") == "0":
             return None
         try:
-            if not os.path.exists(_LIB_PATH) or (
-                    os.path.getmtime(_LIB_PATH) <
-                    os.path.getmtime(os.path.join(_SRC_DIR,
-                                                  "io_native.cc"))):
+            src = os.path.join(_SRC_DIR, "io_native.cc")
+            have_lib = os.path.exists(_LIB_PATH)
+            # rebuild when the source is newer; a prebuilt .so without
+            # sources (deployed image) is used as-is
+            stale = (os.path.exists(src)
+                     and (not have_lib
+                          or os.path.getmtime(_LIB_PATH)
+                          < os.path.getmtime(src)))
+            if stale:
                 subprocess.run(["make", "-C", _SRC_DIR, "-s"], check=True,
                                capture_output=True, timeout=120)
+            elif not have_lib:
+                return None
             _lib = _configure(ctypes.CDLL(_LIB_PATH))
         except Exception:
             _lib = None

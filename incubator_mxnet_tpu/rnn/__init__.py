@@ -1,0 +1,12 @@
+"""Legacy symbolic RNN API (reference `python/mxnet/rnn/`): cell classes
+that unroll into Symbol graphs, plus `BucketSentenceIter` for
+variable-length corpora.  The modern path is `gluon.rnn`; this package
+exists for reference-API parity (`example/rnn/bucketing`)."""
+from .rnn_cell import (BaseRNNCell, RNNCell, LSTMCell, GRUCell,
+                       FusedRNNCell, SequentialRNNCell, DropoutCell,
+                       ZoneoutCell, ResidualCell)
+from .io import BucketSentenceIter, encode_sentences
+
+__all__ = ["BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell", "FusedRNNCell",
+           "SequentialRNNCell", "DropoutCell", "ZoneoutCell", "ResidualCell",
+           "BucketSentenceIter", "encode_sentences"]

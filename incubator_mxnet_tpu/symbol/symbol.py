@@ -573,7 +573,29 @@ def graph_eval_fn(symbol, is_train, n_rng_hint=None):
 
 def _infer_graph(symbol, shapes, partial):
     """Shape inference by abstract evaluation (replaces the InferShape
-    fixpoint, `src/executor/infer_graph_attr_pass.cc:73`)."""
+    fixpoint, `src/executor/infer_graph_attr_pass.cc:73`).
+
+    Layout-marked variables with a 0 batch dim (RNN begin states) need the
+    data batch size, but bound data may be batch-major (NT) or time-major
+    (TN) — try each leading dim of the first bound shape as the hint and
+    keep the first that infers cleanly.
+    """
+    first = next((tuple(v) for v in shapes.values()
+                  if v and tuple(v) and tuple(v)[0] > 0), None)
+    hints = []
+    if first:
+        hints = [d for d in first[:2] if d > 0]
+    hints = list(dict.fromkeys(hints)) or [None]
+    last_err = None
+    for hint in hints:
+        try:
+            return _infer_graph_with_hint(symbol, shapes, partial, hint)
+        except MXNetError as e:
+            last_err = e
+    raise last_err
+
+
+def _infer_graph_with_hint(symbol, shapes, partial, batch_hint):
     import jax
 
     arg_names = symbol.list_arguments()
@@ -589,6 +611,12 @@ def _infer_graph(symbol, shapes, partial):
                 cand = tuple(shapes[n.name])
             elif "__shape__" in n._extra_attrs:
                 cand = tuple(n._extra_attrs["__shape__"])
+                layout = n._extra_attrs.get("__layout__")
+                if cand and batch_hint is not None and layout:
+                    bpos = str(layout).find("N")
+                    if 0 <= bpos < len(cand) and cand[bpos] == 0:
+                        cand = tuple(batch_hint if i == bpos else d
+                                     for i, d in enumerate(cand))
             # shapes containing 0 are "unknown dims" (deferred init) — solve
             if cand is not None and all(d > 0 for d in cand):
                 known[n.name] = cand

@@ -1,0 +1,83 @@
+"""Legacy symbolic RNN API tests (reference
+tests/python/unittest/test_rnn.py): cell unrolling, fused equivalence,
+BucketSentenceIter semantics."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import rnn
+
+
+def test_lstm_cell_unroll_shapes():
+    cell = rnn.LSTMCell(16, prefix="l_")
+    inputs = [mx.sym.Variable(f"t{i}") for i in range(3)]
+    outputs, states = cell.unroll(3, inputs)
+    out = mx.sym.Group(outputs)
+    args = {f"t{i}": (2, 8) for i in range(3)}
+    _, out_shapes, _ = out.infer_shape(**args)
+    assert out_shapes == [(2, 16)] * 3
+    assert len(states) == 2
+
+
+def test_stacked_cells_train_reduces_loss():
+    V, E, H, T, B = 30, 8, 16, 6, 8
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(H, prefix="lstm_l0_"))
+    stack.add(rnn.GRUCell(H, prefix="gru_l1_"))
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    embed = mx.sym.Embedding(data, input_dim=V, output_dim=E)
+    outputs, _ = stack.unroll(T, inputs=embed, merge_outputs=True)
+    pred = mx.sym.Reshape(outputs, shape=(-1, H))
+    pred = mx.sym.FullyConnected(pred, num_hidden=V)
+    net = mx.sym.SoftmaxOutput(pred, mx.sym.Reshape(label, shape=(-1,)))
+
+    rng = np.random.RandomState(0)
+    X = rng.randint(0, V, (64, T)).astype("f4")
+    Y = np.roll(X, -1, axis=1)
+    it = mx.io.NDArrayIter(X, Y, batch_size=B)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    metric = mx.metric.Perplexity(None)
+    mod.fit(it, num_epoch=12, optimizer="adam", eval_metric=metric,
+            optimizer_params={"learning_rate": 0.01,
+                              "rescale_grad": 1.0 / (B * T)})
+    it.reset()
+    final = dict(mod.score(it, mx.metric.Perplexity(None)))["perplexity"]
+    assert final < V * 0.8, final     # better than uniform guessing
+
+
+def test_fused_cell_runs_and_unfuses():
+    cell = rnn.FusedRNNCell(12, num_layers=2, mode="lstm", prefix="f_")
+    data = mx.sym.Variable("data")
+    outputs, states = cell.unroll(5, inputs=data, layout="NTC",
+                                  merge_outputs=True)
+    _, out_shapes, _ = outputs.infer_shape(data=(4, 5, 7))
+    assert out_shapes[0] == (4, 5, 12)
+    stack = cell.unfuse()
+    assert len(stack._cells) == 2
+
+
+def test_bucket_sentence_iter():
+    rng = np.random.RandomState(0)
+    sentences = [list(rng.randint(1, 50, rng.randint(3, 20)))
+                 for _ in range(200)]
+    it = rnn.BucketSentenceIter(sentences, batch_size=8,
+                                buckets=[10, 20], invalid_label=0)
+    assert it.default_bucket_key == 20
+    n = 0
+    for batch in it:
+        assert batch.bucket_key in (10, 20)
+        data = batch.data[0].asnumpy()
+        label = batch.label[0].asnumpy()
+        assert data.shape == (8, batch.bucket_key)
+        # label is data shifted left by one
+        np.testing.assert_array_equal(label[:, :-1], data[:, 1:])
+        n += 1
+    assert n > 0
+
+
+def test_encode_sentences():
+    coded, vocab = rnn.encode_sentences([["a", "b"], ["b", "c"]],
+                                        start_label=1)
+    assert coded[0][1] == coded[1][0]      # shared token -> same id
+    assert len(vocab) == 4                 # 3 tokens + invalid key

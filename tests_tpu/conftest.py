@@ -1,0 +1,26 @@
+"""TPU-context test lane (reference `tests/python/gpu/test_operator_gpu.py`
+pattern: rerun the operator battery on the accelerator and compare against
+the CPU context).
+
+Run with `python -m pytest tests_tpu -q` on a machine with a TPU attached.
+Unlike `tests/` (which pins everything to a virtual CPU mesh), this lane
+keeps the real platform and skips itself when no TPU is present.
+"""
+import numpy as np
+import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    import incubator_mxnet_tpu as mx
+    if mx.context.num_tpus() == 0:
+        skip = pytest.mark.skip(reason="no TPU device attached")
+        for item in items:
+            item.add_marker(skip)
+
+
+@pytest.fixture(autouse=True)
+def _seeded():
+    np.random.seed(0)
+    import incubator_mxnet_tpu as mx
+    mx.random.seed(0)
+    yield
