@@ -1,0 +1,11 @@
+"""ONNX interchange (reference `python/mxnet/contrib/onnx/`).
+
+The wire format is produced/consumed through a protoc-compiled subset of
+the public ONNX schema (`onnx_subset.proto` — field numbers match the
+official definition, so files interchange with any ONNX runtime); the
+`onnx` python package is not required.
+"""
+from .export_onnx import export_model
+from .import_onnx import import_model
+
+__all__ = ["export_model", "import_model"]

@@ -1,0 +1,276 @@
+"""Symbol -> ONNX exporter (reference
+`python/mxnet/contrib/onnx/mx2onnx/export_model.py`)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from . import onnx_subset_pb2 as OP
+
+_DT = {np.dtype("float32"): 1, np.dtype("uint8"): 2, np.dtype("int8"): 3,
+       np.dtype("int32"): 6, np.dtype("int64"): 7, np.dtype("bool"): 9,
+       np.dtype("float16"): 10, np.dtype("float64"): 11}
+
+OPSET = 13
+
+
+def _tensor(name, arr):
+    arr = np.ascontiguousarray(arr)
+    t = OP.TensorProto()
+    t.name = name
+    t.dims.extend(arr.shape)
+    t.data_type = _DT[arr.dtype]
+    t.raw_data = arr.tobytes()
+    return t
+
+
+def _attr(name, value):
+    a = OP.AttributeProto()
+    a.name = name
+    if isinstance(value, bool):
+        a.type = OP.AttributeProto.INT
+        a.i = int(value)
+    elif isinstance(value, int):
+        a.type = OP.AttributeProto.INT
+        a.i = value
+    elif isinstance(value, float):
+        a.type = OP.AttributeProto.FLOAT
+        a.f = value
+    elif isinstance(value, str):
+        a.type = OP.AttributeProto.STRING
+        a.s = value.encode()
+    elif isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], float):
+            a.type = OP.AttributeProto.FLOATS
+            a.floats.extend(value)
+        else:
+            a.type = OP.AttributeProto.INTS
+            a.ints.extend(int(v) for v in value)
+    else:
+        raise MXNetError(f"onnx export: bad attribute {name}={value!r}")
+    return a
+
+
+def _pair(p, key, default):
+    v = p.get(key) or default
+    v = (v, v) if isinstance(v, int) else tuple(v)
+    return v if v else default
+
+
+class _Exporter:
+    def __init__(self, sym, params, in_shapes, in_types, graph_name):
+        self.sym = sym
+        self.params = params
+        self.nodes = []
+        self.initializers = []
+        self.inputs = []
+        self.counter = 0
+        self.graph_name = graph_name
+        self.in_shapes = in_shapes
+        self.in_types = in_types
+
+    def _name(self, base):
+        self.counter += 1
+        return f"{base}_{self.counter}"
+
+    def node(self, op_type, inputs, outputs=None, name=None, **attrs):
+        n = OP.NodeProto()
+        n.op_type = op_type
+        n.name = name or self._name(op_type.lower())
+        n.input.extend(inputs)
+        outputs = outputs or [n.name + "_out"]
+        n.output.extend(outputs)
+        for k, v in attrs.items():
+            if v is not None:
+                n.attribute.append(_attr(k, v))
+        self.nodes.append(n)
+        return outputs[0]
+
+    def add_initializer(self, name, arr):
+        self.initializers.append(_tensor(name, np.asarray(arr)))
+
+    def const_i64(self, values):
+        name = self._name("const")
+        self.add_initializer(name, np.asarray(values, np.int64))
+        return name
+
+    # -- op translators ------------------------------------------------------
+    def convert(self, node, in_names):
+        op = node.op.name
+        p = node.attrs
+        nm = node.name
+
+        if op == "Convolution":
+            k = _pair(p, "kernel", (1, 1))
+            pad = _pair(p, "pad", (0, 0))
+            out = self.node(
+                "Conv", in_names, name=nm,
+                kernel_shape=k, strides=_pair(p, "stride", (1, 1)),
+                pads=list(pad) + list(pad),
+                dilations=_pair(p, "dilate", (1, 1)),
+                group=int(p.get("num_group", 1)))
+            return out
+        if op == "FullyConnected":
+            data = in_names[0]
+            if p.get("flatten", True):
+                data = self.node("Flatten", [data], axis=1)
+            ins = [data, in_names[1]]
+            if len(in_names) > 2:
+                ins.append(in_names[2])
+            return self.node("Gemm", ins, name=nm, alpha=1.0, beta=1.0,
+                             transB=1)
+        if op == "Activation":
+            table = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+                     "softrelu": "Softplus", "softsign": "Softsign"}
+            act = table.get(p["act_type"])
+            if act is None:
+                raise MXNetError(f"onnx export: Activation act_type="
+                                 f"{p['act_type']!r} not yet mapped")
+            return self.node(act, in_names, name=nm)
+        if op == "LeakyReLU":
+            return self.node("LeakyRelu", in_names, name=nm,
+                             alpha=float(p.get("slope", 0.25)))
+        if op == "Pooling":
+            ptype = p.get("pool_type", "max")
+            if ptype not in ("max", "avg"):
+                raise MXNetError(f"onnx export: pool_type={ptype!r} has no "
+                                 "ONNX counterpart (only max/avg)")
+            if p.get("global_pool"):
+                return self.node("GlobalMaxPool" if ptype == "max"
+                                 else "GlobalAveragePool", in_names, name=nm)
+            k = _pair(p, "kernel", (1, 1))
+            pad = _pair(p, "pad", (0, 0))
+            return self.node(
+                "MaxPool" if ptype == "max" else "AveragePool", in_names,
+                name=nm, kernel_shape=k,
+                strides=_pair(p, "stride", (1, 1)),
+                pads=list(pad) + list(pad))
+        if op in ("BatchNorm", "BatchNorm_v1"):
+            return self.node("BatchNormalization", in_names, name=nm,
+                             epsilon=float(p.get("eps", 1e-5)),
+                             momentum=float(p.get("momentum", 0.9)))
+        if op == "Flatten":
+            return self.node("Flatten", in_names, name=nm, axis=1)
+        if op == "Reshape":
+            shape = [int(d) for d in p["shape"]]
+            return self.node("Reshape",
+                             [in_names[0], self.const_i64(shape)], name=nm)
+        if op == "transpose":
+            return self.node("Transpose", in_names, name=nm,
+                             perm=list(p["axes"]))
+        if op in ("concat", "Concat"):
+            return self.node("Concat", in_names, name=nm,
+                             axis=int(p.get("dim", 1)))
+        if op in ("elemwise_add", "broadcast_add", "_plus"):
+            return self.node("Add", in_names, name=nm)
+        if op in ("elemwise_sub", "broadcast_sub"):
+            return self.node("Sub", in_names, name=nm)
+        if op in ("elemwise_mul", "broadcast_mul"):
+            return self.node("Mul", in_names, name=nm)
+        if op in ("elemwise_div", "broadcast_div"):
+            return self.node("Div", in_names, name=nm)
+        if op == "dot":
+            return self.node("MatMul", in_names, name=nm)
+        if op in ("softmax", "SoftmaxActivation"):
+            return self.node("Softmax", in_names, name=nm,
+                             axis=int(p.get("axis", -1)))
+        if op == "SoftmaxOutput":
+            # inference semantics: plain softmax over the class axis
+            return self.node("Softmax", in_names[:1], name=nm, axis=1)
+        if op == "Dropout":
+            # opset 13 takes ratio as an optional input tensor
+            ratio = self._name("dropout_ratio")
+            self.add_initializer(ratio,
+                                 np.float32(p.get("p", 0.5)))
+            return self.node("Dropout", [in_names[0], ratio], name=nm)
+        if op == "Embedding":
+            # onnx Gather(weight, indices)
+            return self.node("Gather", [in_names[1], in_names[0]], name=nm,
+                             axis=0)
+        raise MXNetError(f"onnx export: operator {op} not yet mapped "
+                         "(extend mx2onnx op table)")
+
+    def run(self):
+        memo = {}
+        topo = self.sym._topo()
+        for node in topo:
+            if node.is_variable:
+                if node.name in self.params:
+                    self.add_initializer(node.name,
+                                         self.params[node.name].asnumpy())
+                else:
+                    vi = OP.ValueInfoProto()
+                    vi.name = node.name
+                    vi.type.tensor_type.elem_type = _DT[np.dtype(
+                        self.in_types.get(node.name, "float32"))]
+                    for d in self.in_shapes.get(node.name, ()):
+                        dim = vi.type.tensor_type.shape.dim.add()
+                        dim.dim_value = int(d)
+                    self.inputs.append(vi)
+                memo[id(node)] = [node.name]
+                continue
+            ins = []
+            for src, idx in node.inputs:
+                outs = memo[id(src)]
+                if idx >= len(outs):
+                    raise MXNetError(
+                        f"onnx export: {src.name} output {idx} is consumed "
+                        "but only its first output is exported (multi-"
+                        "output ops are not yet mapped)")
+                ins.append(outs[idx])
+            out = self.convert(node, ins)
+            memo[id(node)] = [out]
+
+        g = OP.GraphProto()
+        g.name = self.graph_name
+        g.node.extend(self.nodes)
+        g.initializer.extend(self.initializers)
+        g.input.extend(self.inputs)
+        for node, idx in self.sym._entries:
+            outs = memo[id(node)]
+            if idx >= len(outs):
+                raise MXNetError(
+                    f"onnx export: graph output {node.name}[{idx}] refers "
+                    "to an unexported secondary output")
+            vi = OP.ValueInfoProto()
+            vi.name = outs[idx]
+            vi.type.tensor_type.elem_type = 1
+            g.output.append(vi)
+
+        m = OP.ModelProto()
+        m.ir_version = 8
+        m.producer_name = "incubator_mxnet_tpu"
+        m.graph.CopyFrom(g)
+        ops = m.opset_import.add()
+        ops.domain = ""
+        ops.version = OPSET
+        return m
+
+
+def export_model(sym, params, in_shapes=None, in_types=None,
+                 onnx_file_path="model.onnx", verbose=False, **kwargs):
+    """Reference `mx2onnx/export_model.py:export_model` surface.
+
+    sym: Symbol (or path to -symbol.json); params: dict (or .params path);
+    returns the path written.
+    """
+    from ... import symbol as _sym
+    from ...ndarray import utils as _nd_utils
+    if isinstance(sym, str):
+        sym = _sym.load(sym)
+    if isinstance(params, str):
+        params = _nd_utils.load(params)
+        params = {k.split(":", 1)[-1]: v for k, v in params.items()}
+    shapes = {}
+    types = {}
+    data_names = [n for n in sym.list_arguments() if n not in params]
+    if in_shapes is not None:
+        for name, s in zip(data_names, in_shapes):
+            shapes[name] = tuple(s)
+    if in_types is not None:
+        for name, t in zip(data_names, in_types):
+            types[name] = np.dtype(t).name
+    model = _Exporter(sym, params, shapes, types, "incubator_mxnet_tpu").run()
+    with open(onnx_file_path, "wb") as f:
+        f.write(model.SerializeToString())
+    return onnx_file_path

@@ -1,0 +1,22 @@
+"""Subgraph partition framework (reference `src/operator/subgraph/`:
+`subgraph_property.h`, `partition_graph.cc:767`).
+
+Pluggable backends mark regions of a Symbol graph and replace each with a
+single fused operator — the escape hatch for custom kernels the compiler
+will not produce on its own.  On TPU the payoff is a hand-written Pallas
+kernel occupying an op slot inside an otherwise XLA-compiled graph
+(`fused_ops.py` ships a fused FullyConnected+ReLU as the working
+example, the role MKLDNN/TensorRT properties play in the reference).
+
+Usage:
+    partitioned = subgraph.partition_graph(sym, "TPU_PALLAS")
+or set MXNET_SUBGRAPH_BACKEND=TPU_PALLAS to partition inside
+`simple_bind` (the reference's env-var behavior, `build_subgraph.cc`).
+"""
+from .subgraph_property import (SubgraphProperty, register_subgraph_property,
+                                get_subgraph_property, list_backends)
+from .partition import partition_graph
+from . import fused_ops  # registers the default TPU_PALLAS backend
+
+__all__ = ["SubgraphProperty", "register_subgraph_property",
+           "get_subgraph_property", "list_backends", "partition_graph"]

@@ -251,8 +251,16 @@ class Symbol:
         `graph_executor.cc:1575`)."""
         from ..executor import Executor
         from ..context import current_context
+        import os
         ctx = ctx or current_context()
-        return Executor._simple_bind(self, ctx, grad_req, type_dict, kwargs)
+        sym = self
+        backend = os.environ.get("MXNET_SUBGRAPH_BACKEND")
+        if backend:
+            # reference build_subgraph.cc: env-selected backend partitions
+            # the graph at bind time
+            from ..subgraph import partition_graph
+            sym = partition_graph(self, backend)
+        return Executor._simple_bind(sym, ctx, grad_req, type_dict, kwargs)
 
     def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,
              group2ctx=None, shared_exec=None):
@@ -714,7 +722,7 @@ def _solve_param_shapes(node, env):
     if d is None:
         return False
     p = node.attrs
-    if op_name == "FullyConnected":
+    if op_name in ("FullyConnected", "_sg_pallas_fc_relu"):
         num_hidden = int(p["num_hidden"])
         in_units = 1
         if p.get("flatten", True):

@@ -1,0 +1,95 @@
+"""ONNX export/import round-trip tests (reference
+tests/python-pytest/onnx/ strategy: numerical equivalence after
+interchange)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.contrib import onnx as mx_onnx
+
+
+def _convnet():
+    data = mx.sym.Variable("data")
+    x = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                           name="conv0")
+    x = mx.sym.BatchNorm(x, fix_gamma=False, name="bn0")
+    x = mx.sym.Activation(x, act_type="relu")
+    x = mx.sym.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    x = mx.sym.Flatten(x)
+    x = mx.sym.FullyConnected(x, num_hidden=10, name="fc0")
+    return mx.sym.softmax(x)
+
+
+def _init(sym, data_shape):
+    arg_shapes, _, aux_shapes = sym.infer_shape(data=data_shape)
+    rng = np.random.RandomState(0)
+    args, auxs = {}, {}
+    for name, s in zip(sym.list_arguments(), arg_shapes):
+        if name != "data":
+            args[name] = nd.array(rng.normal(0, 0.5, s).astype("f4"))
+    for name, s in zip(sym.list_auxiliary_states(), aux_shapes):
+        auxs[name] = nd.array(
+            np.abs(rng.normal(1.0, 0.1, s)).astype("f4"))
+    return args, auxs
+
+
+def _forward(sym, args, auxs, x):
+    exe = sym.simple_bind(ctx=mx.cpu(), grad_req="null", data=x.shape)
+    exe.copy_params_from(args, auxs, allow_extra_params=True)
+    return exe.forward(is_train=False, data=nd.array(x))[0].asnumpy()
+
+
+def test_roundtrip_convnet(tmp_path):
+    sym = _convnet()
+    x = np.random.RandomState(1).normal(0, 1, (2, 3, 8, 8)).astype("f4")
+    args, auxs = _init(sym, x.shape)
+    ref = _forward(sym, args, auxs, x)
+
+    path = str(tmp_path / "m.onnx")
+    mx_onnx.export_model(sym, {**args, **auxs}, in_shapes=[x.shape],
+                         onnx_file_path=path)
+    sym2, args2, auxs2 = mx_onnx.import_model(path)
+    out = _forward(sym2, args2, auxs2, x)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_roundtrip_mlp_and_ops(tmp_path):
+    a = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(a, num_hidden=16, name="l1")
+    h = mx.sym.Activation(h, act_type="tanh")
+    h2 = mx.sym.FullyConnected(h, num_hidden=16, name="l2", no_bias=True)
+    s = mx.sym.broadcast_add(h, h2)
+    s = mx.sym.Reshape(s, shape=(-1, 4, 4))
+    s = mx.sym.transpose(s, axes=(0, 2, 1))
+    out = mx.sym.Reshape(s, shape=(0, -1))
+    x = np.random.RandomState(2).normal(0, 1, (4, 6)).astype("f4")
+    args, auxs = _init(out, x.shape)
+    ref = _forward(out, args, auxs, x)
+
+    path = str(tmp_path / "mlp.onnx")
+    mx_onnx.export_model(out, args, in_shapes=[x.shape],
+                         onnx_file_path=path)
+    sym2, args2, auxs2 = mx_onnx.import_model(path)
+    got = _forward(sym2, args2, auxs2, x)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_exported_file_is_valid_onnx_wire_format(tmp_path):
+    """Parse the file with a FRESH protobuf read and verify the official
+    field layout (ir_version, opset, graph nodes)."""
+    from incubator_mxnet_tpu.contrib.onnx import onnx_subset_pb2 as OP
+    sym = _convnet()
+    args, auxs = _init(sym, (2, 3, 8, 8))
+    path = str(tmp_path / "w.onnx")
+    mx_onnx.export_model(sym, {**args, **auxs}, in_shapes=[(2, 3, 8, 8)],
+                         onnx_file_path=path)
+    m = OP.ModelProto()
+    m.ParseFromString(open(path, "rb").read())
+    assert m.ir_version == 8
+    assert m.opset_import[0].version == 13
+    ops = [n.op_type for n in m.graph.node]
+    assert "Conv" in ops and "BatchNormalization" in ops and "Gemm" in ops
+    assert m.graph.input[0].name == "data"
+    dims = [d.dim_value for d in
+            m.graph.input[0].type.tensor_type.shape.dim]
+    assert dims == [2, 3, 8, 8]
