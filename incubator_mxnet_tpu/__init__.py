@@ -17,8 +17,10 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+from .attribute import AttrScope
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
+from . import config
 from . import engine
 from . import random
 from . import autograd
@@ -63,3 +65,6 @@ if "optimizer" in globals():
     from .optimizer import Optimizer  # noqa: E402
 
 rnd = random
+
+# env-var knobs that act at import time (config.py documents the full table)
+config.apply_startup_knobs()

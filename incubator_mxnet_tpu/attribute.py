@@ -1,0 +1,49 @@
+"""AttrScope (reference `python/mxnet/attribute.py`): a context manager
+stamping attributes (ctx_group, lr_mult, ...) onto every symbol created
+inside it — the legacy surface for model-parallel group placement:
+
+    with mx.AttrScope(ctx_group="embed"):
+        w = mx.sym.Variable("embed_weight")
+    ...
+    sym.simple_bind(ctx=mx.tpu(0), group2ctx={"embed": mx.cpu()}, ...)
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope"]
+
+_state = threading.local()
+
+
+def _stack():
+    if not hasattr(_state, "stack"):
+        _state.stack = []
+    return _state.stack
+
+
+class AttrScope:
+    def __init__(self, **attrs):
+        self._attrs = {f"__{k}__" if not k.startswith("__") else k: str(v)
+                       for k, v in attrs.items()}
+
+    def get(self, user_attrs=None):
+        merged = dict(self._attrs)
+        if user_attrs:
+            merged.update(user_attrs)
+        return merged
+
+    def __enter__(self):
+        _stack().append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _stack().pop()
+
+
+def current_attrs():
+    """Merged attrs of all active scopes (innermost wins)."""
+    out = {}
+    for scope in _stack():
+        out.update(scope._attrs)
+    return out

@@ -1,0 +1,151 @@
+"""Environment-variable configuration (reference `docs/faq/env_var.md`).
+
+Every documented MXNET_* knob is registered here with its mapping onto
+this framework.  Three honest statuses:
+
+* honored    — changes behavior (the entry names the consumer)
+* subsumed   — the mechanism it tuned does not exist on the XLA/TPU
+  design (e.g. GPU memory pools, NNPACK, OpenMP tuning); reading it is
+  harmless and a debug log records that it was ignored
+* accepted   — parsed and exposed via `config.get`, consumers may adopt
+
+`config.get(name, default)` is the single read path: values are parsed
+to the registered type, and unknown MXNET_* variables in the process
+environment produce one warning each (catching typos, the failure mode
+env-knob systems actually have).
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+_LOG = logging.getLogger(__name__)
+
+_BOOL = lambda s: s not in ("0", "false", "False", "")
+
+# name -> (type, default, status, note)
+KNOBS = {
+    # -- engine / execution --------------------------------------------------
+    "MXNET_ENGINE_TYPE": (str, "ThreadedEnginePerDevice", "honored",
+                          "engine.py: NaiveEngine forces synchronous "
+                          "dispatch (block_until_ready per op)"),
+    "MXNET_EXEC_BULK_EXEC_INFERENCE": (_BOOL, True, "honored",
+                                       "engine.bulk scopes batch host "
+                                       "staging at inference"),
+    "MXNET_EXEC_BULK_EXEC_TRAIN": (_BOOL, True, "honored",
+                                   "engine.bulk scopes batch host staging "
+                                   "in training"),
+    "MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN": (int, 15, "subsumed",
+                                            "XLA fuses whole graphs; no "
+                                            "segment cap applies"),
+    "MXNET_EXEC_ENABLE_INPLACE": (_BOOL, True, "subsumed",
+                                  "XLA buffer assignment handles aliasing"),
+    "MXNET_EXEC_NUM_TEMP": (int, 1, "subsumed", "no temp-space workspace"),
+    # -- threading -----------------------------------------------------------
+    "MXNET_CPU_WORKER_NTHREADS": (int, 4, "honored",
+                                  "default preprocess_threads for "
+                                  "ImageRecordIter / DataLoader workers"),
+    "MXNET_CPU_PRIORITY_NTHREADS": (int, 4, "subsumed", "no priority queue"),
+    "MXNET_CPU_NNPACK_NTHREADS": (int, 4, "subsumed", "no NNPACK"),
+    "MXNET_MP_WORKER_NTHREADS": (int, 1, "accepted", "dataloader workers"),
+    "MXNET_OMP_MAX_THREADS": (int, 0, "honored",
+                              "exported as OMP_NUM_THREADS for the native "
+                              "IO library's OpenMP loops"),
+    # -- gpu/memory knobs (no CUDA on this design) ---------------------------
+    "MXNET_GPU_WORKER_NTHREADS": (int, 2, "subsumed", "no CUDA streams"),
+    "MXNET_GPU_COPY_NTHREADS": (int, 2, "subsumed", "no CUDA copy engine"),
+    "MXNET_GPU_MEM_POOL_RESERVE": (int, 5, "subsumed",
+                                   "HBM is managed by PJRT; see "
+                                   "storage.memory_stats()"),
+    "MXNET_GPU_MEM_POOL_TYPE": (str, "Naive", "subsumed", "PJRT allocator"),
+    "MXNET_GPU_MEM_POOL_ROUND_LINEAR_CUTOFF": (int, 24, "subsumed", ""),
+    "MXNET_GPU_MEM_POOL_PAGE_SIZE": (int, 4096, "subsumed", ""),
+    "MXNET_ENABLE_GPU_P2P": (_BOOL, True, "subsumed",
+                             "ICI collectives are XLA-scheduled"),
+    # -- kvstore / distributed ----------------------------------------------
+    "MXNET_KVSTORE_REDUCTION_NTHREADS": (int, 4, "subsumed",
+                                         "reduce is one XLA collective"),
+    "MXNET_KVSTORE_BIGARRAY_BOUND": (int, 1000000, "honored",
+                                     "dist server round accounting "
+                                     "threshold (dist/server.py)"),
+    "MXNET_KVSTORE_USETREE": (_BOOL, False, "subsumed",
+                              "topology is XLA's concern on the torus"),
+    "MXNET_ENABLE_GPU_P2P_COMM": (_BOOL, True, "subsumed", ""),
+    # -- io ------------------------------------------------------------------
+    "MXNET_USE_NATIVE_IO": (_BOOL, True, "honored",
+                            "native.py: disables the C++ IO library"),
+    "MXNET_CUDNN_AUTOTUNE_DEFAULT": (int, 1, "subsumed", "no cuDNN"),
+    # -- model zoo / home ----------------------------------------------------
+    "MXNET_HOME": (str, os.path.join(os.path.expanduser("~"), ".mxnet"),
+                   "honored", "gluon model_zoo root directory"),
+    # -- profiling / debug ---------------------------------------------------
+    "MXNET_PROFILER_AUTOSTART": (_BOOL, False, "honored",
+                                 "profiler.py starts a jax trace at import"),
+    "MXNET_PROFILER_MODE": (int, 0, "accepted", ""),
+    "MXNET_EXEC_VERBOSE_LOGGING": (_BOOL, False, "accepted", ""),
+    "MXNET_SUBGRAPH_BACKEND": (str, "", "honored",
+                               "symbol.simple_bind partitions with the "
+                               "named subgraph property"),
+    "MXNET_SUBGRAPH_VERBOSE": (_BOOL, True, "accepted", ""),
+    "MXNET_SAFE_ACCUMULATION": (_BOOL, False, "honored",
+                                "fp32 accumulation for low-precision "
+                                "reductions (BatchNorm stats, optimizers "
+                                "with multi_precision)"),
+    # -- numerics ------------------------------------------------------------
+    "MXNET_FORCE_F32_MATMUL": (_BOOL, False, "honored",
+                               "sets jax default_matmul_precision=highest "
+                               "(full-fp32 MXU inputs; this framework's "
+                               "own knob)"),
+}
+
+_warned = set()
+
+
+def get(name, default=None):
+    """Read a knob with its registered parser; single read path."""
+    if name not in KNOBS:
+        raise KeyError(f"unknown config knob {name}; register it in "
+                       "config.KNOBS")
+    typ, reg_default, status, _ = KNOBS[name]
+    raw = os.environ.get(name)
+    if raw is None:
+        return default if default is not None else reg_default
+    if status == "subsumed" and name not in _warned:
+        _warned.add(name)
+        _LOG.debug("%s is set but subsumed by the XLA/TPU design; ignored",
+                   name)
+    try:
+        return typ(raw)
+    except (TypeError, ValueError):
+        _LOG.warning("could not parse %s=%r; using default", name, raw)
+        return default if default is not None else reg_default
+
+
+def warn_unknown():
+    """Flag MXNET_* env vars that match no registered knob (typo guard)."""
+    unknown = []
+    for key in os.environ:
+        if key.startswith("MXNET_") and key not in KNOBS \
+                and key not in _warned:
+            _warned.add(key)
+            unknown.append(key)
+            _LOG.warning("environment variable %s matches no known knob "
+                         "(typo? see config.KNOBS)", key)
+    return unknown
+
+
+def apply_startup_knobs():
+    """Knobs that act at import time."""
+    omp = get("MXNET_OMP_MAX_THREADS")
+    if omp:
+        os.environ.setdefault("OMP_NUM_THREADS", str(omp))
+    if get("MXNET_FORCE_F32_MATMUL"):
+        import jax
+        jax.config.update("jax_default_matmul_precision", "highest")
+    if get("MXNET_PROFILER_AUTOSTART"):
+        from . import profiler
+        try:
+            profiler.set_state("run")
+        except Exception:
+            pass
+    warn_unknown()

@@ -153,6 +153,12 @@ class _Exporter:
             return self.node("Flatten", in_names, name=nm, axis=1)
         if op == "Reshape":
             shape = [int(d) for d in p["shape"]]
+            if any(d < -1 for d in shape):
+                # MXNet's -2/-3/-4 split/merge codes have no ONNX meaning
+                raise MXNetError(
+                    f"onnx export: Reshape shape {tuple(shape)} uses MXNet "
+                    "special codes (<-1) that ONNX Reshape cannot express")
+            # 0 = copy-dim in both conventions (ONNX allowzero=0 default)
             return self.node("Reshape",
                              [in_names[0], self.const_i64(shape)], name=nm)
         if op == "transpose":

@@ -107,8 +107,10 @@ def import_model(model_file):
                 "num_filter": int(params[node.input[1]].shape[0]),
                 "no_bias": len(ins) < 3})
         elif op == "Gemm":
-            if a.get("transB", 0) != 1 or a.get("alpha", 1.0) != 1.0:
-                raise MXNetError("onnx import: general Gemm unsupported")
+            if a.get("transB", 0) != 1 or a.get("alpha", 1.0) != 1.0 \
+                    or a.get("beta", 1.0) != 1.0:
+                raise MXNetError("onnx import: general Gemm (alpha/beta/"
+                                 "transB beyond FC semantics) unsupported")
             out = _sym_apply("FullyConnected", ins, {
                 "num_hidden": int(params[node.input[1]].shape[0]),
                 "no_bias": len(ins) < 3, "flatten": False})

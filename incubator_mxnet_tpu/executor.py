@@ -97,6 +97,27 @@ class Executor:
             self._bwd_wrt_idx = wrt_idx
         return self._bwd_jit
 
+    def _store_grad(self, tgt, g, req):
+        """Write a gradient back honoring the grad array's OWN device
+        (group2ctx grads live with their parameters)."""
+        g = g.astype(tgt.dtype)
+        if tgt.context.jax_device != self._ctx.jax_device:
+            g = jax.device_put(g, tgt.context.jax_device)
+        tgt._data = (tgt._data + g) if req == "add" else g
+
+    def _gather_args(self, arrays):
+        """Array values for the jitted program, streaming any that reside
+        on another device (group2ctx parameter placement) onto the compute
+        ctx — one program, per-step transfers at the group boundary."""
+        dev = self._ctx.jax_device
+        out = []
+        for a in arrays:
+            v = a._data
+            if hasattr(v, "devices") and v.devices() != {dev}:
+                v = jax.device_put(v, dev)
+            out.append(v)
+        return tuple(out)
+
     # -- API -----------------------------------------------------------------
     def forward(self, is_train=False, **kwargs):
         """Run forward (reference `executor.py:114 forward` → `MXExecutorForward`)."""
@@ -113,8 +134,8 @@ class Executor:
         self._last_key = key
         self._last_is_train = is_train
         fwd = self._forward_jit(bool(is_train))
-        args = tuple(a._data for a in self.arg_arrays)
-        aux = tuple(a._data for a in self.aux_arrays)
+        args = self._gather_args(self.arg_arrays)
+        aux = self._gather_args(self.aux_arrays)
         outs, new_aux = fwd(args, aux, key)
         if is_train:
             for a, v in zip(self.aux_arrays, new_aux):
@@ -129,8 +150,8 @@ class Executor:
         """Run backward (reference `graph_executor.cc:76 Backward`): executes
         the combined forward+vjp XLA program with the stashed rng key."""
         run = self._backward_jit()
-        args = tuple(a._data for a in self.arg_arrays)
-        aux = tuple(a._data for a in self.aux_arrays)
+        args = self._gather_args(self.arg_arrays)
+        aux = self._gather_args(self.aux_arrays)
         key = self._last_key if self._last_key is not None else jax.random.PRNGKey(0)
         n_out = len(self._symbol._entries)
         if out_grads is None:
@@ -154,10 +175,7 @@ class Executor:
             if tgt is None:
                 continue
             name = self._symbol.list_arguments()[i]
-            if self._grad_req.get(name) == "add":
-                tgt._data = tgt._data + g.astype(tgt.dtype)
-            else:
-                tgt._data = g.astype(tgt.dtype)
+            self._store_grad(tgt, g, self._grad_req.get(name))
         return [NDArray(g, ctx=self._ctx) for g in grads]
 
     def forward_backward(self, out_grads=None, **kwargs):
@@ -172,8 +190,8 @@ class Executor:
         key = _random.next_key() if self._n_rng else jax.random.PRNGKey(0)
         self._last_key = key
         run = self._backward_jit()
-        args = tuple(a._data for a in self.arg_arrays)
-        aux = tuple(a._data for a in self.aux_arrays)
+        args = self._gather_args(self.arg_arrays)
+        aux = self._gather_args(self.aux_arrays)
         n_out = len(self._symbol._entries)
         fwd = self._forward_jit(True)
         outs_s, _ = jax.eval_shape(fwd, args, aux, key)
@@ -189,30 +207,31 @@ class Executor:
             tgt = self.grad_arrays[i]
             if tgt is None:
                 continue
-            if self._grad_req.get(arg_names[i]) == "add":
-                tgt._data = tgt._data + g.astype(tgt.dtype)
-            else:
-                tgt._data = g.astype(tgt.dtype)
+            self._store_grad(tgt, g, self._grad_req.get(arg_names[i]))
         self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
         return self.outputs
 
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params=False):
-        """Reference `executor.py copy_params_from`."""
-        dev = self._ctx.jax_device
+        """Reference `executor.py copy_params_from`.  Each array keeps ITS
+        OWN context: group2ctx-placed parameters stay on their group's
+        device (that residency is the point of the feature)."""
         for k, v in arg_params.items():
             if k in self.arg_dict:
+                tgt = self.arg_dict[k]
                 src = v._data if isinstance(v, NDArray) else jnp.asarray(v)
-                self.arg_dict[k]._data = jax.device_put(
-                    src.astype(self.arg_dict[k].dtype), dev)
+                tgt._data = jax.device_put(src.astype(tgt.dtype),
+                                           tgt.context.jax_device)
             elif not allow_extra_params:
                 raise MXNetError(f"Found name {k} not in arguments")
         if aux_params:
             for k, v in aux_params.items():
                 if k in self.aux_dict:
-                    src = v._data if isinstance(v, NDArray) else jnp.asarray(v)
-                    self.aux_dict[k]._data = jax.device_put(
-                        src.astype(self.aux_dict[k].dtype), dev)
+                    tgt = self.aux_dict[k]
+                    src = v._data if isinstance(v, NDArray) else \
+                        jnp.asarray(v)
+                    tgt._data = jax.device_put(src.astype(tgt.dtype),
+                                               tgt.context.jax_device)
                 elif not allow_extra_params:
                     raise MXNetError(f"Found name {k} not in aux states")
 
@@ -253,7 +272,8 @@ class Executor:
 
     # -- construction --------------------------------------------------------
     @staticmethod
-    def _simple_bind(symbol, ctx, grad_req, type_dict, shape_kwargs):
+    def _simple_bind(symbol, ctx, grad_req, type_dict, shape_kwargs,
+                     group2ctx=None):
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
         arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shape_kwargs)
@@ -261,10 +281,26 @@ class Executor:
             raise MXNetError("simple_bind: shape inference failed")
         type_dict = type_dict or {}
 
+        # reference group2ctx (`graph_executor.cc` ctx assignment from
+        # __ctx_group__ attrs): parameter arrays RESIDE on their group's
+        # device — the memory-placement half of legacy model parallelism.
+        # Compute still runs as one XLA program on the bound ctx (inputs
+        # stream in per step); per-group COMPUTE placement is the job of
+        # the sharding layer (`parallel.group2ctx_shardings` bridges this
+        # API to mesh shardings for true SPMD model parallel).
+        var_group = {}
+        if group2ctx:
+            for node in symbol._topo():
+                if node.is_variable:
+                    g = node._extra_attrs.get("__ctx_group__")
+                    if g is not None and g in group2ctx:
+                        var_group[node.name] = group2ctx[g]
+
         def make(shape, name):
             dt = np_dtype(type_dict.get(name, _np.float32))
-            return NDArray(jax.device_put(jnp.zeros(shape, dt), ctx.jax_device),
-                           ctx=ctx)
+            dev_ctx = var_group.get(name, ctx)
+            return NDArray(jax.device_put(jnp.zeros(shape, dt),
+                                          dev_ctx.jax_device), ctx=dev_ctx)
 
         args = [make(s, n) for n, s in zip(arg_names, arg_shapes)]
         if isinstance(grad_req, str):

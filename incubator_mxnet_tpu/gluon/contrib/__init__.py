@@ -1,2 +1,3 @@
 """`gluon.contrib` (reference `python/mxnet/gluon/contrib/`)."""
-from . import estimator  # noqa: F401
+from . import data, estimator, nn, rnn  # noqa: F401
+from .estimator import Estimator  # noqa: F401

@@ -1,25 +1,241 @@
-"""Minimal training-loop helper in the spirit of gluon.contrib."""
+"""Estimator: event-driven gluon training loop (reference
+`python/mxnet/gluon/contrib/estimator/estimator.py` + event_handler.py).
+
+The loop itself stays thin — forward/backward/step per batch — and every
+cross-cutting concern (logging, metric bookkeeping, checkpointing, early
+stopping) is an EventHandler hooked on train_begin/epoch_begin/
+batch_begin/batch_end/epoch_end/train_end, exactly the reference's
+architecture.
+"""
 from __future__ import annotations
+
+import logging
+import time
+
+from ...base import MXNetError
+
+__all__ = ["Estimator", "EventHandler", "LoggingHandler",
+           "CheckpointHandler", "EarlyStoppingHandler", "StopTraining"]
+
+
+class StopTraining(Exception):
+    """Raised by handlers (early stopping) to end fit() cleanly."""
+
+
+class EventHandler:
+    def train_begin(self, estimator):
+        pass
+
+    def epoch_begin(self, estimator):
+        pass
+
+    def batch_begin(self, estimator):
+        pass
+
+    def batch_end(self, estimator):
+        pass
+
+    def epoch_end(self, estimator):
+        pass
+
+    def train_end(self, estimator):
+        pass
+
+
+def _metric_items(metric):
+    names, vals = metric.get()
+    if not isinstance(names, list):
+        names, vals = [names], [vals]
+    return list(zip(names, vals))
+
+
+class LoggingHandler(EventHandler):
+    """Per-epoch (and optionally per-N-batches) metric logging
+    (reference `event_handler.py:LoggingHandler`)."""
+
+    def __init__(self, log_interval="epoch", logger=None):
+        self.log_interval = log_interval
+        self.logger = logger or logging.getLogger("Estimator")
+
+    def train_begin(self, est):
+        self._t0 = time.time()
+
+    def batch_end(self, est):
+        if self.log_interval == "epoch" or \
+                est.batch_idx % self.log_interval:
+            return
+        msg = " ".join(f"{n}={v:.6f}" for m in est.train_metrics
+                       for n, v in _metric_items(m))
+        self.logger.info("[epoch %d][batch %d] %s", est.epoch,
+                         est.batch_idx, msg)
+
+    def epoch_end(self, est):
+        parts = [f"train_{n}={v:.6f}" for m in est.train_metrics
+                 for n, v in _metric_items(m)]
+        parts += [f"val_{n}={v:.6f}" for m in est.val_metrics
+                  for n, v in _metric_items(m)]
+        self.logger.info("[epoch %d] %s time=%.1fs", est.epoch,
+                         " ".join(parts), time.time() - self._t0)
+
+
+class CheckpointHandler(EventHandler):
+    """Save parameters each epoch; keep the best by a monitored metric
+    (reference `event_handler.py:CheckpointHandler`)."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 mode="min", save_best=False):
+        import os
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.save_best = save_best
+        self.best = float("inf") if mode == "min" else -float("inf")
+        self.mode = mode
+        os.makedirs(model_dir, exist_ok=True)
+
+    def epoch_end(self, est):
+        import os
+        path = os.path.join(self.model_dir,
+                            f"{self.model_prefix}-epoch{est.epoch}.params")
+        est.net.save_parameters(path)
+        if self.save_best and self.monitor is not None:
+            val = _metric_value(est, self.monitor)
+            better = val < self.best if self.mode == "min" else \
+                val > self.best
+            if better:
+                self.best = val
+                est.net.save_parameters(os.path.join(
+                    self.model_dir, f"{self.model_prefix}-best.params"))
+
+
+class EarlyStoppingHandler(EventHandler):
+    """Stop when the monitored metric stops improving (reference
+    `event_handler.py:EarlyStoppingHandler`)."""
+
+    def __init__(self, monitor, mode="min", patience=3, min_delta=0.0):
+        self.monitor = monitor
+        self.mode = mode
+        self.patience = patience
+        self.min_delta = min_delta
+        self.best = float("inf") if mode == "min" else -float("inf")
+        self.waited = 0
+
+    def epoch_end(self, est):
+        val = _metric_value(est, self.monitor)
+        improved = (val < self.best - self.min_delta if self.mode == "min"
+                    else val > self.best + self.min_delta)
+        if improved:
+            self.best = val
+            self.waited = 0
+        else:
+            self.waited += 1
+            if self.waited >= self.patience:
+                raise StopTraining(
+                    f"early stop: {self.monitor} plateaued at {self.best}")
+
+
+def _metric_value(est, name):
+    # prefer validation, but a never-updated val metric (no val_data)
+    # reports nan and must not shadow the train metric of the same name
+    candidates = []
+    for m in list(est.val_metrics) + list(est.train_metrics):
+        for n, v in _metric_items(m):
+            if n == name:
+                candidates.append(v)
+    for v in candidates:
+        if v == v:                       # not nan
+            return v
+    if candidates:
+        return candidates[0]
+    raise MXNetError(f"EarlyStopping/Checkpoint: metric {name!r} not found")
 
 
 class Estimator:
-    """Simple fit loop over a Gluon net + loss + trainer."""
+    """Reference `estimator.py:Estimator` — fit with event handlers."""
 
-    def __init__(self, net, loss, trainer, metrics=None, context=None):
+    def __init__(self, net, loss, train_metrics=None, trainer=None,
+                 context=None):
+        from ... import metric as metric_mod
         self.net = net
         self.loss = loss
+        metrics = train_metrics if train_metrics is not None \
+            else [metric_mod.Accuracy()]
+        if not isinstance(metrics, (list, tuple)):
+            metrics = [metrics]
+        self.train_metrics = list(metrics)
+        self.val_metrics = [m.__class__() for m in self.train_metrics]
         self.trainer = trainer
-        self.metrics = metrics or []
         self.context = context
+        self.epoch = 0
+        self.batch_idx = 0
+        self._epochs_done = 0
 
-    def fit(self, train_data, epochs=1):
+    def _ctx(self):
+        if self.context is not None:
+            return self.context
+        try:
+            return next(iter(self.net.collect_params().values())) \
+                .list_ctx()[0]
+        except Exception:
+            return None
+
+    def _place(self, data, label):
+        """Batches land on the net's context (the reference estimator's
+        split_and_load step, single-device form)."""
+        ctx = self._ctx()
+        if ctx is not None:
+            if hasattr(data, "as_in_context"):
+                data = data.as_in_context(ctx)
+            if hasattr(label, "as_in_context"):
+                label = label.as_in_context(ctx)
+        return data, label
+
+    def evaluate(self, val_data):
+        for m in self.val_metrics:
+            m.reset()
+        for data, label in val_data:
+            data, label = self._place(data, label)
+            out = self.net(data)
+            for m in self.val_metrics:
+                m.update([label], [out])
+        return self.val_metrics
+
+    def fit(self, train_data, val_data=None, epochs=1, event_handlers=None):
         from ... import autograd
-        for _ in range(epochs):
-            for batch in train_data:
-                data, label = batch
-                with autograd.record():
-                    out = self.net(data)
-                    loss = self.loss(out, label)
-                loss.backward()
-                self.trainer.step(data.shape[0])
+        if self.trainer is None:
+            from ..trainer import Trainer
+            self.trainer = Trainer(self.net.collect_params(), "sgd",
+                                   {"learning_rate": 0.01})
+        handlers = list(event_handlers or [LoggingHandler()])
+        try:
+            for h in handlers:
+                h.train_begin(self)
+            for self.epoch in range(self._epochs_done,
+                                    self._epochs_done + epochs):
+                for m in self.train_metrics:
+                    m.reset()
+                for h in handlers:
+                    h.epoch_begin(self)
+                for self.batch_idx, (data, label) in enumerate(train_data):
+                    data, label = self._place(data, label)
+                    for h in handlers:
+                        h.batch_begin(self)
+                    with autograd.record():
+                        out = self.net(data)
+                        loss = self.loss(out, label)
+                    loss.backward()
+                    self.trainer.step(data.shape[0])
+                    for m in self.train_metrics:
+                        m.update([label], [out])
+                    for h in handlers:
+                        h.batch_end(self)
+                if val_data is not None:
+                    self.evaluate(val_data)
+                self._epochs_done = self.epoch + 1
+                for h in handlers:
+                    h.epoch_end(self)
+        except StopTraining as e:
+            logging.getLogger("Estimator").info(str(e))
+        for h in handlers:
+            h.train_end(self)
         return self

@@ -1,0 +1,126 @@
+"""Contrib layers (reference
+`python/mxnet/gluon/contrib/nn/basic_layers.py`)."""
+from __future__ import annotations
+
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential, Embedding, BatchNorm
+
+
+class Concurrent(Sequential):
+    """Run children on the same input, concat outputs
+    (reference `basic_layers.py:Concurrent`)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from .... import ndarray as nd
+        out = [block(x) for block in self._children.values()]
+        return nd.concat(*out, dim=self.axis)
+
+
+class HybridConcurrent(HybridSequential):
+    """Hybridizable Concurrent (reference `basic_layers.py:46`)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        out = [block(x) for block in self._children.values()]
+        return F.concat(*out, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Pass-through (reference `basic_layers.py:Identity`) — the skip
+    branch of a HybridConcurrent."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Block):
+    """API-compatible sparse-grad embedding (reference
+    `basic_layers.py:SparseEmbedding`).
+
+    Design note: on TPU the gradient of a gather is itself a fused XLA
+    scatter-add — there is no sparse row_sparse gradient tensor to
+    exploit, so this delegates to the dense Embedding (the sparse
+    STORAGE path stays host-side per the framework's sparse stance)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        self._embed = Embedding(input_dim, output_dim, dtype=dtype,
+                                weight_initializer=weight_initializer)
+        self.register_child(self._embed)
+
+    def forward(self, x):
+        return self._embed(x)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device BatchNorm (reference `basic_layers.py:SyncBatchNorm`).
+
+    Under this framework's data-parallel design the train step is ONE
+    SPMD program (`parallel.data_parallel_step`), so batch statistics are
+    computed over the device axis with an XLA `pmean` when run inside
+    `shard_map` — the separate NCCL sync pass of the reference
+    (`sync_batch_norm-inl.h`) has no equivalent to manage.  Outside an
+    SPMD region this is exactly BatchNorm.
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, **kwargs):
+        super().__init__(momentum=momentum, epsilon=epsilon,
+                         in_channels=in_channels, **kwargs)
+        self._num_devices = num_devices
+
+
+class _PixelShuffle(HybridBlock):
+    def __init__(self, factor, dims, **kwargs):
+        super().__init__(**kwargs)
+        self._factors = ((factor,) * dims if isinstance(factor, int)
+                         else tuple(factor))
+        assert len(self._factors) == dims
+
+    def hybrid_forward(self, F, x):
+        import numpy as _np
+        # implemented with reshape+transpose over the channel dim
+        # (reference contrib PixelShuffleND)
+        f = self._factors
+        if len(f) == 1:
+            x = F.reshape(x, shape=(0, -4, -1, f[0], 0))     # (N,C,f,W)
+            x = F.transpose(x, axes=(0, 1, 3, 2))
+            return F.reshape(x, shape=(0, 0, -3))
+        if len(f) == 2:
+            x = F.reshape(x, shape=(0, -4, -1, f[0] * f[1], 0, 0))
+            x = F.reshape(x, shape=(0, 0, -4, f[0], f[1], 0, 0))
+            x = F.transpose(x, axes=(0, 1, 4, 2, 5, 3))
+            return F.reshape(x, shape=(0, 0, -3, -3))
+        x = F.reshape(x, shape=(0, -4, -1, f[0] * f[1] * f[2], 0, 0, 0))
+        x = F.reshape(x, shape=(0, 0, -5, f[0], f[1], f[2], 0, 0, 0))
+        x = F.transpose(x, axes=(0, 1, 5, 2, 6, 3, 7, 4))
+        return F.reshape(x, shape=(0, 0, -3, -3, -3))
+
+
+class PixelShuffle1D(_PixelShuffle):
+    """(N, C*f, W) -> (N, C, W*f) (reference PixelShuffle1D)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 1, **kwargs)
+
+
+class PixelShuffle2D(_PixelShuffle):
+    """(N, C*f1*f2, H, W) -> (N, C, H*f1, W*f2)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 2, **kwargs)
+
+
+class PixelShuffle3D(_PixelShuffle):
+    """(N, C*f1*f2*f3, D, H, W) -> (N, C, D*f1, H*f2, W*f3)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 3, **kwargs)

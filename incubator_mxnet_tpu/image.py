@@ -370,10 +370,13 @@ class ImageRecordIterImpl(DataIter):
                  shuffle=False, rand_crop=False, rand_mirror=False,
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
                  std_b=1.0, resize=0, part_index=0, num_parts=1,
-                 preprocess_threads=4, prefetch_buffer=4, round_batch=True,
-                 data_name="data", label_name="softmax_label", seed=0,
-                 **kwargs):
+                 preprocess_threads=None, prefetch_buffer=4,
+                 round_batch=True, data_name="data",
+                 label_name="softmax_label", seed=0, **kwargs):
         super().__init__(batch_size)
+        if preprocess_threads is None:
+            from . import config as _config
+            preprocess_threads = _config.get("MXNET_CPU_WORKER_NTHREADS")
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
         self._shuffle = shuffle

@@ -106,3 +106,138 @@ def load_checkpoint(prefix, epoch):
         if tp == "aux":
             aux_params[name] = v
     return (symbol, arg_params, aux_params)
+
+
+class FeedForward:
+    """Legacy training API (reference `model.py:451 FeedForward`) — kept for
+    scripts predating Module; internally an adapter over `mod.Module`,
+    which owns the jit-compiled executor group."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        from . import initializer as init_mod
+        from .context import cpu
+        self.symbol = symbol
+        self.ctx = ctx if ctx is not None else [cpu()]
+        if not isinstance(self.ctx, (list, tuple)):
+            self.ctx = [self.ctx]
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.optimizer = optimizer
+        self.initializer = initializer if initializer is not None \
+            else init_mod.Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self.kwargs = dict(kwargs)
+        self._module = None
+
+    # -- internals -------------------------------------------------------------
+    def _label_names(self):
+        # classic convention: the symbol's label argument(s) end in
+        # "_label" (reference model.py label handling)
+        return [n for n in self.symbol.list_arguments()
+                if n.endswith("_label")]
+
+    def _as_iter(self, X, y=None, shuffle=False):
+        from .io import DataIter, NDArrayIter
+        if isinstance(X, DataIter):
+            return X
+        labels = self._label_names()
+        return NDArrayIter(X, y, batch_size=self.numpy_batch_size,
+                           shuffle=shuffle,
+                           label_name=labels[0] if labels
+                           else "softmax_label")
+
+    def _build_module(self, data_iter):
+        from .module import Module
+        data_names = [d.name for d in data_iter.provide_data]
+        self._module = Module(self.symbol, data_names=tuple(data_names),
+                              label_names=tuple(self._label_names()),
+                              context=self.ctx)
+        return self._module
+
+    # -- API -------------------------------------------------------------------
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        train = self._as_iter(X, y, shuffle=True)
+        if eval_data is not None and not hasattr(eval_data, "provide_data"):
+            eval_data = self._as_iter(eval_data[0], eval_data[1])
+        mod = self._build_module(train)
+        opt_params = dict(self.kwargs)
+        mod.fit(train, eval_data=eval_data, eval_metric=eval_metric,
+                epoch_end_callback=epoch_end_callback,
+                batch_end_callback=batch_end_callback, kvstore=kvstore,
+                optimizer=self.optimizer, optimizer_params=opt_params,
+                initializer=self.initializer,
+                arg_params=self.arg_params, aux_params=self.aux_params,
+                allow_missing=self.arg_params is not None,
+                begin_epoch=self.begin_epoch,
+                num_epoch=self.num_epoch or 1)
+        self.arg_params, self.aux_params = mod.get_params()
+        return self
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        import numpy as _np
+        data = self._as_iter(X)
+        if reset:
+            data.reset()
+        if self._module is None or not self._module.binded:
+            mod = self._build_module(data)
+            mod.bind(data_shapes=data.provide_data, for_training=False)
+            # allow_missing: a loss symbol's label variable has no param
+            # entry at inference (predict mode ignores it)
+            mod.set_params(self.arg_params or {}, self.aux_params or {},
+                           allow_missing=True)
+        outs = []
+        for i, batch in enumerate(data):
+            if num_batch is not None and i >= num_batch:
+                break
+            self._module.forward(batch, is_train=False)
+            out = self._module.get_outputs()[0].asnumpy()
+            if batch.pad:
+                out = out[: out.shape[0] - batch.pad]
+            outs.append(out)
+        return _np.concatenate(outs, axis=0)
+
+    def score(self, X, eval_metric="acc", num_batch=None, **kwargs):
+        from . import metric as metric_mod
+        data = self._as_iter(X)
+        if self._module is None or not self._module.binded:
+            mod = self._build_module(data)
+            mod.bind(data_shapes=data.provide_data,
+                     label_shapes=data.provide_label, for_training=False)
+            mod.set_params(self.arg_params or {}, self.aux_params or {},
+                           allow_missing=True)
+        metric = metric_mod.create(eval_metric)
+        res = self._module.score(data, metric, num_batch=num_batch)
+        return dict(res).popitem()[1]
+
+    def save(self, prefix, epoch=None):
+        epoch = epoch if epoch is not None else (self.num_epoch or 0)
+        save_checkpoint(prefix, epoch, self.symbol, self.arg_params or {},
+                        self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch,
+                           **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None, **kwargs):
+        """Reference `model.py create`: construct AND fit."""
+        fit_kwargs = {k: kwargs.pop(k) for k in
+                      ("eval_data", "eval_metric", "epoch_end_callback",
+                       "batch_end_callback", "kvstore", "logger")
+                      if k in kwargs}
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch, **kwargs)
+        model.fit(X, y, **fit_kwargs)
+        return model

@@ -57,3 +57,30 @@ def shard_params(params, mesh, rules, name_fn=None):
         data = arr._data if hasattr(arr, "_data") else arr
         out[name] = jax.device_put(data, sharding)
     return out
+
+
+def group2ctx_shardings(symbol, group2axis, mesh):
+    """Bridge legacy `group2ctx` model parallelism to mesh shardings.
+
+    The reference pins each ctx_group's parameters to a device
+    (`executor.py group2ctx`); the TPU-native equivalent shards or pins
+    them over mesh axes.  group2axis maps group name -> PartitionSpec
+    (or axis name, sharded on dim 0).  Returns {var_name: NamedSharding}
+    for every __ctx_group__-annotated variable, ready for
+    `jax.device_put` / `jit(in_shardings=...)`.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    out = {}
+    for node in symbol._topo():
+        if not node.is_variable:
+            continue
+        g = node._extra_attrs.get("__ctx_group__")
+        if g is None or g not in group2axis:
+            continue
+        spec = group2axis[g]
+        if isinstance(spec, str):
+            spec = P(spec)
+        out[node.name] = NamedSharding(mesh, spec)
+    return out

@@ -260,7 +260,8 @@ class Symbol:
             # the graph at bind time
             from ..subgraph import partition_graph
             sym = partition_graph(self, backend)
-        return Executor._simple_bind(sym, ctx, grad_req, type_dict, kwargs)
+        return Executor._simple_bind(sym, ctx, grad_req, type_dict, kwargs,
+                                     group2ctx=group2ctx)
 
     def bind(self, ctx, args, args_grad=None, grad_req="write", aux_states=None,
              group2ctx=None, shared_exec=None):
@@ -438,6 +439,10 @@ def _sym_apply(op_name, inputs, kwargs):
             vnode = _Node(None, f"{name}_{slot}", {}, [])
             entries.append((vnode, 0))
     node = _Node(op, name, params, entries)
+    from ..attribute import current_attrs
+    scope_attrs = current_attrs()
+    if scope_attrs:
+        node._extra_attrs.update(scope_attrs)
     if attr:
         node._extra_attrs.update(attr)
     nout = node.num_outputs()
@@ -461,6 +466,8 @@ def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
     if not isinstance(name, str):
         raise TypeError("Expect a string for variable name")
     node = _Node(None, name, {}, [])
+    from ..attribute import current_attrs
+    node._extra_attrs.update(current_attrs())
     if shape is not None:
         node._extra_attrs["__shape__"] = tuple(shape)
     if dtype is not None:

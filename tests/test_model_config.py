@@ -1,0 +1,103 @@
+"""FeedForward legacy API, AttrScope/group2ctx, config knobs (reference
+model.py:451, attribute.py, docs/faq/env_var.md)."""
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.model import FeedForward
+
+
+def _net():
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=3, name="fc2")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    X = rng.randn(96, 8).astype("f4")
+    W = rng.randn(8, 3).astype("f4")
+    y = (X @ W).argmax(1).astype("f4")
+    return X, y
+
+
+def test_feedforward_fit_predict_save_load(tmp_path):
+    X, y = _data()
+    model = FeedForward(_net(), ctx=mx.cpu(), num_epoch=12,
+                        optimizer="sgd", learning_rate=0.5,
+                        rescale_grad=1.0 / 32, numpy_batch_size=32)
+    model.fit(X, y)
+    preds = model.predict(X)
+    acc = (preds.argmax(1) == y).mean()
+    assert acc > 0.8, acc
+    # classic create() one-shot
+    m2 = FeedForward.create(_net(), X, y, ctx=mx.cpu(), num_epoch=5,
+                            learning_rate=0.5, rescale_grad=1.0 / 32)
+    assert m2.arg_params
+
+    prefix = str(tmp_path / "ff")
+    model.save(prefix, 12)
+    loaded = FeedForward.load(prefix, 12, ctx=mx.cpu())
+    preds2 = loaded.predict(X)
+    np.testing.assert_allclose(preds2, preds, rtol=1e-5, atol=1e-6)
+
+
+def test_attr_scope_and_group2ctx():
+    with mx.AttrScope(ctx_group="embed", lr_mult=2.0):
+        data = mx.sym.Variable("data")
+        w = mx.sym.Variable("w")
+    out = mx.sym.FullyConnected(data, w, no_bias=True, num_hidden=4,
+                                name="fc")
+    node = [n for n in out._topo() if n.name == "w"][0]
+    assert node._extra_attrs["__ctx_group__"] == "embed"
+    assert node._extra_attrs["__lr_mult__"] == "2.0"
+
+    # group2ctx places the group's params on the mapped device
+    import jax
+    exe = out.simple_bind(ctx=mx.cpu(0), group2ctx={"embed": mx.cpu(1)},
+                          data=(2, 6))
+    assert exe.arg_dict["w"].context.device_id == 1
+    assert exe.arg_dict["data"].context.device_id == 1  # also in scope
+    res = exe.forward(data=nd.array(np.ones((2, 6), "f4")))
+    assert res[0].shape == (2, 4)
+
+
+def test_group2ctx_shardings_bridge():
+    from incubator_mxnet_tpu import parallel as par
+    from jax.sharding import PartitionSpec as P
+    with mx.AttrScope(ctx_group="tp_group"):
+        w = mx.sym.Variable("w")
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, w, no_bias=True, num_hidden=8)
+    import jax
+    mesh = par.make_mesh({"tp": 4}, devices=jax.devices()[:4])
+    from incubator_mxnet_tpu.parallel.tensor_parallel import \
+        group2ctx_shardings
+    sh = group2ctx_shardings(out, {"tp_group": "tp"}, mesh)
+    assert set(sh) == {"w"}
+    assert sh["w"].spec == P("tp")
+
+
+def test_config_knobs():
+    from incubator_mxnet_tpu import config
+    assert config.get("MXNET_CPU_WORKER_NTHREADS") >= 1
+    os.environ["MXNET_CPU_WORKER_NTHREADS"] = "7"
+    try:
+        assert config.get("MXNET_CPU_WORKER_NTHREADS") == 7
+    finally:
+        del os.environ["MXNET_CPU_WORKER_NTHREADS"]
+    with pytest.raises(KeyError):
+        config.get("MXNET_NO_SUCH_KNOB")
+    os.environ["MXNET_TYPO_KNOB"] = "1"
+    try:
+        assert "MXNET_TYPO_KNOB" in config.warn_unknown()
+    finally:
+        del os.environ["MXNET_TYPO_KNOB"]
+    # every documented knob has an explicit status
+    for name, (typ, default, status, note) in config.KNOBS.items():
+        assert status in ("honored", "subsumed", "accepted"), name
