@@ -44,7 +44,7 @@ for _mod_name, _aliases in [
     ("model", ()), ("profiler", ()), ("visualization", ("viz",)),
     ("parallel", ()), ("test_utils", ()), ("image", ()), ("operator", ()),
     ("contrib", ()), ("rnn", ()), ("compat", ()), ("dist", ()),
-    ("subgraph", ()),
+    ("subgraph", ()), ("storage", ()),
     ("native", ()),
 ]:
     try:

@@ -170,12 +170,12 @@ class Executor:
             ogs = tuple(jnp.ones(o.shape, o.dtype) if g is None else g
                         for g, o in zip(ogs, outs))
         outs, grads, new_aux = run(args, aux, key, ogs)
+        arg_names = self._symbol.list_arguments()
         for i, g in zip(self._bwd_wrt_idx, grads):
             tgt = self.grad_arrays[i]
             if tgt is None:
                 continue
-            name = self._symbol.list_arguments()[i]
-            self._store_grad(tgt, g, self._grad_req.get(name))
+            self._store_grad(tgt, g, self._grad_req.get(arg_names[i]))
         return [NDArray(g, ctx=self._ctx) for g in grads]
 
     def forward_backward(self, out_grads=None, **kwargs):

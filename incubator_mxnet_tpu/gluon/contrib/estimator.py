@@ -163,7 +163,10 @@ class Estimator:
         if not isinstance(metrics, (list, tuple)):
             metrics = [metrics]
         self.train_metrics = list(metrics)
-        self.val_metrics = [m.__class__() for m in self.train_metrics]
+        import copy
+        self.val_metrics = [copy.deepcopy(m) for m in self.train_metrics]
+        for m in self.val_metrics:
+            m.reset()
         self.trainer = trainer
         self.context = context
         self.epoch = 0

@@ -99,8 +99,11 @@ class _PixelShuffle(HybridBlock):
             x = F.reshape(x, shape=(0, 0, -4, f[0], f[1], 0, 0))
             x = F.transpose(x, axes=(0, 1, 4, 2, 5, 3))
             return F.reshape(x, shape=(0, 0, -3, -3))
+        # -4 splits one dim into two; chain three splits to factor the
+        # channel dim into (C, f1, f2, f3)
         x = F.reshape(x, shape=(0, -4, -1, f[0] * f[1] * f[2], 0, 0, 0))
-        x = F.reshape(x, shape=(0, 0, -5, f[0], f[1], f[2], 0, 0, 0))
+        x = F.reshape(x, shape=(0, 0, -4, f[0], f[1] * f[2], 0, 0, 0))
+        x = F.reshape(x, shape=(0, 0, 0, -4, f[1], f[2], 0, 0, 0))
         x = F.transpose(x, axes=(0, 1, 5, 2, 6, 3, 7, 4))
         return F.reshape(x, shape=(0, 0, -3, -3, -3))
 

@@ -450,8 +450,10 @@ class ImageRecordIterImpl(DataIter):
 
     def _build_batch(self, bidx):
         import cv2
+        from .storage import default_pool
         c, h, w = self.data_shape
-        data = np.empty((self.batch_size, c, h, w), dtype="float32")
+        pool = default_pool()
+        data = pool.acquire((self.batch_size, c, h, w), "float32")
         label = np.zeros((self.batch_size, self.label_width),
                          dtype="float32")
         nat = _native.lib()
@@ -511,9 +513,13 @@ class ImageRecordIterImpl(DataIter):
             label[i, :min(len(lab), self.label_width)] = \
                 lab[:self.label_width]
         label_out = label[:, 0] if self.label_width == 1 else label
-        return DataBatch(data=[array(data)], label=[array(label_out)],
-                         pad=pad, provide_data=self.provide_data,
-                         provide_label=self.provide_label)
+        batch = DataBatch(data=[array(data)], label=[array(label_out)],
+                          pad=pad, provide_data=self.provide_data,
+                          provide_label=self.provide_label)
+        # array() takes a private copy (nd.array copy semantics), so the
+        # staging buffer recycles immediately
+        pool.release(data)
+        return batch
 
     def next(self):
         batch = self._pool.next()

@@ -534,7 +534,12 @@ def array(source_array, ctx=None, dtype=None):
                          dtype=np_dtype(dtype) if dtype is not None else _np.float32)
     # put the host buffer straight onto the target device: routing through
     # jnp.asarray first would land it on the DEFAULT device (the TPU) and
-    # then copy back — a full round trip over the chip link for cpu arrays
+    # then copy back — a full round trip over the chip link for cpu arrays.
+    # CPU targets: device_put ZERO-COPIES matching-dtype numpy buffers, but
+    # mx.nd.array promises copy semantics (the caller may mutate or recycle
+    # its buffer) — take a private copy when jax would alias
+    if ctx.jax_device.platform == "cpu" and np_arr is source_array:
+        np_arr = np_arr.copy()
     return NDArray(jax.device_put(np_arr, ctx.jax_device), ctx=ctx)
 
 

@@ -1,0 +1,131 @@
+"""Storage management (reference `src/storage/storage.cc`,
+`pooled_storage_manager.h`).
+
+What remains of the reference's storage layer on this design, honestly:
+
+* **Device (HBM) memory** is owned by PJRT — XLA's buffer assignment and
+  the PJRT allocator replace `GPUPooledStorageManager` outright.  What
+  the framework owes users is VISIBILITY, not another allocator:
+  `memory_stats()` surfaces the PJRT per-device counters the reference
+  exposed via `mx.context.gpu_memory_info`.
+* **Host staging buffers** are the part still worth pooling: the input
+  pipeline materializes one large float32 batch per step, and repeated
+  malloc/free of tens-of-MB numpy buffers costs real time on the host.
+  `HostStagingPool` recycles them by rounded size class, the same
+  strategy as the reference's pooled manager
+  (`pooled_storage_manager.h` round-to-bucket), applied where it still
+  pays on TPU: between JPEG decode and `device_put`.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+__all__ = ["HostStagingPool", "default_pool", "memory_stats",
+           "device_memory_info"]
+
+
+class HostStagingPool:
+    """Size-class pool of host numpy buffers.
+
+    acquire(shape, dtype) -> array backed by a pooled buffer;
+    release(arr) returns the backing buffer.  Buffers round up to the
+    next power-of-two byte size (the reference's bucket rounding), so a
+    few classes serve all batch shapes.  Thread-safe; bounded.
+    """
+
+    def __init__(self, max_bytes=1 << 30):
+        self._free = {}                 # rounded nbytes -> [np buffers]
+        self._lock = threading.Lock()
+        self._max_bytes = max_bytes
+        self._held = 0
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _round(nbytes):
+        return 1 << max(12, int(np.ceil(np.log2(max(1, nbytes)))))
+
+    def acquire(self, shape, dtype=np.float32):
+        dtype = np.dtype(dtype)
+        need = int(np.prod(shape)) * dtype.itemsize
+        size = self._round(need)
+        with self._lock:
+            bucket = self._free.get(size)
+            if bucket:
+                raw = bucket.pop()
+                self._held -= size
+                self.hits += 1
+            else:
+                raw = None
+                self.misses += 1
+        if raw is None:
+            raw = np.empty(size, np.uint8)
+        # the returned view keeps `raw` alive via .base; release() walks
+        # the base chain back to the pooled buffer
+        return raw[:need].view(dtype).reshape(shape)
+
+    def release(self, arr):
+        base = arr.base if arr.base is not None else arr
+        raw = base
+        while raw.base is not None:
+            raw = raw.base
+        if raw.dtype != np.uint8 or raw.ndim != 1:
+            return False                # not one of ours
+        size = raw.nbytes
+        if size & (size - 1):
+            return False
+        with self._lock:
+            if self._held + size > self._max_bytes:
+                return False            # pool full: let gc take it
+            self._free.setdefault(size, []).append(raw)
+            self._held += size
+        return True
+
+    def stats(self):
+        with self._lock:
+            return {"held_bytes": self._held, "hits": self.hits,
+                    "misses": self.misses,
+                    "buckets": {k: len(v) for k, v in self._free.items()}}
+
+    def clear(self):
+        with self._lock:
+            self._free.clear()
+            self._held = 0
+
+
+_default = None
+_default_lock = threading.Lock()
+
+
+def default_pool():
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                _default = HostStagingPool()
+    return _default
+
+
+def memory_stats(ctx=None):
+    """PJRT per-device memory counters (the `gpu_memory_info` role).
+
+    Returns dict with at least bytes_in_use/peak_bytes_in_use when the
+    backend reports them (TPU does; CPU returns {}).
+    """
+    from .context import current_context
+    ctx = ctx or current_context()
+    dev = ctx.jax_device
+    try:
+        return dict(dev.memory_stats() or {})
+    except Exception:
+        return {}
+
+
+def device_memory_info(ctx=None):
+    """(free, total) bytes, reference `mx.context.gpu_memory_info`."""
+    stats = memory_stats(ctx)
+    total = stats.get("bytes_limit", 0)
+    used = stats.get("bytes_in_use", 0)
+    return (total - used, total)

@@ -433,14 +433,17 @@ def _sym_apply(op_name, inputs, kwargs):
     # auto-create variables for missing trailing inputs (weights, biases, aux
     # states) — the reference does this in Symbol composition, producing the
     # canonical `{name}_weight` / `{name}_moving_mean` argument names
+    from ..attribute import current_attrs
+    scope_attrs = current_attrs()
     slot_names = op.list_input_names(params)
     if slot_names is not None and len(entries) < len(slot_names):
         for slot in slot_names[len(entries):]:
             vnode = _Node(None, f"{name}_{slot}", {}, [])
+            # auto-created parameters inherit the scope (ctx_group,
+            # lr_mult, ...) like explicitly declared Variables do
+            vnode._extra_attrs.update(scope_attrs)
             entries.append((vnode, 0))
     node = _Node(op, name, params, entries)
-    from ..attribute import current_attrs
-    scope_attrs = current_attrs()
     if scope_attrs:
         node._extra_attrs.update(scope_attrs)
     if attr:

@@ -1,0 +1,56 @@
+"""Failure-propagation semantics (reference exception_handling docs +
+tests/python/unittest/test_exc_handling.py): errors surface at wait
+points, failed ops don't poison subsequent work."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, autograd
+
+
+def test_bad_shapes_raise_promptly():
+    a = nd.ones((2, 3))
+    w = nd.ones((4, 5))
+    with pytest.raises(Exception):
+        out = nd.FullyConnected(a, w, nd.zeros((4,)), num_hidden=4)
+        out.asnumpy()          # wait point at the latest
+
+
+def test_unknown_op_and_param_errors_name_the_problem():
+    with pytest.raises(mx.MXNetError, match="not registered"):
+        nd.imperative_invoke("NoSuchOperator", nd.ones((2,)))
+    with pytest.raises(mx.MXNetError, match="bogus"):
+        nd.FullyConnected(nd.ones((2, 3)), num_hidden=4, bogus=1)
+
+
+def test_engine_recovers_after_failure():
+    """A failed op must not wedge the engine: subsequent work succeeds
+    (the reference's exception-propagation guarantee)."""
+    a = nd.ones((2, 3))
+    with pytest.raises(Exception):
+        nd.dot(a, nd.ones((7, 2))).asnumpy()
+    # engine still serves new work
+    out = nd.dot(a, nd.ones((3, 2)))
+    mx.engine.waitall()
+    np.testing.assert_allclose(out.asnumpy(), 3.0)
+
+
+def test_failure_inside_record_scope_keeps_autograd_usable():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with pytest.raises(Exception):
+        with autograd.record():
+            y = nd.dot(x.reshape((1, 2)), x.reshape((1, 2)))  # bad shapes
+            y.backward()
+    with autograd.record():
+        z = (x * x).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_symbolic_bind_failure_names_op():
+    data = mx.sym.Variable("data")
+    out = mx.sym.Reshape(data, shape=(7, 9))   # infeasible for input below
+    with pytest.raises(mx.MXNetError):
+        exe = out.simple_bind(ctx=mx.cpu(), data=(2, 3))
+        exe.forward(data=nd.ones((2, 3)))

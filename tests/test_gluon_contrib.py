@@ -146,3 +146,29 @@ def test_estimator_with_handlers(tmp_path):
     assert est.epoch == 2
     import os
     assert os.path.exists(str(tmp_path / "model-epoch0.params"))
+
+
+def test_pixel_shuffle_1d_3d():
+    ps1 = contrib.nn.PixelShuffle1D(2)
+    x1 = nd.array(np.arange(2 * 4 * 3, dtype="f4").reshape(2, 4, 3))
+    out1 = ps1(x1)
+    assert out1.shape == (2, 2, 6)
+    xn = x1.asnumpy().reshape(2, 2, 2, 3)
+    ref1 = xn.transpose(0, 1, 3, 2).reshape(2, 2, 6)
+    np.testing.assert_array_equal(out1.asnumpy(), ref1)
+
+    ps3 = contrib.nn.PixelShuffle3D((2, 2, 2))
+    x3 = nd.array(np.arange(1 * 8 * 2 * 2 * 2, dtype="f4")
+                  .reshape(1, 8, 2, 2, 2))
+    out3 = ps3(x3)
+    assert out3.shape == (1, 1, 4, 4, 4)
+    xn3 = x3.asnumpy().reshape(1, 1, 2, 2, 2, 2, 2, 2)
+    ref3 = xn3.transpose(0, 1, 5, 2, 6, 3, 7, 4).reshape(1, 1, 4, 4, 4)
+    np.testing.assert_array_equal(out3.asnumpy(), ref3)
+
+
+def test_estimator_metric_with_args():
+    from incubator_mxnet_tpu.gluon.contrib.estimator import Estimator
+    est = Estimator(gluon.nn.Dense(3), gluon.loss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=mx.metric.TopKAccuracy(top_k=5))
+    assert est.val_metrics[0].get()[0] == est.train_metrics[0].get()[0]

@@ -101,3 +101,15 @@ def test_config_knobs():
     # every documented knob has an explicit status
     for name, (typ, default, status, note) in config.KNOBS.items():
         assert status in ("honored", "subsumed", "accepted"), name
+
+
+def test_group2ctx_covers_auto_created_params():
+    with mx.AttrScope(ctx_group="dev1"):
+        fc = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                   name="fc")
+    node = [n for n in fc._topo() if n.name == "fc_weight"][0]
+    assert node._extra_attrs.get("__ctx_group__") == "dev1"
+    exe = fc.simple_bind(ctx=mx.cpu(0), group2ctx={"dev1": mx.cpu(1)},
+                         data=(2, 6))
+    assert exe.arg_dict["fc_weight"].context.device_id == 1
+    assert exe.arg_dict["fc_bias"].context.device_id == 1

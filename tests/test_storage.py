@@ -1,0 +1,61 @@
+"""Storage-manager tests (reference tests for storage.cc pooling)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.storage import (HostStagingPool, default_pool,
+                                         memory_stats, device_memory_info)
+
+
+def test_pool_recycles_buffers():
+    pool = HostStagingPool()
+    a = pool.acquire((16, 3, 32, 32), "float32")
+    assert a.shape == (16, 3, 32, 32) and a.dtype == np.float32
+    a[:] = 1.5
+    assert pool.release(a)
+    b = pool.acquire((16, 3, 32, 32), "float32")
+    s = pool.stats()
+    assert s["hits"] == 1 and s["misses"] == 1
+    # different shape, same size class also reuses
+    assert pool.release(b)
+    c = pool.acquire((3, 16, 32, 32), "float32")
+    assert pool.stats()["hits"] == 2
+
+
+def test_pool_size_classes_and_bound():
+    pool = HostStagingPool(max_bytes=1 << 16)
+    small = pool.acquire((10,), "float32")
+    assert pool.release(small)
+    big = pool.acquire((1 << 16,), "float32")   # 256 KiB > bound
+    assert not pool.release(big)                # pool refuses, gc takes it
+    assert pool.stats()["held_bytes"] <= 1 << 16
+    # foreign arrays are refused, not corrupted
+    assert not pool.release(np.zeros((4, 4), "float64"))
+
+
+def test_record_iter_uses_pool(tmp_path):
+    import cv2
+    from incubator_mxnet_tpu import recordio
+    from incubator_mxnet_tpu.image import ImageRecordIterImpl
+    rng = np.random.RandomState(0)
+    rec = recordio.MXRecordIO(str(tmp_path / "p.rec"), "w")
+    for i in range(20):
+        ok, enc = cv2.imencode(".png", rng.randint(0, 255, (32, 32, 3),
+                                                   np.uint8))
+        rec.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                                enc.tobytes()))
+    rec.close()
+    pool = default_pool()
+    hits0 = pool.hits
+    it = ImageRecordIterImpl(path_imgrec=str(tmp_path / "p.rec"),
+                             data_shape=(3, 32, 32), batch_size=5,
+                             preprocess_threads=1)
+    n = sum(b.data[0].shape[0] for b in it)
+    assert n == 20
+    assert pool.hits > hits0            # later batches reused buffers
+
+
+def test_memory_stats_shapes():
+    stats = memory_stats(mx.cpu())
+    assert isinstance(stats, dict)
+    free, total = device_memory_info(mx.cpu())
+    assert free <= total
