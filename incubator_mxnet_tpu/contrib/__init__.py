@@ -1,3 +1,15 @@
 """`mx.contrib` (reference `python/mxnet/contrib/`)."""
 from . import quantization  # noqa: F401
+from . import svrg_optimization  # noqa: F401
+from . import tensorboard   # noqa: F401
 from . import text          # noqa: F401
+
+
+def __getattr__(name):
+    # onnx pulls in the protobuf bindings; load on first touch
+    if name == "onnx":
+        import importlib
+        mod = importlib.import_module(__name__ + ".onnx")
+        globals()["onnx"] = mod
+        return mod
+    raise AttributeError(name)

@@ -1,0 +1,67 @@
+"""Training-metric logging bridge (reference
+`python/mxnet/contrib/tensorboard.py`: LogMetricsCallback).
+
+The reference forwards eval metrics to a TensorBoard SummaryWriter.  The
+same callback shape is kept; the sink degrades gracefully:
+
+* `tensorboardX`/`torch.utils.tensorboard` present -> real event files
+* otherwise -> newline-delimited JSON (`events.jsonl`) in the logging
+  dir — trivially greppable/plottable, and convertible later.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+__all__ = ["LogMetricsCallback"]
+
+
+class _JsonlWriter:
+    def __init__(self, logging_dir):
+        os.makedirs(logging_dir, exist_ok=True)
+        self._f = open(os.path.join(logging_dir, "events.jsonl"), "a")
+
+    def add_scalar(self, tag, value, global_step=None):
+        self._f.write(json.dumps({"tag": tag, "value": float(value),
+                                  "step": global_step}) + "\n")
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+
+def _make_writer(logging_dir):
+    for mod, cls in (("tensorboardX", "SummaryWriter"),
+                     ("torch.utils.tensorboard", "SummaryWriter")):
+        try:
+            import importlib
+            m = importlib.import_module(mod)
+            return getattr(m, cls)(logging_dir)
+        except Exception:
+            continue
+    return _JsonlWriter(logging_dir)
+
+
+class LogMetricsCallback:
+    """Batch-end callback pushing eval metrics to the writer
+    (reference `tensorboard.py:LogMetricsCallback`)."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.step = 0
+        self._writer = _make_writer(logging_dir)
+
+    def __call__(self, param):
+        self.step += 1
+        if param.eval_metric is None:
+            return
+        names, values = param.eval_metric.get()
+        if not isinstance(names, list):
+            names, values = [names], [values]
+        for name, value in zip(names, values):
+            if self.prefix is not None:
+                name = f"{self.prefix}-{name}"
+            self._writer.add_scalar(name, value, self.step)
+
+    def close(self):
+        self._writer.close()

@@ -109,7 +109,9 @@ class Augmenter:
 
     def dumps(self):
         import json
-        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs],
+                          default=lambda o: o.tolist()
+                          if hasattr(o, "tolist") else str(o))
 
     def __call__(self, src):
         raise NotImplementedError
@@ -513,11 +515,15 @@ class ImageRecordIterImpl(DataIter):
             label[i, :min(len(lab), self.label_width)] = \
                 lab[:self.label_width]
         label_out = label[:, 0] if self.label_width == 1 else label
-        batch = DataBatch(data=[array(data)], label=[array(label_out)],
+        batch_nd = array(data)
+        batch = DataBatch(data=[batch_nd], label=[array(label_out)],
                           pad=pad, provide_data=self.provide_data,
                           provide_label=self.provide_label)
-        # array() takes a private copy (nd.array copy semantics), so the
-        # staging buffer recycles immediately
+        # cpu targets: array() took a private copy, recycle immediately.
+        # accelerator targets: device_put reads the host buffer
+        # asynchronously — wait for the transfer before recycling.
+        if batch_nd.context.jax_device.platform != "cpu":
+            batch_nd._data.block_until_ready()
         pool.release(data)
         return batch
 
@@ -628,3 +634,10 @@ def _index_records(buf):
         out.append((pos + 8, length))
         pos += 8 + length + (4 - length % 4) % 4
     return out
+
+
+# detection pipeline shares this namespace in the reference (mx.image.*)
+from .image_detection import (DetAugmenter, DetBorrowAug,   # noqa: E402
+                              DetRandomSelectAug, DetHorizontalFlipAug,
+                              DetRandomCropAug, DetRandomPadAug,
+                              CreateDetAugmenter, ImageDetIter)

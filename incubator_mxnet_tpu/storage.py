@@ -67,8 +67,7 @@ class HostStagingPool:
         return raw[:need].view(dtype).reshape(shape)
 
     def release(self, arr):
-        base = arr.base if arr.base is not None else arr
-        raw = base
+        raw = arr
         while raw.base is not None:
             raw = raw.base
         if raw.dtype != np.uint8 or raw.ndim != 1:
@@ -79,7 +78,10 @@ class HostStagingPool:
         with self._lock:
             if self._held + size > self._max_bytes:
                 return False            # pool full: let gc take it
-            self._free.setdefault(size, []).append(raw)
+            bucket = self._free.setdefault(size, [])
+            if any(r is raw for r in bucket):
+                return False            # double release: keep one copy
+            bucket.append(raw)
             self._held += size
         return True
 
@@ -124,8 +126,11 @@ def memory_stats(ctx=None):
 
 
 def device_memory_info(ctx=None):
-    """(free, total) bytes, reference `mx.context.gpu_memory_info`."""
+    """(free, total) bytes, reference `mx.context.gpu_memory_info`.
+    (0, 0) when the backend reports no capacity figure."""
     stats = memory_stats(ctx)
     total = stats.get("bytes_limit", 0)
     used = stats.get("bytes_in_use", 0)
-    return (total - used, total)
+    if not total:
+        return (0, 0)
+    return (max(0, total - used), total)

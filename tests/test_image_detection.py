@@ -1,0 +1,92 @@
+"""Detection augmenter tests (reference
+tests/python/unittest/test_image.py det section)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.image import (DetHorizontalFlipAug,
+                                       DetRandomCropAug, DetRandomPadAug,
+                                       CreateDetAugmenter, ImageDetIter)
+from incubator_mxnet_tpu.ndarray.ndarray import array
+
+
+def _sample():
+    rng = np.random.RandomState(0)
+    img = array(rng.randint(0, 255, (60, 80, 3), np.uint8), dtype="uint8")
+    label = np.full((4, 5), -1.0, np.float32)
+    label[0] = [1, 0.25, 0.25, 0.75, 0.75]
+    label[1] = [0, 0.10, 0.10, 0.30, 0.40]
+    return img, label
+
+
+def test_flip_moves_boxes():
+    img, label = _sample()
+    aug = DetHorizontalFlipAug(p=1.0)
+    out, lab = aug(img, label)
+    np.testing.assert_array_equal(out.asnumpy(), img.asnumpy()[:, ::-1])
+    np.testing.assert_allclose(lab[0, [1, 3]], [0.25, 0.75], atol=1e-6)
+    np.testing.assert_allclose(lab[1, [1, 3]], [0.70, 0.90], atol=1e-6)
+    assert (lab[2:, 0] == -1).all()
+
+
+def test_random_crop_clips_boxes():
+    img, label = _sample()
+    aug = DetRandomCropAug(min_object_covered=0.5, area_range=(0.3, 0.8))
+    found_smaller = False
+    for _ in range(10):
+        out, lab = aug(img, label)
+        valid = lab[lab[:, 0] >= 0]
+        assert len(valid) >= 1             # coverage constraint held
+        assert (valid[:, 1:5] >= -1e-6).all()
+        assert (valid[:, 1:5] <= 1 + 1e-6).all()
+        if out.shape != img.shape:
+            found_smaller = True
+    assert found_smaller
+
+
+def test_random_pad_shrinks_boxes():
+    img, label = _sample()
+    aug = DetRandomPadAug(area_range=(2.0, 2.5))
+    out, lab = aug(img, label)
+    assert out.shape[0] >= img.shape[0] and out.shape[1] >= img.shape[1]
+    v = lab[lab[:, 0] >= 0]
+    orig = label[label[:, 0] >= 0]
+    assert ((v[:, 3] - v[:, 1]) <= (orig[:, 3] - orig[:, 1]) + 1e-6).all()
+
+
+def test_image_det_iter(tmp_path):
+    import cv2
+    from incubator_mxnet_tpu import recordio
+    rng = np.random.RandomState(1)
+    rec = recordio.MXRecordIO(str(tmp_path / "det.rec"), "w")
+    for i in range(12):
+        img = rng.randint(0, 255, (48, 48, 3), np.uint8)
+        ok, enc = cv2.imencode(".png", img)
+        label = np.array([i % 3, 0.2, 0.2, 0.8, 0.8], np.float32)
+        rec.write(recordio.pack(
+            recordio.IRHeader(0, label, i, 0), enc.tobytes()))
+    rec.close()
+    it = ImageDetIter(batch_size=4, data_shape=(3, 32, 32),
+                      path_imgrec=str(tmp_path / "det.rec"),
+                      rand_mirror=True, max_objects=3)
+    n = 0
+    for batch in it:
+        assert batch.data[0].shape == (4, 3, 32, 32)
+        assert batch.label[0].shape == (4, 3, 5)
+        lab = batch.label[0].asnumpy()
+        valid = lab[..., 0] >= 0
+        assert valid.any()
+        n += 4 - batch.pad
+    assert n == 12
+
+
+def test_create_det_augmenter_pipeline():
+    img, label = _sample()
+    augs = CreateDetAugmenter((3, 32, 32), rand_crop=0.5, rand_mirror=True,
+                              rand_pad=0.5, mean=True, std=True)
+    out, lab = img, label
+    for aug in augs:
+        out, lab = aug(out, lab)
+    assert out.shape == (32, 32, 3)
+    assert out.dtype == np.float32

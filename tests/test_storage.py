@@ -59,3 +59,16 @@ def test_memory_stats_shapes():
     assert isinstance(stats, dict)
     free, total = device_memory_info(mx.cpu())
     assert free <= total
+
+
+def test_pool_double_release_guard():
+    pool = HostStagingPool()
+    a = pool.acquire((64,), "float32")
+    assert pool.release(a)
+    assert not pool.release(a)          # second release refused
+    b = pool.acquire((64,), "float32")
+    c = pool.acquire((64,), "float32")
+    # b and c must not alias
+    b[:] = 1.0
+    c[:] = 2.0
+    assert b[0] == 1.0 and c[0] == 2.0

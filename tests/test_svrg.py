@@ -1,0 +1,52 @@
+"""SVRG tests (reference tests/python/unittest/test_contrib_svrg_module.py
+strategy: converges, and the variance-reduced gradient at the snapshot
+equals the plain gradient)."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.contrib.svrg_optimization import SVRGModule
+
+
+def _problem():
+    rng = np.random.RandomState(0)
+    X = rng.randn(128, 6).astype("f4")
+    W = rng.randn(6, 1).astype("f4")
+    Y = (X @ W + 0.05 * rng.randn(128, 1)).astype("f4")
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, num_hidden=1, name="fc")
+    out = mx.sym.LinearRegressionOutput(out, name="lro")
+    it = mx.io.NDArrayIter(X, Y, batch_size=32, label_name="lro_label")
+    return out, it, X, Y
+
+
+def test_svrg_converges():
+    sym, it, X, Y = _problem()
+    mod = SVRGModule(sym, label_names=("lro_label",), update_freq=2,
+                     context=mx.cpu())
+    mod.fit(it, num_epoch=25, eval_metric="mse", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.3,
+                              "rescale_grad": 1.0 / 32})
+    it.reset()
+    score = dict(mod.score(it, mx.metric.MSE()))["mse"]
+    assert score < 0.05, score
+
+
+def test_svrg_estimator_unbiased_at_snapshot():
+    """Right after a snapshot, g - g_snap + mu == mu + 0 when evaluated at
+    w == w_snap with the same batch: the correction must vanish."""
+    sym, it, X, Y = _problem()
+    mod = SVRGModule(sym, label_names=("lro_label",), update_freq=1,
+                     context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=True)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.0),))
+    mod._take_snapshot(it)
+    it.reset()
+    batch = next(iter(it))
+    mod.forward_backward(batch)
+    live = {k: g.asnumpy().copy() for k, g in mod._live_grads().items()}
+    snap = {k: g.asnumpy() for k, g in mod._grad_at_snapshot(batch).items()}
+    for k in live:
+        np.testing.assert_allclose(live[k], snap[k], rtol=1e-5, atol=1e-6)

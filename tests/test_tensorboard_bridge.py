@@ -1,0 +1,26 @@
+"""LogMetricsCallback bridge test (reference contrib/tensorboard.py)."""
+import json
+import os
+
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.contrib.tensorboard import LogMetricsCallback
+
+
+def test_log_metrics_callback(tmp_path):
+    cb = LogMetricsCallback(str(tmp_path), prefix="train")
+    metric = mx.metric.Accuracy()
+    metric.update([mx.nd.array([1.0, 0.0])],
+                  [mx.nd.array([[0.1, 0.9], [0.8, 0.2]])])
+    from incubator_mxnet_tpu.model import BatchEndParam
+    for i in range(3):
+        cb(BatchEndParam(epoch=0, nbatch=i, eval_metric=metric, locals=None))
+    cb.close()
+    events = [json.loads(l) for l in
+              open(tmp_path / "events.jsonl")] if \
+        (tmp_path / "events.jsonl").exists() else None
+    if events is not None:              # jsonl fallback path
+        assert len(events) == 3
+        assert events[0]["tag"] == "train-accuracy"
+        assert events[0]["value"] == 1.0
