@@ -56,6 +56,8 @@ class SVRGModule(Module):
                 for k, g in grads.items():
                     sums[k] += g
             n_batches += 1
+        if not n_batches:
+            raise MXNetError("SVRG snapshot: train_data yielded no batches")
         self._mu = {k: v / float(n_batches) for k, v in sums.items()}
         train_data.reset()
 
@@ -73,13 +75,18 @@ class SVRGModule(Module):
     # -- training loop --------------------------------------------------------
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             num_epoch=None, optimizer="sgd", optimizer_params=None,
-            initializer=None, kvstore="local",
+            initializer=None, kvstore=None,
             batch_end_callback=None, epoch_end_callback=None,
             validation_metric=None, **kwargs):
         from .. import metric as metric_mod
         from .. import initializer as init_mod
+        from ..model import BatchEndParam
         if num_epoch is None:
             raise MXNetError("num_epoch required")
+        if kvstore not in (None, "local"):
+            raise MXNetError("SVRGModule is single-context (matching the "
+                             "reference module's constraint); kvstore is "
+                             "not supported")
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label, for_training=True)
         self.init_params(initializer or init_mod.Uniform(0.01))
@@ -87,6 +94,10 @@ class SVRGModule(Module):
                             optimizer_params=optimizer_params or
                             (("learning_rate", 0.01),))
         metric = metric_mod.create(eval_metric)
+        val_metric = (metric_mod.create(validation_metric)
+                      if validation_metric is not None else
+                      metric_mod.create(eval_metric))
+        log = logging.getLogger("SVRGModule")
         for epoch in range(num_epoch):
             if epoch % self.update_freq == 0:
                 self._take_snapshot(train_data)
@@ -94,19 +105,34 @@ class SVRGModule(Module):
             train_data.reset()
             for nbatch, batch in enumerate(train_data):
                 self.forward_backward(batch)
-                live_grads = list(self._live_grads().items())
+                # snapshot the LIVE gradients and outputs by value: the
+                # snapshot pass below reuses the same executor buffers
+                live_vals = {k: g.copyto(g.context)
+                             for k, g in self._live_grads().items()}
+                live_outputs = [o.copyto(o.context)
+                                for o in self.get_outputs()]
                 snap_grads = self._grad_at_snapshot(batch)
-                # g <- g - g_snap + mu  (in place on the live grad arrays)
-                for k, g in live_grads:
-                    corr = g - snap_grads[k] + self._mu[k]
+                # g <- g_live - g_snap + mu, written into the live arrays
+                for k, g in self._live_grads().items():
+                    corr = live_vals[k] - snap_grads[k] + self._mu[k]
                     g._set_data(corr._data)
                 self.update()
-                self.update_metric(metric, batch.label)
-            logging.getLogger("SVRGModule").info(
-                "Epoch[%d] %s", epoch,
-                " ".join(f"{n}={v:.6f}" for n, v in
-                         zip(*[x if isinstance(x, list) else [x]
-                               for x in metric.get()])))
+                metric.update(batch.label, live_outputs)
+                if batch_end_callback is not None:
+                    cbs = batch_end_callback if isinstance(
+                        batch_end_callback, (list, tuple)) else \
+                        [batch_end_callback]
+                    for cb in cbs:
+                        cb(BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                         eval_metric=metric, locals=None))
+            log.info("Epoch[%d] %s", epoch,
+                     " ".join(f"{n}={v:.6f}" for n, v in
+                              zip(*[x if isinstance(x, list) else [x]
+                                    for x in metric.get()])))
+            if eval_data is not None:
+                res = self.score(eval_data, val_metric)
+                log.info("Epoch[%d] validation %s", epoch,
+                         " ".join(f"{n}={v:.6f}" for n, v in res))
             if epoch_end_callback:
                 epoch_end_callback(epoch, self._symbol, *self.get_params())
         return self

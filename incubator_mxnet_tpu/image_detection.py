@@ -11,7 +11,7 @@ import random as _pyrandom
 import numpy as np
 
 from .base import MXNetError
-from .image import (Augmenter, CreateAugmenter, imdecode, _resize_np)
+from .image import Augmenter, imdecode
 from .io import DataIter, DataBatch, DataDesc
 from .ndarray.ndarray import NDArray, array
 
@@ -263,15 +263,26 @@ class ImageDetIter(DataIter):
         self._iter.reset()
 
     def _parse_label(self, raw):
-        """Accepts flat [extra_header..., cls,x1,y1,x2,y2, ...] rows
-        (reference `detection.py _parse_label` format: [A, B, ...])."""
+        """Reference `detection.py _parse_label` convention: the label is
+        [A, B, header..., objects...] where A = header width (counting A
+        and B themselves), B = object record width >= 5; objects begin at
+        raw[A:].  A flat multiple-of-5 list with no plausible header is
+        accepted as bare [cls,x1,y1,x2,y2] rows for convenience."""
         raw = np.asarray(raw, np.float32).ravel()
-        if raw.size % 5 == 0:
-            obj = raw.reshape(-1, 5)
-        else:
-            header = int(raw[0])          # header width, then object width
+        obj = None
+        if raw.size >= 2:
+            header = int(raw[0])
             width = int(raw[1])
-            obj = raw[2 + header:].reshape(-1, width)[:, :5]
+            if (2 <= header <= raw.size and width >= 5
+                    and float(header) == raw[0] and float(width) == raw[1]
+                    and (raw.size - header) % width == 0):
+                obj = raw[header:].reshape(-1, width)[:, :5]
+        if obj is None:
+            if raw.size % 5:
+                raise MXNetError(
+                    f"ImageDetIter: cannot parse label of size {raw.size} "
+                    "(neither [A,B,header...,objects...] nor flat 5-wide)")
+            obj = raw.reshape(-1, 5)
         out = np.full((self.max_objects, 5), -1.0, np.float32)
         n = min(len(obj), self.max_objects)
         out[:n] = obj[:n]

@@ -451,7 +451,19 @@ def invoke(op, data, kwargs, out=None):
         from .. import random as _random
         in_arrays = in_arrays + [_random.next_key()]
 
-    results = _reg.eager_call(op, params, in_arrays)
+    from .. import profiler as _profiler
+    if _profiler._imperative_active():
+        # honest per-op timing requires waiting out async dispatch; only
+        # paid while the profiler runs (reference profile_imperative)
+        import time as _time
+        import jax as _jax
+        t0 = _time.perf_counter()
+        results = _reg.eager_call(op, params, in_arrays)
+        _jax.block_until_ready(results)
+        _profiler.record_op(op.name,
+                            (_time.perf_counter() - t0) * 1e6)
+    else:
+        results = _reg.eager_call(op, params, in_arrays)
     n_out = op.num_outputs(params)
     vis, aux_updates = results[:n_out], results[n_out:]
 

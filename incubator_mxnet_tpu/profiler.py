@@ -95,6 +95,20 @@ def _emit(event):
         _custom_events.append(event)
 
 
+def _imperative_active():
+    """True when eager ops should be timed (reference
+    `profile_imperative` config, `MXSetProcessProfilerConfig`)."""
+    return _state["running"] and (_config.get("profile_imperative", True)
+                                  or _config.get("profile_all", False))
+
+
+def record_op(name, dur_us):
+    """Record one eager operator execution (feeds the per-op aggregate
+    table, reference `profiler.cc` ProfileOperator)."""
+    _emit({"name": name, "cat": "operator", "ph": "X",
+           "dur": float(dur_us), "ts": 0, "pid": 0, "tid": 0})
+
+
 class _Named:
     def __init__(self, name):
         self.name = name

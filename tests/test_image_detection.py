@@ -90,3 +90,20 @@ def test_create_det_augmenter_pipeline():
         out, lab = aug(out, lab)
     assert out.shape == (32, 32, 3)
     assert out.dtype == np.float32
+
+
+def test_parse_label_header_format():
+    it = ImageDetIter.__new__(ImageDetIter)
+    it.max_objects = 3
+    # reference header convention: [A=4, B=6, extra, extra, objects...]
+    raw = np.array([4, 6, 9.9, 9.9,
+                    1, 0.1, 0.2, 0.3, 0.4, 0.0,
+                    2, 0.5, 0.5, 0.9, 0.9, 0.0], np.float32)
+    out = it._parse_label(raw)
+    np.testing.assert_allclose(out[0], [1, 0.1, 0.2, 0.3, 0.4])
+    np.testing.assert_allclose(out[1], [2, 0.5, 0.5, 0.9, 0.9])
+    assert out[2, 0] == -1
+    # flat rows still accepted
+    flat = np.array([0, 0.1, 0.1, 0.2, 0.2], np.float32)
+    out2 = it._parse_label(flat)
+    np.testing.assert_allclose(out2[0], flat)

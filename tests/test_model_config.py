@@ -113,3 +113,20 @@ def test_group2ctx_covers_auto_created_params():
                          data=(2, 6))
     assert exe.arg_dict["fc_weight"].context.device_id == 1
     assert exe.arg_dict["fc_bias"].context.device_id == 1
+
+
+def test_profiler_per_op_stats(tmp_path):
+    from incubator_mxnet_tpu import profiler, nd
+    profiler.set_config(filename=str(tmp_path / "p.json"),
+                        profile_imperative=True)
+    profiler.set_state("run")
+    try:
+        a = nd.ones((8, 8))
+        b = nd.dot(a, a)
+        c = nd.relu(b)
+        c.asnumpy()
+    finally:
+        profiler.set_state("stop")
+    table = profiler.dumps(reset=True)
+    assert "dot" in table and "count=" in table
+    assert "relu" in table or "Activation" in table

@@ -50,3 +50,30 @@ def test_svrg_estimator_unbiased_at_snapshot():
     snap = {k: g.asnumpy() for k, g in mod._grad_at_snapshot(batch).items()}
     for k in live:
         np.testing.assert_allclose(live[k], snap[k], rtol=1e-5, atol=1e-6)
+
+
+def test_svrg_correction_is_not_plain_mu_after_update():
+    """After one optimizer step away from the snapshot, g_live != g_snap,
+    so the written gradient must differ from mu (guards against the
+    aliasing bug where the live grads were read AFTER being overwritten
+    by the snapshot pass)."""
+    sym, it, X, Y = _problem()
+    mod = SVRGModule(sym, label_names=("lro_label",), update_freq=1,
+                     context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=True)
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.05),))
+    mod._take_snapshot(it)
+    it.reset()
+    batches = list(it)
+    # one real step moves w away from w_snap
+    mod.forward_backward(batches[0])
+    mod.update()
+    mod.forward_backward(batches[1])
+    live = {k: g.copyto(g.context) for k, g in mod._live_grads().items()}
+    snap = mod._grad_at_snapshot(batches[1])
+    diff = sum(float(np.abs((live[k] - snap[k]).asnumpy()).sum())
+               for k in live)
+    assert diff > 1e-4, "live and snapshot grads identical: aliasing bug"
