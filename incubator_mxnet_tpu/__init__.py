@@ -44,7 +44,8 @@ for _mod_name, _aliases in [
     ("model", ()), ("profiler", ()), ("visualization", ("viz",)),
     ("parallel", ()), ("test_utils", ()), ("image", ()), ("operator", ()),
     ("contrib", ()), ("rnn", ()), ("compat", ()), ("dist", ()),
-    ("subgraph", ()), ("storage", ()),
+    ("subgraph", ()), ("storage", ()), ("libinfo", ()),
+    ("kvstore_server", ()),
     ("native", ()),
 ]:
     try:

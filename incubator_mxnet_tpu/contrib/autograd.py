@@ -1,0 +1,35 @@
+"""Legacy contrib autograd surface (reference
+`python/mxnet/contrib/autograd.py` — the pre-1.0 API kept for old
+scripts).  Thin aliases over the first-class `mx.autograd`."""
+from __future__ import annotations
+
+from ..autograd import (backward, grad, is_recording as _is_recording,
+                        mark_variables, pause, record,
+                        set_recording as _set_recording)
+
+__all__ = ["set_is_training", "train_section", "test_section",
+           "mark_variables", "backward", "grad", "compute_gradient"]
+
+
+def set_is_training(is_train):
+    """Reference `contrib/autograd.py set_is_training`."""
+    from .. import autograd as ag
+    prev_r = ag.set_recording(is_train)
+    prev_t = ag.set_training(is_train)
+    return prev_r
+
+
+def train_section():
+    """Old name for `autograd.record()`."""
+    return record(train_mode=True)
+
+
+def test_section():
+    """Old name for `autograd.pause()`."""
+    return pause(train_mode=False)
+
+
+def compute_gradient(outputs):
+    """Reference `contrib/autograd.py compute_gradient`."""
+    backward(outputs)
+    return [getattr(o, "grad", None) for o in outputs]

@@ -1,0 +1,42 @@
+"""contrib IO adapters (reference `python/mxnet/contrib/io.py`)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io import DataIter, DataBatch, DataDesc
+
+__all__ = ["DataLoaderIter"]
+
+
+class DataLoaderIter(DataIter):
+    """Wrap a gluon DataLoader as a classic DataIter
+    (reference `contrib/io.py:25 DataLoaderIter`): lets Module.fit train
+    from gluon datasets."""
+
+    def __init__(self, loader, data_name="data", label_name="softmax_label"):
+        self._loader = loader
+        self._iter = iter(loader)
+        self.data_name = data_name
+        self.label_name = label_name
+        first = next(self._iter)
+        self._first = first
+        data, label = first
+        super().__init__(batch_size=data.shape[0])
+        self.provide_data = [DataDesc(data_name, tuple(data.shape),
+                                      np.dtype(data.dtype))]
+        self.provide_label = [DataDesc(label_name, tuple(label.shape),
+                                       np.dtype(label.dtype))]
+
+    def reset(self):
+        self._iter = iter(self._loader)
+        self._first = None
+
+    def next(self):
+        if self._first is not None:
+            data, label = self._first
+            self._first = None
+        else:
+            data, label = next(self._iter)
+        return DataBatch(data=[data], label=[label], pad=0,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

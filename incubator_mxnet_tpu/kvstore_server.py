@@ -1,0 +1,41 @@
+"""Server-process bootstrap (reference `python/mxnet/kvstore_server.py`).
+
+The reference blocks inside `KVStoreServer.run()` when DMLC_ROLE=server;
+the same surface is provided over the dist parameter server.  Normal
+usage never touches this module — `kvstore.create('dist_*')` already
+becomes the server in a server-role process.
+"""
+from __future__ import annotations
+
+import os
+
+from .base import MXNetError
+
+__all__ = ["KVStoreServer"]
+
+
+class KVStoreServer:
+    """Reference `kvstore_server.py:KVStoreServer`."""
+
+    def __init__(self, kvstore=None):
+        self.kvstore = kvstore
+        self.init_logging = False
+
+    def run(self):
+        """Serve until every worker has sent its stop command
+        (reference `KVStoreServer.run:64`)."""
+        if os.environ.get("DMLC_ROLE") not in ("server", None):
+            raise MXNetError("KVStoreServer.run: DMLC_ROLE is not 'server'")
+        from .dist.server import ParameterServer
+        ParameterServer(
+            host=os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
+            port=int(os.environ.get("DMLC_PS_ROOT_PORT", 9091)),
+        ).serve_forever()
+
+
+def _init_kvstore_server_module():
+    """Reference module-level hook: server-role processes never return."""
+    if os.environ.get("DMLC_ROLE") == "server":
+        import sys
+        KVStoreServer().run()
+        sys.exit(0)

@@ -1,0 +1,44 @@
+"""Library/build information (reference `python/mxnet/libinfo.py`).
+
+The reference locates libmxnet.so; here the "library" is the JAX/XLA
+runtime plus the optional native IO extension, so this reports what is
+actually loadable.
+"""
+from __future__ import annotations
+
+import os
+
+__version__ = "0.1.0"
+
+
+def find_lib_path():
+    """Paths of loadable native components (reference `find_lib_path`).
+
+    Returns the native IO library when built; empty list otherwise (the
+    compute path needs no framework .so — XLA executables are produced
+    at trace time).
+    """
+    from . import native
+    if native.lib() is not None:
+        return [native._LIB_PATH]
+    return []
+
+
+def find_include_path():
+    """Reference `find_include_path`: headers for native extensions."""
+    src = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "src")
+    return src if os.path.isdir(src) else ""
+
+
+def features():
+    """Runtime feature flags (the role of `libinfo.cc` feature list)."""
+    import jax
+    from . import native
+    from .context import num_tpus
+    return {
+        "TPU": num_tpus() > 0,
+        "NATIVE_IO": native.lib() is not None,
+        "JAX_VERSION": jax.__version__,
+        "BACKENDS": sorted({d.platform for d in jax.devices()}),
+    }

@@ -130,3 +130,32 @@ def test_profiler_per_op_stats(tmp_path):
     table = profiler.dumps(reset=True)
     assert "dot" in table and "count=" in table
     assert "relu" in table or "Activation" in table
+
+
+def test_libinfo_and_contrib_shims():
+    from incubator_mxnet_tpu import libinfo
+    feats = libinfo.features()
+    assert "BACKENDS" in feats and isinstance(libinfo.find_lib_path(), list)
+
+    # contrib.io.DataLoaderIter feeds Module from a gluon DataLoader
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.contrib.io import DataLoaderIter
+    rng = np.random.RandomState(0)
+    X = nd.array(rng.randn(64, 6).astype("f4"))
+    Y = nd.array(rng.randint(0, 3, 64).astype("f4"))
+    loader = gluon.data.DataLoader(gluon.data.ArrayDataset(X, Y),
+                                   batch_size=16)
+    it = DataLoaderIter(loader)
+    n = sum(b.data[0].shape[0] for b in it)
+    assert n == 64
+    it.reset()
+    assert next(iter(it)).data[0].shape == (16, 6)
+
+    # contrib.autograd legacy surface
+    from incubator_mxnet_tpu.contrib import autograd as old_ag
+    x = nd.array([2.0])
+    x.attach_grad()
+    with old_ag.train_section():
+        y = x * x
+    old_ag.backward([y])
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])
