@@ -75,14 +75,15 @@ class ParameterServer:
 
         try:
             self._server = Server((host, port), Handler)
-        except OSError:
-            if not host:
-                raise
-            # multi-homed host: the root URI names this machine as workers
-            # see it, which may not be locally bindable — fall back to all
-            # interfaces only then (the transport is unauthenticated pickle,
-            # ps-lite's trust model: never widen the bind surface by default)
-            self._server = Server(("", port), Handler)
+        except OSError as e:
+            # never silently widen the bind surface: the transport carries
+            # pickle, so binding all interfaces on a multi-homed host would
+            # expose code execution to anything that can reach the port
+            raise OSError(
+                f"parameter server cannot bind {host}:{port} ({e}). Set "
+                "DMLC_PS_ROOT_URI to an address bindable on this machine "
+                "(e.g. the host's private interface IP), or 0.0.0.0 "
+                "explicitly if you really mean all interfaces.") from e
         self.port = self._server.server_address[1]
         self._thread = None
 

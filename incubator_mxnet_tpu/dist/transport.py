@@ -8,27 +8,43 @@ over ZMQ; here a message is one length-prefixed frame on a TCP stream:
 The payload is a small header dict plus raw ndarray bytes, serialized with
 pickle protocol 5 (out-of-band buffers keep large arrays as single
 memoryview copies — the practical equivalent of ps-lite's zero-copy SArray
-for a localhost/DCN transport).  The channel is trusted (same security
-model as ps-lite: the training cluster is a private network).
+for a localhost/DCN transport).  The channel assumes a private cluster
+network (ps-lite's trust model), but because pickle deserialization is
+code execution, setting ``MXNET_PS_HMAC_KEY`` (same value on every node)
+adds an HMAC-SHA256 tag over the pickle frame that is verified BEFORE
+deserialization — a cheap authentication fence for shared networks.
 """
 from __future__ import annotations
 
+import hashlib
+import hmac as _hmac
+import os
 import pickle
 import socket
 import struct
 
 _LEN = struct.Struct(">Q")
+_TAG_LEN = 32
+
+
+def _hmac_key():
+    k = os.environ.get("MXNET_PS_HMAC_KEY", "")
+    return k.encode() if k else None
 
 
 def send_msg(sock: socket.socket, obj) -> None:
     buffers = []
     payload = pickle.dumps(obj, protocol=5, buffer_callback=buffers.append)
     raws = [b.raw() for b in buffers]
-    # frame: payload length, out-of-band buffer count, payload, then each
-    # buffer prefixed with its own length
+    # frame: payload length, out-of-band buffer count, payload,
+    # [HMAC tag over payload when keyed], then each buffer prefixed with
+    # its own length
     sock.sendall(_LEN.pack(len(payload)))
     sock.sendall(_LEN.pack(len(raws)))
     sock.sendall(payload)
+    key = _hmac_key()
+    if key is not None:
+        sock.sendall(_hmac.new(key, payload, hashlib.sha256).digest())
     for r in raws:
         sock.sendall(_LEN.pack(len(r)))
         sock.sendall(r)
@@ -49,6 +65,14 @@ def recv_msg(sock: socket.socket):
     plen = _LEN.unpack(_recv_exact(sock, 8))[0]
     nbuf = _LEN.unpack(_recv_exact(sock, 8))[0]
     payload = _recv_exact(sock, plen)
+    key = _hmac_key()
+    if key is not None:
+        tag = _recv_exact(sock, _TAG_LEN)
+        want = _hmac.new(key, payload, hashlib.sha256).digest()
+        if not _hmac.compare_digest(tag, want):
+            raise ConnectionError(
+                "transport: HMAC verification failed — peer does not hold "
+                "MXNET_PS_HMAC_KEY; refusing to deserialize")
     bufs = []
     for _ in range(nbuf):
         blen = _LEN.unpack(_recv_exact(sock, 8))[0]

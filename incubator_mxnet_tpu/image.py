@@ -467,9 +467,9 @@ class ImageRecordIterImpl(DataIter):
         rng = np.random.RandomState(
             (self._seed * 1000003 + self._epoch * 8191 + bidx) % (2**31))
         for i in range(self.batch_size):
-            off, length = self._records[self._order[(base + i) % n_rec]]
+            segs = self._records[self._order[(base + i) % n_rec]]
             header, payload = _recordio.unpack(
-                self._buf[off:off + length])
+                _record_payload(self._buf, segs))
             img = cv2.imdecode(np.frombuffer(payload, np.uint8),
                                cv2.IMREAD_COLOR)  # BGR HWC
             if img is None:
@@ -603,37 +603,88 @@ class _BatchPool:
             t.join(timeout=5)
 
 
+def _group_parts(parts):
+    """Group (offset, length, cflag) physical parts into logical records:
+    cflag 0 stands alone; 1/2*/3 sequences form one multi-part record
+    (dmlc writers split payloads containing the magic word; see
+    `recordio.MXRecordIO.read`)."""
+    records = []
+    pending = None
+    for off, ln, cf in parts:
+        if cf == 0:
+            if pending is not None:
+                raise MXNetError("RecordIO: truncated multi-part record")
+            records.append([(off, ln)])
+        elif cf == 1:
+            if pending is not None:
+                raise MXNetError("RecordIO: nested multi-part record start")
+            pending = [(off, ln)]
+        elif cf in (2, 3):
+            if pending is None:
+                raise MXNetError(
+                    f"RecordIO: continuation flag {cf} without a start part")
+            pending.append((off, ln))
+            if cf == 3:
+                records.append(pending)
+                pending = None
+        else:
+            raise MXNetError(f"RecordIO: invalid cflag {cf}")
+    if pending is not None:
+        raise MXNetError("RecordIO: truncated multi-part record at EOF")
+    return records
+
+
+_REC_MAGIC = __import__("struct").pack("<I", 0xced7230a)
+
+
+def _record_payload(buf, segments):
+    """Payload bytes of one logical record: single-part records slice
+    straight from the mapped file; multi-part records are re-joined with
+    the magic word the writer dropped at each split."""
+    if len(segments) == 1:
+        off, ln = segments[0]
+        return buf[off:off + ln]
+    return _REC_MAGIC.join(bytes(buf[off:off + ln]) for off, ln in segments)
+
+
 def _index_records(buf):
-    """Offsets+lengths of every record payload — native scan when the
-    library is built, struct-walk fallback otherwise."""
+    """Segment lists of every logical record payload — native scan when
+    the library is built, struct-walk fallback otherwise.  Each entry is a
+    list of (offset, length) parts; pass to `_record_payload`."""
     nat = _native.lib()
+    parts = None
     if nat is not None:
         cap = max(1024, len(buf) // 12)
         offs = np.empty(cap, dtype=np.int64)
         lens = np.empty(cap, dtype=np.int64)
+        cfls = np.empty(cap, dtype=np.int32)
         # zero-copy view works for bytes and (read-only) mmap alike
         view = np.frombuffer(buf, dtype=np.uint8)
         n = nat.mxtpu_recordio_index(
             view.ctypes.data_as(ctypes.c_void_p), len(buf),
             offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), cap)
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            cfls.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), cap)
         if n == -1:
             raise MXNetError("Invalid RecordIO magic")
         if n >= 0:
-            return list(zip(offs[:n].tolist(), lens[:n].tolist()))
-    import struct as _struct
-    out = []
-    pos = 0
-    while pos + 8 <= len(buf):
-        magic, lrec = _struct.unpack_from("<II", buf, pos)
-        if magic != 0xced7230a:
-            raise MXNetError("Invalid RecordIO magic")
-        length = lrec & ((1 << 29) - 1)
-        if pos + 8 + length > len(buf):
-            break
-        out.append((pos + 8, length))
-        pos += 8 + length + (4 - length % 4) % 4
-    return out
+            parts = zip(offs[:n].tolist(), lens[:n].tolist(),
+                        cfls[:n].tolist())
+    if parts is None:
+        import struct as _struct
+        out = []
+        pos = 0
+        while pos + 8 <= len(buf):
+            magic, lrec = _struct.unpack_from("<II", buf, pos)
+            if magic != 0xced7230a:
+                raise MXNetError("Invalid RecordIO magic")
+            length = lrec & ((1 << 29) - 1)
+            if pos + 8 + length > len(buf):
+                break
+            out.append((pos + 8, length, lrec >> 29))
+            pos += 8 + length + (4 - length % 4) % 4
+        parts = out
+    return _group_parts(parts)
 
 
 # detection pipeline shares this namespace in the reference (mx.image.*)

@@ -26,7 +26,8 @@ def _configure(lib):
     i64 = ctypes.c_int64
     lib.mxtpu_recordio_index.restype = i64
     lib.mxtpu_recordio_index.argtypes = [
-        ctypes.c_void_p, i64, ctypes.POINTER(i64), ctypes.POINTER(i64), i64]
+        ctypes.c_void_p, i64, ctypes.POINTER(i64), ctypes.POINTER(i64),
+        ctypes.POINTER(ctypes.c_int32), i64]
     lib.mxtpu_augment_to_chw.restype = None
     lib.mxtpu_augment_to_chw.argtypes = [
         ctypes.c_void_p, i64, i64, i64, i64, i64, i64, i64, ctypes.c_int,

@@ -87,10 +87,16 @@ def _quantized_fc(params, *args):
     if params["flatten"]:
         x = x.reshape(x.shape[0], -1)
     out = jax.lax.dot(x, weight.astype(jnp.int32).T)
-    if bias is not None:
-        out = out + bias.astype(jnp.int32)
     d_scale = jnp.maximum(jnp.abs(dmin), jnp.abs(dmax)) / 127.0
     w_scale = jnp.maximum(jnp.abs(wmin), jnp.abs(wmax)) / 127.0
+    if bias is not None:
+        # the int8 bias carries its OWN scale (b_scale); accumulators carry
+        # d_scale*w_scale — rescale into accumulator units before adding
+        # (reference quantized_fully_connected float_for_one_quant_of_bias)
+        b_scale = jnp.maximum(jnp.abs(bmin), jnp.abs(bmax)) / 127.0
+        bias_acc = jnp.round(bias.astype(jnp.float32) * b_scale /
+                             (d_scale * w_scale)).astype(jnp.int32)
+        out = out + bias_acc
     out_range = d_scale * w_scale * 127.0 * 127.0
     return out, -out_range, out_range
 
@@ -129,10 +135,15 @@ def _quantized_conv(params, *args):
         feature_group_count=int(params["num_group"]),
         dimension_numbers=("NCHW", "OIHW", "NCHW"))
     out = jnp.round(out).astype(jnp.int32)
-    if bias is not None:
-        out = out + bias.astype(jnp.int32).reshape(1, -1, 1, 1)
     d_scale = jnp.maximum(jnp.abs(dmin), jnp.abs(dmax)) / 127.0
     w_scale = jnp.maximum(jnp.abs(wmin), jnp.abs(wmax)) / 127.0
+    if bias is not None:
+        # rescale the int8 bias from its own scale into accumulator units
+        # (reference quantized_conv.cu float_for_one_out_quant)
+        b_scale = jnp.maximum(jnp.abs(bmin), jnp.abs(bmax)) / 127.0
+        bias_acc = jnp.round(bias.astype(jnp.float32) * b_scale /
+                             (d_scale * w_scale)).astype(jnp.int32)
+        out = out + bias_acc.reshape(1, -1, 1, 1)
     out_range = d_scale * w_scale * 127.0 * 127.0
     return out, -out_range, out_range
 

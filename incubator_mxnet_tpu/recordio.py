@@ -73,30 +73,77 @@ class MXRecordIO:
         self.close()
         self.open()
 
-    def write(self, buf):
-        assert self.writable
+    def _write_part(self, cflag, buf):
         length = len(buf)
-        lrecord = (0 << _CFLAG_BITS) | length
+        lrecord = (cflag << _CFLAG_BITS) | length
         self.handle.write(struct.pack("<II", _MAGIC, lrecord))
         self.handle.write(buf)
         pad = (4 - length % 4) % 4
         if pad:
             self.handle.write(b"\x00" * pad)
 
-    def read(self):
-        assert not self.writable
+    def write(self, buf):
+        """Write one logical record, splitting at in-payload magic words so
+        any dmlc-compatible scanner stays synchronized (cflag 1/2/3
+        multi-part encoding; the magic bytes at each split are implied by
+        the next part's header and not stored)."""
+        assert self.writable
+        buf = bytes(buf)
+        magic = struct.pack("<I", _MAGIC)
+        if magic not in buf:
+            self._write_part(0, buf)
+            return
+        parts = buf.split(magic)
+        for i, part in enumerate(parts):
+            cflag = 1 if i == 0 else (3 if i == len(parts) - 1 else 2)
+            self._write_part(cflag, part)
+
+    def _read_part(self):
         header = self.handle.read(8)
         if len(header) < 8:
-            return None
+            return None, None
         magic, lrecord = struct.unpack("<II", header)
         if magic != _MAGIC:
             raise MXNetError("Invalid RecordIO magic")
+        cflag = lrecord >> _CFLAG_BITS
         length = lrecord & ((1 << _CFLAG_BITS) - 1)
         buf = self.handle.read(length)
         pad = (4 - length % 4) % 4
         if pad:
             self.handle.read(pad)
-        return buf
+        return cflag, buf
+
+    def read(self):
+        """Read one logical record, reassembling multi-part sequences.
+
+        dmlc-core writers split any payload containing the magic word into
+        parts (cflag 1=start, 2=middle, 3=end), dropping the 4 magic bytes
+        at each split point; readers re-insert the magic between parts
+        (dmlc-core recordio semantics mirrored by reference
+        `src/io/` iterators).
+        """
+        assert not self.writable
+        cflag, buf = self._read_part()
+        if cflag is None:
+            return None
+        if cflag == 0:
+            return buf
+        if cflag != 1:
+            raise MXNetError(
+                f"RecordIO: unexpected continuation flag {cflag} at record "
+                "start (corrupt file or reader desynchronized)")
+        parts = [buf]
+        while True:
+            cflag, buf = self._read_part()
+            if cflag is None:
+                raise MXNetError("RecordIO: truncated multi-part record")
+            if cflag not in (2, 3):
+                raise MXNetError(
+                    f"RecordIO: invalid flag {cflag} inside multi-part record")
+            parts.append(buf)
+            if cflag == 3:
+                break
+        return struct.pack("<I", _MAGIC).join(parts)
 
     def tell(self):
         return self.handle.tell()

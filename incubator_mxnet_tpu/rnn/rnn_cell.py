@@ -85,7 +85,7 @@ class BaseRNNCell:
         return outputs, states
 
     def _get_weight(self, name, **kwargs):
-        return self._params.get(f"{self._prefix}{name}")
+        return self._params.get(f"{self._prefix}{name}", **kwargs)
 
 
 class _RNNParams:
@@ -93,9 +93,9 @@ class _RNNParams:
         self._prefix = prefix
         self._params = {}
 
-    def get(self, name):
+    def get(self, name, **kwargs):
         if name not in self._params:
-            self._params[name] = sym.Variable(name)
+            self._params[name] = sym.Variable(name, **kwargs)
         return self._params[name]
 
 
@@ -149,8 +149,17 @@ class LSTMCell(BaseRNNCell):
     def __call__(self, inputs, states):
         self._counter += 1
         name = f"{self._prefix}t{self._counter}_"
+        # forget_bias is applied through the i2h_bias initializer
+        # (reference init.LSTMBias) rather than an inline graph term, so
+        # reference-trained checkpoints — whose saved bias already absorbed
+        # it — load without shifting the forget gate
+        import json as _json
+        i2h_bias = self._get_weight(
+            "i2h_bias",
+            init=_json.dumps(["lstmbias",
+                              {"forget_bias": self._forget_bias}]))
         i2h = sym.FullyConnected(inputs, self._get_weight("i2h_weight"),
-                                 self._get_weight("i2h_bias"),
+                                 i2h_bias,
                                  num_hidden=4 * self._num_hidden,
                                  name=f"{name}i2h")
         h2h = sym.FullyConnected(states[0], self._get_weight("h2h_weight"),
@@ -160,8 +169,7 @@ class LSTMCell(BaseRNNCell):
         gates = i2h + h2h
         slices = sym.split(gates, num_outputs=4, axis=1)
         in_gate = sym.Activation(slices[0], act_type="sigmoid")
-        forget_gate = sym.Activation(slices[1] + self._forget_bias,
-                                     act_type="sigmoid")
+        forget_gate = sym.Activation(slices[1], act_type="sigmoid")
         in_trans = sym.Activation(slices[2], act_type="tanh")
         out_gate = sym.Activation(slices[3], act_type="sigmoid")
         next_c = forget_gate * states[1] + in_gate * in_trans

@@ -594,15 +594,26 @@ def _infer_graph(symbol, shapes, partial):
     fixpoint, `src/executor/infer_graph_attr_pass.cc:73`).
 
     Layout-marked variables with a 0 batch dim (RNN begin states) need the
-    data batch size, but bound data may be batch-major (NT) or time-major
-    (TN) — try each leading dim of the first bound shape as the hint and
-    keep the first that infers cleanly.
+    data batch size.  When a *bound input variable* carries an explicit
+    ``__layout__`` attr ('NT'/'TN'/'NTC'/'TNC'), its N position identifies
+    the batch dim authoritatively — that hint is tried first.  Only
+    layout-less graphs fall back to probing each leading dim of the first
+    bound shape and keeping the first that infers cleanly (which can guess
+    wrong when batch == time; hence the layout preference).
     """
+    hints = []
+    for n in symbol._topo():
+        if n.is_variable and n.name in shapes:
+            layout = n._extra_attrs.get("__layout__")
+            bound = tuple(shapes[n.name] or ())
+            if layout:
+                bpos = str(layout).find("N")
+                if 0 <= bpos < len(bound) and bound[bpos] > 0:
+                    hints.append(bound[bpos])
     first = next((tuple(v) for v in shapes.values()
                   if v and tuple(v) and tuple(v)[0] > 0), None)
-    hints = []
     if first:
-        hints = [d for d in first[:2] if d > 0]
+        hints += [d for d in first[:2] if d > 0]
     hints = list(dict.fromkeys(hints)) or [None]
     last_err = None
     for hint in hints:

@@ -15,11 +15,14 @@ extern "C" {
 
 // dmlc recordio framing: [u32 magic 0xced7230a][u32 cflag<<29|len][payload]
 // padded to 4 bytes (python/mxnet/recordio.py, dmlc-core/recordio.h).
-// Fills payload offsets+lengths; returns record count, or -1 on a bad
-// magic (corrupt file), -2 if max_n too small.
+// Fills payload offsets+lengths+cflags (0 whole, 1 start, 2 middle,
+// 3 end of a multi-part record — dmlc writers split payloads containing
+// the magic word); returns part count, or -1 on a bad magic (corrupt
+// file), -2 if max_n too small.  Callers group 1/2*/3 sequences into one
+// logical record, re-inserting the magic word between parts.
 int64_t mxtpu_recordio_index(const uint8_t* buf, int64_t len,
                              int64_t* offsets, int64_t* lengths,
-                             int64_t max_n) {
+                             int32_t* cflags, int64_t max_n) {
   static const uint32_t kMagic = 0xced7230a;
   int64_t pos = 0, n = 0;
   while (pos + 8 <= len) {
@@ -32,6 +35,7 @@ int64_t mxtpu_recordio_index(const uint8_t* buf, int64_t len,
     if (n >= max_n) return -2;
     offsets[n] = pos + 8;
     lengths[n] = dlen;
+    cflags[n] = static_cast<int32_t>(lrec >> 29);
     ++n;
     int64_t pad = (4 - dlen % 4) % 4;
     pos += 8 + dlen + pad;

@@ -7,7 +7,8 @@ import pytest
 
 import incubator_mxnet_tpu as mx
 from incubator_mxnet_tpu import native, recordio
-from incubator_mxnet_tpu.image import ImageRecordIterImpl, _index_records
+from incubator_mxnet_tpu.image import (ImageRecordIterImpl, _index_records,
+                                       _record_payload)
 
 
 def _write_corpus(path, n=64, size=64):
@@ -31,8 +32,48 @@ def test_native_index_matches_python(tmp_path):
     assert len(got) == 17
     # cross-check against the sequential reader
     r = recordio.MXRecordIO(str(rec), "r")
-    for off, length in got:
-        assert r.read() == buf[off:off + length]
+    for segs in got:
+        assert r.read() == _record_payload(buf, segs)
+
+
+def test_multipart_records_roundtrip(tmp_path):
+    """Payloads containing the magic word are split by the writer (cflag
+    1/2/3) and must reassemble byte-exactly through every read path."""
+    import struct
+    magic = struct.pack("<I", 0xced7230a)
+    payloads = [
+        b"plain record",
+        b"head" + magic + b"tail",                 # one split
+        magic + b"starts with magic",              # empty first part
+        b"ends with magic" + magic,                # empty last part
+        b"a" + magic + b"b" + magic + b"c",        # two splits
+    ]
+    rec = tmp_path / "m.rec"
+    w = recordio.MXRecordIO(str(rec), "w")
+    for p in payloads:
+        w.write(p)
+    w.close()
+    # sequential reader reassembles
+    r = recordio.MXRecordIO(str(rec), "r")
+    for p in payloads:
+        assert r.read() == p
+    assert r.read() is None
+    r.close()
+    # index scan (native + fallback) groups parts into logical records
+    buf = rec.read_bytes()
+    got = _index_records(buf)
+    assert len(got) == len(payloads)
+    for segs, p in zip(got, payloads):
+        assert _record_payload(buf, segs) == p
+    # force the pure-python fallback scan too
+    import incubator_mxnet_tpu.image as image_mod
+    orig = image_mod._native.lib
+    image_mod._native.lib = lambda: None
+    try:
+        got_py = _index_records(buf)
+    finally:
+        image_mod._native.lib = orig
+    assert got_py == got
 
 
 def test_native_augment_matches_numpy():
