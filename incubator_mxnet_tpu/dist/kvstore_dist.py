@@ -69,9 +69,17 @@ class KVStoreDist(KVStoreTPU):
                 raise MXNetError(f"Key {k} has not been initialized")
             merged = self._reduce(vals)      # one collective over local chips
             if self._compression is not None:
+                # quantize device-side (error feedback stays on device),
+                # then pack 4 codes/byte for the wire — 16x fewer bytes
+                # than fp32 (reference gradient_compression.h packing)
+                from .compression import pack_2bit
                 merged = self._compress(sk, merged)
+                wire_value = pack_2bit(merged.asnumpy(),
+                                       self._compression["threshold"])
+            else:
+                wire_value = merged.asnumpy()
             reply = self._chan.request(
-                {"cmd": "push", "key": sk, "value": merged.asnumpy(),
+                {"cmd": "push", "key": sk, "value": wire_value,
                  "sync": self._sync, "rank": self._rank})
             _check(reply)
             if self._sync:

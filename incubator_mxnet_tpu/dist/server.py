@@ -127,7 +127,10 @@ class ParameterServer:
             return {"ok": True}
 
         if cmd == "push":
-            k, v, sync = msg["key"], np.asarray(msg["value"]), msg["sync"]
+            from .compression import is_packed, unpack_2bit
+            raw = msg["value"]
+            v = unpack_2bit(raw) if is_packed(raw) else np.asarray(raw)
+            k, sync = msg["key"], msg["sync"]
             rank = msg.get("rank", 0)
             with st.cond:
                 if k not in st.store:

@@ -36,18 +36,23 @@ def send_msg(sock: socket.socket, obj) -> None:
     buffers = []
     payload = pickle.dumps(obj, protocol=5, buffer_callback=buffers.append)
     raws = [b.raw() for b in buffers]
-    # frame: payload length, out-of-band buffer count, payload,
-    # [HMAC tag over payload when keyed], then each buffer prefixed with
-    # its own length
+    # frame: payload length, out-of-band buffer count, payload, each buffer
+    # prefixed with its own length, then [HMAC tag] when keyed.  The tag
+    # covers the pickle AND every out-of-band buffer (protocol 5 ships the
+    # actual ndarray bytes out-of-band — leaving them unauthenticated would
+    # let a peer flip gradient bytes behind a valid tag).
     sock.sendall(_LEN.pack(len(payload)))
     sock.sendall(_LEN.pack(len(raws)))
     sock.sendall(payload)
     key = _hmac_key()
-    if key is not None:
-        sock.sendall(_hmac.new(key, payload, hashlib.sha256).digest())
+    mac = _hmac.new(key, payload, hashlib.sha256) if key is not None else None
     for r in raws:
         sock.sendall(_LEN.pack(len(r)))
         sock.sendall(r)
+        if mac is not None:
+            mac.update(r)
+    if mac is not None:
+        sock.sendall(mac.digest())
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -66,17 +71,20 @@ def recv_msg(sock: socket.socket):
     nbuf = _LEN.unpack(_recv_exact(sock, 8))[0]
     payload = _recv_exact(sock, plen)
     key = _hmac_key()
-    if key is not None:
-        tag = _recv_exact(sock, _TAG_LEN)
-        want = _hmac.new(key, payload, hashlib.sha256).digest()
-        if not _hmac.compare_digest(tag, want):
-            raise ConnectionError(
-                "transport: HMAC verification failed — peer does not hold "
-                "MXNET_PS_HMAC_KEY; refusing to deserialize")
+    mac = _hmac.new(key, payload, hashlib.sha256) if key is not None else None
     bufs = []
     for _ in range(nbuf):
         blen = _LEN.unpack(_recv_exact(sock, 8))[0]
-        bufs.append(_recv_exact(sock, blen))
+        buf = _recv_exact(sock, blen)
+        if mac is not None:
+            mac.update(buf)
+        bufs.append(buf)
+    if mac is not None:
+        tag = _recv_exact(sock, _TAG_LEN)
+        if not _hmac.compare_digest(tag, mac.digest()):
+            raise ConnectionError(
+                "transport: HMAC verification failed — peer does not hold "
+                "MXNET_PS_HMAC_KEY; refusing to deserialize")
     return pickle.loads(payload, buffers=bufs)
 
 

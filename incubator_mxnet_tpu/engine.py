@@ -45,6 +45,12 @@ def _naive():
 
 def track(jarr):
     """Register a dispatched jax.Array; block immediately under NaiveEngine."""
+    import jax.core as _jc
+    if isinstance(jarr, _jc.Tracer):
+        # abstract value inside a jax trace (fused train step / CachedOp):
+        # nothing is in flight, and a leaked tracer in the wait-set would
+        # outlive its trace
+        return jarr
     if _naive():
         try:
             jarr.block_until_ready()

@@ -1,0 +1,558 @@
+"""Fused public training paths — the whole train step as ONE donated XLA
+program.
+
+The reference keeps per-step dispatch cheap with bulk-exec segments
+(`src/executor/graph_executor.cc:1194-1316`) and fused optimizer kernels
+(`src/operator/optimizer_op.cc`), so `Module.fit`'s forward → backward →
+kvstore push/pull → per-parameter-update loop costs little on GPU.  On TPU
+every dispatch is a host→device round trip; the TPU-native answer is to
+compile the ENTIRE train step — forward, backward, gradient reduction
+(data parallel), optimizer for all parameters, BatchNorm aux updates,
+metric accumulation, RNG key advance — into one donated XLA program per
+input signature, reachable from the public `Module.fit` /
+`gluon.Trainer.step` APIs.
+
+Two layers:
+
+* `FusedOptimizer` — applies `Optimizer.update_multi_precision` for every
+  parameter in one jitted donated program.  The *public* optimizer objects
+  are traced directly (their nd-op math is jax underneath), so every
+  registered optimizer keeps its exact semantics — including lr/wd
+  multipliers, schedulers, and multi-precision fp32 master weights.
+  Hyperparameters that change per step (lr, wd, update count t,
+  rescale_grad) are injected as traced scalars so schedules never
+  retrigger compilation.  Optimizers whose update cannot trace (e.g. ones
+  drawing host RNG) fall back to the per-parameter eager path
+  automatically.
+
+* `FusedTrainStep` — used by `Module` (`module/module.py`): whole-graph
+  forward+vjp (the Symbol is already one XLA computation) composed with
+  the `FusedOptimizer` trace plus aux/metric/key carries.  For multiple
+  devices the inputs are sharded over a 1-D `jax.sharding.Mesh` data axis
+  with parameters replicated: XLA inserts the gradient all-reduce (the
+  `kvstore='device'/'tpu'` reduce becomes a collective inside the
+  program) and BatchNorm statistics become global-batch statistics
+  (sync-BN semantics, the stronger form of the reference's per-device
+  stats).
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as _np
+
+from .ndarray.ndarray import NDArray
+
+__all__ = ["FusedOptimizer", "FusedTrainStep"]
+
+_log = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# pytree helpers over optimizer states (None | NDArray | nested tuples)
+# ---------------------------------------------------------------------------
+
+def _state_data(s):
+    """NDArray-state pytree -> raw jax-array pytree."""
+    if s is None:
+        return None
+    if isinstance(s, NDArray):
+        return s._data
+    if isinstance(s, (tuple, list)):
+        return tuple(_state_data(x) for x in s)
+    return s
+
+
+def _state_wrap(values, ctx):
+    """Raw-array pytree -> fresh NDArray shells (used inside the trace so
+    the public optimizer's in-place writes land on throwaway wrappers)."""
+    import jax
+    if values is None:
+        return None
+    if isinstance(values, (tuple, list)):
+        return tuple(_state_wrap(v, ctx) for v in values)
+    if isinstance(values, jax.Array) or hasattr(values, "dtype"):
+        return NDArray(values, ctx=ctx)
+    return values
+
+
+def _state_write_back(dst, new_values):
+    """Write updated raw arrays into the persistent NDArray state pytree."""
+    if dst is None:
+        return
+    if isinstance(dst, NDArray):
+        dst._set_data(new_values)
+        return
+    if isinstance(dst, (tuple, list)):
+        for d, v in zip(dst, new_values):
+            _state_write_back(d, v)
+
+
+class _TMap(dict):
+    """Stand-in for `Optimizer._index_update_count` during tracing: returns
+    the traced per-parameter step count (as an NDArray scalar so optimizer
+    float math like ``beta ** t`` stays inside the graph)."""
+
+    def __init__(self, t_vec, pos, ctx):
+        super().__init__()
+        self._t_vec = t_vec
+        self._pos = pos
+        self._ctx = ctx
+
+    def __getitem__(self, index):
+        return NDArray(self._t_vec[self._pos[index]], ctx=self._ctx)
+
+
+def _apply_traced(opt, indices, ws, gs, ss, ctx, lr_vec, wd_vec, t_vec,
+                  rescale):
+    """Trace the PUBLIC optimizer over all parameters at once.
+
+    Runs inside a jax trace: `opt`'s lr/wd/t/rescale lookups are patched to
+    return traced scalars, then `update_multi_precision` is called per
+    parameter on NDArray shells wrapping the traced arrays.  The patches
+    are removed before returning (they only matter at trace time;
+    compiled executions never re-enter this Python).
+    """
+    pos = {i: k for k, i in enumerate(indices)}
+    saved = dict(vars(opt))
+    try:
+        opt._get_lr = lambda i: NDArray(lr_vec[pos[i]], ctx=ctx)
+        opt._get_wd = lambda i: NDArray(wd_vec[pos[i]], ctx=ctx)
+        opt._update_count = lambda i: None  # host-side, done by the caller
+        opt._index_update_count = _TMap(t_vec, pos, ctx)
+        opt.rescale_grad = NDArray(rescale, ctx=ctx)
+        new_ws, new_ss = [], []
+        for k, i in enumerate(indices):
+            w = NDArray(ws[k], ctx=ctx)
+            g = NDArray(gs[k], ctx=ctx)
+            s = _state_wrap(ss[k], ctx)
+            opt.update_multi_precision(i, w, g, s)
+            new_ws.append(w._data)
+            new_ss.append(_state_data(s))
+        return new_ws, tuple(new_ss)
+    finally:
+        for k in list(vars(opt)):
+            if k not in saved:
+                delattr(opt, k)
+        opt.__dict__.update(saved)
+
+
+class _AotCall:
+    """AOT trace→compile→execute wrapper around a donating jit.
+
+    Donation deletes the caller's persistent buffers (weights, optimizer
+    state) at dispatch — so a jit call whose TRACE fails can destroy the
+    arrays the fallback path then needs.  Lowering and compiling first
+    (`jax.jit(...).lower(args).compile()`) consumes nothing; only the
+    compiled executable — which can no longer fail to trace — touches the
+    donated buffers.  One executable is kept per input signature
+    (shape/dtype/sharding), mirroring CachedOp's signature-keyed cache
+    (reference `cached_op.cc:265 SetForwardGraph`).
+    """
+
+    def __init__(self, jit_fn):
+        self._jit = jit_fn
+        self._execs = {}
+
+    @staticmethod
+    def _sig(args):
+        import jax
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        # dtype objects (not str()) — hashable, and orders of magnitude
+        # cheaper per leaf on the per-step hot path
+        return (treedef, tuple(
+            (getattr(a, "shape", None), getattr(a, "dtype", None),
+             getattr(a, "sharding", None)) for a in leaves))
+
+    def __call__(self, *args):
+        sig = self._sig(args)
+        exe = self._execs.get(sig)
+        if exe is None:
+            exe = self._jit.lower(*args).compile()
+            self._execs[sig] = exe
+        return exe(*args)
+
+
+def _no_rng():
+    """Context forbidding host RNG draws during a fused trace: a key drawn
+    at trace time would bake the SAME randomness into every compiled step."""
+    import contextlib
+    from . import random as _random
+
+    @contextlib.contextmanager
+    def guard():
+        orig = _random.next_key
+
+        def blocked():
+            raise RuntimeError(
+                "optimizer draws host RNG; not fusable (fall back)")
+
+        _random.next_key = blocked
+        try:
+            yield
+        finally:
+            _random.next_key = orig
+    return guard()
+
+
+# ---------------------------------------------------------------------------
+# FusedOptimizer: all parameter updates in one donated program
+# ---------------------------------------------------------------------------
+
+class FusedOptimizer:
+    """One-dispatch optimizer application for a fixed parameter set.
+
+    Replaces N per-parameter update dispatches (reference
+    `model.py _update_params` / `gluon/trainer.py _update`) with a single
+    donated XLA program.  Weight and state buffers are donated — the
+    caller's NDArrays are repointed to the new buffers in place.
+    """
+
+    def __init__(self, optimizer):
+        self._opt = optimizer
+        self._jit = None
+        self._broken = False
+
+    def _build(self):
+        import jax
+        opt = self._opt
+
+        def step(ws, gs, ss, lr_vec, wd_vec, t_vec, rescale):
+            return _apply_traced(opt, self._call_indices, ws, gs, ss,
+                                 self._call_ctx, lr_vec, wd_vec, t_vec,
+                                 rescale)
+
+        self._jit = _AotCall(jax.jit(step, donate_argnums=(0, 2)))
+
+    def _hyper(self, indices):
+        """Advance host-side update counts and collect per-parameter
+        hyperparameters for injection (exact scheduler semantics: the real
+        `_update_count`/`_get_lr`/`_get_wd` run on the host every step)."""
+        opt = self._opt
+        for i in indices:
+            opt._update_count(i)
+        lrs = _np.asarray([opt._get_lr(i) for i in indices], _np.float32)
+        wds = _np.asarray([opt._get_wd(i) for i in indices], _np.float32)
+        ts = _np.asarray([opt._index_update_count[i] for i in indices],
+                         _np.float32)
+        rescale = _np.float32(opt.rescale_grad)
+        return lrs, wds, ts, rescale
+
+    def __call__(self, indices, weights, grads, states):
+        """Apply updates for all (index, weight, grad, state) in one
+        program; falls back to the eager per-parameter path if the
+        optimizer cannot trace."""
+        opt = self._opt
+        if self._broken:
+            for i, w, g, s in zip(indices, weights, grads, states):
+                opt.update_multi_precision(i, w, g, s)
+            return
+        lrs, wds, ts, rescale = self._hyper(indices)
+        if self._jit is None:
+            self._build()
+        ws = [w._data for w in weights]
+        gs = [g._data for g in grads]
+        ss = tuple(_state_data(s) for s in states)
+        self._call_indices = list(indices)
+        self._call_ctx = weights[0].context
+        # counts were already advanced; replay through the raw update on
+        # fallback (not update_multi_precision, which would double-count)
+        try:
+            with _no_rng():
+                new_ws, new_ss = self._jit(ws, gs, ss, lrs, wds, ts, rescale)
+        except Exception as e:
+            self._broken = True
+            _log.warning(
+                "fused optimizer apply unavailable for %s (%s); using the "
+                "per-parameter path", type(opt).__name__, str(e)[:200])
+            saved = dict(vars(opt))
+            try:
+                opt._update_count = lambda i: None  # already counted above
+                for i, w, g, s in zip(indices, weights, grads, states):
+                    opt.update_multi_precision(i, w, g, s)
+            finally:
+                for k in list(vars(opt)):
+                    if k not in saved:
+                        delattr(opt, k)
+                opt.__dict__.update(saved)
+            return
+        for w, nw in zip(weights, new_ws):
+            w._set_data(nw)
+        for s, ns in zip(states, new_ss):
+            _state_write_back(s, ns)
+
+
+# ---------------------------------------------------------------------------
+# FusedTrainStep: Module's forward+backward+update(+metric) in one program
+# ---------------------------------------------------------------------------
+
+class FusedTrainStep:
+    """The `Module.fit` hot loop as one donated XLA program.
+
+    Built by `Module.init_optimizer` when eligible (single-process kvstore,
+    plain ``write`` grads, no module states).  Each call:
+
+      host:   advance optimizer counts, gather lr/wd/t scalars
+      device: ONE program = forward + vjp + optimizer (traced public
+              object) + BN-aux update + metric accumulation + key split
+
+    Parameters, optimizer state, aux state, the metric accumulator and the
+    RNG key are donated carries — steady-state training allocates nothing
+    and dispatches once per batch.
+    """
+
+    def __init__(self, module, updater):
+        import jax
+        self._mod = module
+        self._updater = updater
+        self._symbol = module._symbol
+        self._opt = updater.optimizer
+        self._contexts = module._context
+        exec0 = module._exec_group.execs[0]
+        self._exec0 = exec0
+
+        self._arg_names = self._symbol.list_arguments()
+        self._aux_names = self._symbol.list_auxiliary_states()
+        self._param_names = [n for n in module._exec_group.param_names
+                             if module._exec_group.grad_req.get(n) == "write"]
+        input_names = (module._exec_group.data_names +
+                       module._exec_group.label_names)
+        self._input_names = input_names
+        # "fixed" args: bound but not updated (grad_req null non-inputs)
+        self._fixed_names = [n for n in self._arg_names
+                             if n not in self._param_names and
+                             n not in input_names]
+        ndev = len(self._contexts)
+        update_on_kv = bool(module._update_on_kvstore)
+        self._indices = [i if (update_on_kv or ndev == 1) else i * ndev
+                         for i in range(len(module._exec_group.param_names))]
+        self._indices = [self._indices[module._exec_group.param_names.index(n)]
+                         for n in self._param_names]
+
+        # device mesh for multi-device data parallelism
+        devices = [c.jax_device for c in self._contexts]
+        if len(devices) > 1:
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+            mesh = Mesh(_np.array(devices), ("dp",))
+            self._data_sharding = NamedSharding(mesh, P("dp"))
+            self._rep_sharding = NamedSharding(mesh, P())
+        else:
+            from jax.sharding import SingleDeviceSharding
+            self._data_sharding = SingleDeviceSharding(devices[0])
+            self._rep_sharding = SingleDeviceSharding(devices[0])
+
+        from .symbol.symbol import graph_eval_fn
+        self._gfn, _, _, self._n_rng = graph_eval_fn(self._symbol, True)
+        self._key = None
+        self._jit = None
+        self.last_outputs = None
+        self.broken = False
+
+    # -- placement of persistent buffers -------------------------------------
+    # Every call normalizes buffer shardings (a no-op once placed): other
+    # code paths — set_params at epoch boundaries, checkpoint loads — may
+    # legally repoint these NDArrays at single-device arrays between steps.
+    def _place_nd(self, a):
+        import jax
+        if getattr(a._data, "sharding", None) != self._rep_sharding:
+            a._set_data(jax.device_put(a._data, self._rep_sharding))
+
+    def _place_state(self, s):
+        if isinstance(s, NDArray):
+            self._place_nd(s)
+        elif isinstance(s, (tuple, list)):
+            for x in s:
+                self._place_state(x)
+
+    def _place_all(self):
+        exec0 = self._exec0
+        for n in self._param_names + self._fixed_names:
+            self._place_nd(exec0.arg_dict[n])
+        for n in self._aux_names:
+            self._place_nd(exec0.aux_dict[n])
+        upd = self._updater
+        for i, n in zip(self._indices, self._param_names):
+            if i not in upd.states:
+                upd.states[i] = self._opt.create_state_multi_precision(
+                    i, exec0.arg_dict[n])
+                upd.states_synced[i] = True
+            self._place_state(upd.states[i])
+
+    # -- the traced step -----------------------------------------------------
+    def _build(self, metric_fns):
+        import jax
+        import jax.numpy as jnp
+
+        gfn = self._gfn
+        arg_names = self._arg_names
+        param_pos = {n: k for k, n in enumerate(self._param_names)}
+        input_pos = {n: k for k, n in enumerate(self._input_names)}
+        fixed_pos = {n: k for k, n in enumerate(self._fixed_names)}
+        n_label = len(self._mod._exec_group.label_names)
+        opt = self._opt
+        indices = self._indices
+        ctx = self._contexts[0]
+        n_rng = self._n_rng
+
+        def step(ws, ss, auxs, mcarry, key, inputs, fixed,
+                 lr_vec, wd_vec, t_vec, rescale):
+            if n_rng:
+                key, sub = jax.random.split(key)
+            else:
+                sub = key
+
+            def forward(pws):
+                args = []
+                for n in arg_names:
+                    if n in param_pos:
+                        args.append(pws[param_pos[n]])
+                    elif n in input_pos:
+                        args.append(inputs[input_pos[n]])
+                    else:
+                        args.append(fixed[fixed_pos[n]])
+                outs, new_aux = gfn(tuple(args), tuple(auxs), sub)
+                return tuple(outs), tuple(new_aux)
+
+            outs, vjp, new_aux = jax.vjp(forward, ws, has_aux=True)
+            cts = tuple(
+                jnp.ones(o.shape, o.dtype)
+                if jnp.issubdtype(o.dtype, jnp.floating)
+                else jnp.zeros(o.shape, o.dtype) for o in outs)
+            (grads,) = vjp(cts)
+            new_ws, new_ss = _apply_traced(opt, indices, ws, grads, ss, ctx,
+                                           lr_vec, wd_vec, t_vec, rescale)
+            labels = inputs[len(inputs) - n_label:] if n_label else ()
+            new_mcarry = []
+            for (fn, _), (msum, mnum) in zip(metric_fns, mcarry):
+                dsum, dnum = fn(list(labels), list(outs))
+                # counts carry as int32: float32 would silently stop
+                # incrementing past 2^24 samples
+                new_mcarry.append((msum + jnp.asarray(dsum, jnp.float32),
+                                   mnum + jnp.asarray(dnum, jnp.int32)))
+            return new_ws, new_ss, tuple(new_aux), tuple(new_mcarry), key, \
+                tuple(outs)
+
+        self._jit = _AotCall(jax.jit(step, donate_argnums=(0, 1, 2, 3, 4)))
+
+    # -- per-call ------------------------------------------------------------
+    def _metric_leaves(self, eval_metric):
+        """Leaf metrics with device-side update fns, or None when any leaf
+        cannot run in-graph (caller then uses the host update path)."""
+        from . import metric as _metric
+        if eval_metric is None:
+            return []
+        if isinstance(eval_metric, _metric.CompositeEvalMetric):
+            leaves = eval_metric.metrics
+        else:
+            leaves = [eval_metric]
+        out = []
+        for m in leaves:
+            fn = getattr(m, "device_update", None)
+            if fn is None:
+                return None
+            out.append((fn, m))
+        return out
+
+    def __call__(self, data_batch, eval_metric=None):
+        """Run one fused train step.  Returns True when handled (metric
+        included); False -> caller must use the unfused path."""
+        if self.broken:
+            return False
+        import jax
+        mod = self._mod
+
+        metric_fns = self._metric_leaves(eval_metric)
+        if metric_fns is None:
+            return False
+        self._place_all()
+        if self._jit is None or metric_fns_changed(self._metric_sig(),
+                                                   metric_fns):
+            self._metric_ids = [id(m) for _, m in metric_fns]
+            self._build(metric_fns)
+
+        exec0 = self._exec0
+        data = list(data_batch.data) + list(data_batch.label or [])
+        if len(data) != len(self._input_names):
+            return False
+        inputs = []
+        for v, name in zip(data, self._input_names):
+            raw = v._data if isinstance(v, NDArray) else _np.asarray(v)
+            tgt = exec0.arg_dict[name]
+            if hasattr(raw, "astype") and raw.dtype != tgt.dtype and \
+                    name not in self._mod._exec_group.label_names:
+                raw = raw.astype(tgt.dtype)
+            inputs.append(jax.device_put(raw, self._data_sharding))
+        fixed = [exec0.arg_dict[n]._data for n in self._fixed_names]
+
+        ws = [exec0.arg_dict[n]._data for n in self._param_names]
+        states = [self._updater.states[i] for i in self._indices]
+        ss = tuple(_state_data(s) for s in states)
+        auxs = [exec0.aux_dict[n]._data for n in self._aux_names]
+
+        mcarry = []
+        for fn, m in metric_fns:
+            pend = getattr(m, "_device_totals", None)
+            if pend is None:
+                import jax.numpy as jnp
+                pend = (jax.device_put(jnp.zeros((), jnp.float32),
+                                       self._rep_sharding),
+                        jax.device_put(jnp.zeros((), jnp.int32),
+                                       self._rep_sharding))
+            mcarry.append(tuple(pend))
+
+        if self._key is None:
+            from . import random as _random
+            self._key = jax.device_put(_random.next_key(),
+                                       self._rep_sharding)
+
+        opt = self._opt
+        # snapshot counts so a failed attempt doesn't double-count the step
+        # when the caller re-runs it through the unfused path
+        counts_before = dict(opt._index_update_count)
+        num_update_before = opt.num_update
+        for i in self._indices:
+            opt._update_count(i)
+        lrs = _np.asarray([opt._get_lr(i) for i in self._indices], _np.float32)
+        wds = _np.asarray([opt._get_wd(i) for i in self._indices], _np.float32)
+        ts = _np.asarray([opt._index_update_count[i] for i in self._indices],
+                         _np.float32)
+        rescale = _np.float32(opt.rescale_grad)
+
+        try:
+            with _no_rng():
+                new_ws, new_ss, new_aux, new_mcarry, new_key, outs = \
+                    self._jit(ws, tuple(ss), auxs, mcarry, self._key, inputs,
+                              fixed, lrs, wds, ts, rescale)
+        except Exception as e:
+            self.broken = True
+            opt._index_update_count = counts_before
+            opt.num_update = num_update_before
+            _log.warning("fused train step unavailable (%s); Module.fit "
+                         "falls back to forward_backward+update",
+                         str(e)[:300])
+            return False
+
+        # repoint persistent buffers (donation invalidated the old ones)
+        groups = mod._exec_group
+        for n, nw in zip(self._param_names, new_ws):
+            for e in groups.execs:
+                e.arg_dict[n]._set_data(nw)
+        for s, ns in zip(states, new_ss):
+            _state_write_back(s, ns)
+        for n, na in zip(self._aux_names, new_aux):
+            for e in groups.execs:
+                e.aux_dict[n]._set_data(na)
+        for (fn, m), pend in zip(metric_fns, new_mcarry):
+            m._device_totals = tuple(pend)
+        self._key = new_key
+        ctx0 = self._contexts[0]
+        self.last_outputs = [NDArray(o, ctx=ctx0) for o in outs]
+        mod._params_dirty = True
+        return True
+
+    def _metric_sig(self):
+        return getattr(self, "_metric_ids", None)
+
+
+def metric_fns_changed(prev_ids, metric_fns):
+    return prev_ids != [id(m) for _, m in metric_fns]

@@ -39,6 +39,7 @@ class Trainer:
         self._update_on_kvstore_arg = update_on_kvstore
         self._kvstore = None
         self._update_on_kvstore = None
+        self._fused = None
 
     def _check_contexts(self):
         contexts = None
@@ -117,12 +118,36 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
-        for i, param in enumerate(self._params):
-            if param.grad_req == "null":
-                continue
-            for upd, arr, grad in zip(self._updaters, param.list_data(),
-                                      param.list_grad()):
-                upd(i, grad, arr)
+        """Apply all parameter updates.
+
+        TPU fast path: ONE donated XLA program applies the optimizer for
+        every parameter (`fused.FusedOptimizer`), replacing the reference's
+        per-parameter fused-op dispatches (`trainer.py:254 step` →
+        `optimizer_op.cc` kernels) — on TPU each dispatch is a host round
+        trip, so the multi-tensor apply is the only way `Trainer.step`
+        keeps up with a jitted forward/backward."""
+        live = [(i, p) for i, p in enumerate(self._params)
+                if p.grad_req != "null"]
+        if not live:
+            return
+        if self._fused is None:
+            from .. import fused as _fused
+            self._fused = [_fused.FusedOptimizer(u.optimizer)
+                           for u in self._updaters]
+        for k, upd in enumerate(self._updaters):
+            indices, ws, gs, ss = [], [], [], []
+            for i, param in live:
+                arr = param.list_data()[k]
+                grad = param.list_grad()[k]
+                if i not in upd.states:
+                    upd.states[i] = \
+                        upd.optimizer.create_state_multi_precision(i, arr)
+                    upd.states_synced[i] = True
+                indices.append(i)
+                ws.append(arr)
+                gs.append(grad)
+                ss.append(upd.states[i])
+            self._fused[k](indices, ws, gs, ss)
 
     def save_states(self, fname):
         assert self._optimizer is not None

@@ -96,11 +96,29 @@ class EvalMetric:
     def update(self, labels, preds):
         raise NotImplementedError()
 
+    # -- device-side accumulation (fused train step) -------------------------
+    # Metrics that can run in-graph define `device_update(labels, preds) ->
+    # (sum_delta, num_delta)` over jax arrays; the fused Module train step
+    # (`fused.FusedTrainStep`) then accumulates (sum, num) ON DEVICE as part
+    # of the compiled program and stores the running totals here — `get()`
+    # fetches them with a single host sync instead of one per batch.
+    # Metrics without `device_update` keep the per-batch host path.
+    _device_totals = None
+
+    def _materialize(self):
+        if self._device_totals is not None:
+            dsum, dnum = self._device_totals
+            self.sum_metric += float(dsum)
+            self.num_inst += int(round(float(dnum)))
+            self._device_totals = None
+
     def reset(self):
         self.num_inst = 0
         self.sum_metric = 0.0
+        self._device_totals = None
 
     def get(self):
+        self._materialize()
         if self.num_inst == 0:
             return (self.name, float("nan"))
         return (self.name, self.sum_metric / self.num_inst)
@@ -178,6 +196,18 @@ class Accuracy(EvalMetric):
             self.sum_metric += (pred == lab).sum()
             self.num_inst += len(pred)
 
+    def device_update(self, labels, preds):
+        import jax.numpy as jnp
+        dsum, dnum = 0.0, 0.0
+        for label, pred in zip(labels, preds):
+            if pred.ndim > 1 and pred.shape != label.shape:
+                pred = jnp.argmax(pred, axis=self.axis)
+            lab = label.reshape(-1).astype(jnp.int32)
+            pred = pred.reshape(-1).astype(jnp.int32)
+            dsum = dsum + (pred == lab).sum()
+            dnum = dnum + pred.size
+        return dsum, dnum
+
 
 @register
 @alias("top_k_accuracy", "top_k_acc")
@@ -205,6 +235,19 @@ class TopKAccuracy(EvalMetric):
                     self.sum_metric += (
                         pred[:, num_classes - 1 - j].flat == lab.flat).sum()
             self.num_inst += num_samples
+
+    def device_update(self, labels, preds):
+        import jax.numpy as jnp
+        dsum, dnum = 0.0, 0.0
+        for label, pred in zip(labels, preds):
+            if pred.ndim != 2:
+                continue
+            top_k = min(pred.shape[1], self.top_k)
+            top = jnp.argsort(pred.astype(jnp.float32), axis=1)[:, -top_k:]
+            lab = label.reshape(-1).astype(jnp.int32)
+            dsum = dsum + (top == lab[:, None]).sum()
+            dnum = dnum + pred.shape[0]
+        return dsum, dnum
 
 
 @register
@@ -369,6 +412,16 @@ class MAE(EvalMetric):
             self.sum_metric += numpy.abs(label - pred).mean()
             self.num_inst += 1
 
+    def device_update(self, labels, preds):
+        import jax.numpy as jnp
+        dsum, dnum = 0.0, 0.0
+        for label, pred in zip(labels, preds):
+            label = label.reshape(label.shape[0], -1).astype(jnp.float32)
+            pred = pred.reshape(pred.shape[0], -1).astype(jnp.float32)
+            dsum = dsum + jnp.abs(label - pred).mean()
+            dnum = dnum + 1
+        return dsum, dnum
+
 
 @register
 class MSE(EvalMetric):
@@ -386,6 +439,16 @@ class MSE(EvalMetric):
                 pred = pred.reshape(pred.shape[0], 1)
             self.sum_metric += ((label - pred) ** 2.0).mean()
             self.num_inst += 1
+
+    def device_update(self, labels, preds):
+        import jax.numpy as jnp
+        dsum, dnum = 0.0, 0.0
+        for label, pred in zip(labels, preds):
+            label = label.reshape(label.shape[0], -1).astype(jnp.float32)
+            pred = pred.reshape(pred.shape[0], -1).astype(jnp.float32)
+            dsum = dsum + ((label - pred) ** 2.0).mean()
+            dnum = dnum + 1
+        return dsum, dnum
 
 
 @register
@@ -423,6 +486,17 @@ class CrossEntropy(EvalMetric):
             prob = pred[numpy.arange(label.shape[0]), numpy.int64(label)]
             self.sum_metric += (-numpy.log(prob + self.eps)).sum()
             self.num_inst += label.shape[0]
+
+    def device_update(self, labels, preds):
+        import jax.numpy as jnp
+        dsum, dnum = 0.0, 0.0
+        for label, pred in zip(labels, preds):
+            lab = label.reshape(-1).astype(jnp.int32)
+            pred = pred.astype(jnp.float32)
+            prob = jnp.take_along_axis(pred, lab[:, None], axis=1)[:, 0]
+            dsum = dsum + (-jnp.log(prob + self.eps)).sum()
+            dnum = dnum + lab.shape[0]
+        return dsum, dnum
 
 
 @register
@@ -474,6 +548,14 @@ class Loss(EvalMetric):
             loss = _as_numpy(pred).sum()
             self.sum_metric += loss
             self.num_inst += _as_numpy(pred).size
+
+    def device_update(self, labels, preds):
+        import jax.numpy as jnp
+        dsum, dnum = 0.0, 0.0
+        for pred in preds:
+            dsum = dsum + pred.astype(jnp.float32).sum()
+            dnum = dnum + pred.size
+        return dsum, dnum
 
 
 @register

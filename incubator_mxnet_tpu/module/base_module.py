@@ -32,6 +32,13 @@ class BaseModule:
         self.forward(data_batch, is_train=True)
         self.backward()
 
+    def fit_step(self, data_batch, eval_metric):
+        """One training step plus metric update.  Subclasses may override
+        with a fused single-program implementation (Module does on TPU)."""
+        self.forward_backward(data_batch)
+        self.update()
+        self.update_metric(eval_metric, data_batch.label)
+
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
               epoch=0, sparse_row_id_fn=None):
@@ -151,17 +158,21 @@ class BaseModule:
             while not end_of_batch:
                 data_batch = next_data_batch
                 if monitor is not None:
+                    # monitoring needs per-pass intermediate values: use the
+                    # unfused forward/backward so the hooks can observe them
                     monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
+                    self.forward_backward(data_batch)
+                    self.update()
+                else:
+                    self.fit_step(data_batch, eval_metric)
                 try:
                     next_data_batch = next(data_iter)
                     self.prepare(next_data_batch,
                                  sparse_row_id_fn=sparse_row_id_fn)
                 except StopIteration:
                     end_of_batch = True
-                self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
+                    self.update_metric(eval_metric, data_batch.label)
                     monitor.toc_print()
                 if batch_end_callback is not None:
                     batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,

@@ -70,6 +70,23 @@ class DataParallelExecutorGroup:
         else:
             self.grad_req = dict(grad_req)
 
+        # low-precision lane (reference fp16 flow, `docs/faq/perf.md:161-178`;
+        # on TPU the type is bfloat16): when every data input is declared
+        # bf16/fp16 via DataDesc.dtype, parameters are bound in that dtype so
+        # the matmuls/convs hit the MXU natively.  Aux states (BatchNorm
+        # running stats) and labels keep their own dtypes — stats accumulate
+        # in fp32, and the multi-precision optimizer keeps fp32 masters.
+        type_dict = None
+        data_dtypes = {_np.dtype(d.dtype) for d in self.data_shapes}
+        if len(data_dtypes) == 1 and \
+                next(iter(data_dtypes)).name in ("float16", "bfloat16"):
+            low = next(iter(data_dtypes))
+            label_names_set = set(self.label_names)
+            type_dict = {n: low for n in self.arg_names
+                         if n not in label_names_set}
+            for l in self.label_shapes:
+                type_dict[l.name] = _np.dtype(l.dtype)
+
         self.execs = []
         for i, ctx in enumerate(contexts):
             shard = self.slices[i]
@@ -80,6 +97,7 @@ class DataParallelExecutorGroup:
                 shapes[l.name] = (shard.stop - shard.start,) + l.shape[1:]
             self.execs.append(symbol.simple_bind(ctx=ctx,
                                                  grad_req=self.grad_req,
+                                                 type_dict=type_dict,
                                                  **shapes))
 
         # param/grad arrays grouped across devices: [n_params][n_devices]

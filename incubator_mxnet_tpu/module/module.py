@@ -56,6 +56,7 @@ class Module(BaseModule):
         self._exec_group = None
         self._data_shapes = None
         self._label_shapes = None
+        self._fused_step = None
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
@@ -205,6 +206,7 @@ class Module(BaseModule):
         self._exec_group = None
         self._data_shapes = None
         self._label_shapes = None
+        self._fused_step = None
 
     def reshape(self, data_shapes, label_shapes=None):
         assert self.binded
@@ -277,14 +279,72 @@ class Module(BaseModule):
         else:
             self._updater = opt.get_updater(optimizer)
 
+        # TPU fast path: compile forward+backward+optimizer+metric into ONE
+        # donated XLA program per signature (fused.FusedTrainStep) — the
+        # public equivalent of the reference's bulk-exec segments + fused
+        # update ops (`graph_executor.cc:1194-1316`, `optimizer_op.cc`)
+        self._fused_step = None
+        if self._fusable(kvstore):
+            try:
+                from .. import fused as _fused
+                updater = self._updater or opt.get_updater(optimizer)
+                self._fused_step = _fused.FusedTrainStep(self, updater)
+                # optimizer state now lives in the updater (save/load go
+                # through it, not a kvstore-side optimizer)
+                self._updater = updater
+                self._update_on_kvstore = False
+            except Exception as e:  # never block training on the fast path
+                self.logger.warning(
+                    "fused train step unavailable (%s); Module.fit uses "
+                    "forward_backward+update", str(e)[:200])
+                self._fused_step = None
+
         self.optimizer_initialized = True
         if self._preload_opt_states is not None:
             self.load_optimizer_states(self._preload_opt_states)
             self._preload_opt_states = None
 
+    def _fusable(self, kvstore):
+        """Whether fit can run the single-program fused train step."""
+        import os
+        if os.environ.get("MXNET_FUSED_TRAIN_STEP", "1") == "0":
+            return False
+        if self._state_names or self.inputs_need_grad or not self.for_training:
+            return False
+        if self._compression_params:
+            return False
+        if any(v not in ("write", "null")
+               for v in self._exec_group.grad_req.values()):
+            return False
+        if kvstore is not None and \
+                getattr(kvstore, "type", "") not in ("local", "device", "tpu"):
+            return False
+        ndev = len(self._context)
+        if ndev > 1:
+            if len({c.device_type for c in self._context}) > 1:
+                return False
+            bs = self._exec_group.batch_size
+            if bs % ndev or any(
+                    (s.stop - s.start) != bs // ndev
+                    for s in self._exec_group.slices):
+                return False
+        return True
+
+    def fit_step(self, data_batch, eval_metric):
+        """One train step + metric update; fused single-program when
+        available (see init_optimizer), reference semantics otherwise."""
+        if self._fused_step is not None and \
+                self._fused_step(data_batch, eval_metric):
+            return
+        self.forward_backward(data_batch)
+        self.update()
+        self.update_metric(eval_metric, data_batch.label)
+
     # -- forward/backward ------------------------------------------------------
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
+        if self._fused_step is not None:
+            self._fused_step.last_outputs = None
         self._exec_group.forward(data_batch, is_train)
 
     def forward_backward(self, data_batch):
@@ -317,6 +377,10 @@ class Module(BaseModule):
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
+        if self._fused_step is not None and \
+                self._fused_step.last_outputs is not None:
+            # last step ran fused: outputs are the global-batch arrays
+            return self._fused_step.last_outputs
         return self._exec_group.get_outputs(merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):

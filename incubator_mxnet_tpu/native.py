@@ -32,14 +32,14 @@ def _configure(lib):
     lib.mxtpu_augment_to_chw.argtypes = [
         ctypes.c_void_p, i64, i64, i64, i64, i64, i64, i64, ctypes.c_int,
         ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
-        ctypes.POINTER(ctypes.c_float)]
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int]
     lib.mxtpu_augment_batch.restype = None
     lib.mxtpu_augment_batch.argtypes = [
         ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(i64),
         ctypes.POINTER(i64), i64, ctypes.POINTER(i64), ctypes.POINTER(i64),
         i64, i64, ctypes.POINTER(ctypes.c_int),
         ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
-        ctypes.POINTER(ctypes.c_float), i64]
+        ctypes.POINTER(ctypes.c_float), i64, ctypes.c_int]
     return lib
 
 

@@ -398,6 +398,11 @@ def _binary(lhs, rhs, tensor_op, scalar_op):
         return _apply_op(scalar_op, [lhs], {"scalar": float(rhs)})
     if isinstance(rhs, _np.ndarray):
         return _apply_op(tensor_op, [lhs, array(rhs, ctx=lhs.context)], {})
+    import jax
+    if isinstance(rhs, jax.Array) and tensor_op is not None:
+        # raw jax array or tracer operand (fused optimizer traces inject
+        # lr/wd/t as tracer scalars); broadcasting covers the scalar case
+        return _apply_op(tensor_op, [lhs, NDArray(rhs, ctx=lhs.context)], {})
     return NotImplemented
 
 
@@ -445,6 +450,8 @@ def invoke(op, data, kwargs, out=None):
         import jax.numpy as jnp
         for pname in op.dynamic_params:
             pval = params.pop(pname)
+            if isinstance(pval, NDArray):  # traced scalar (fused optimizer)
+                pval = pval._data
             in_arrays.append(jnp.asarray(pval, dtype="float32"))
 
     if op.needs_rng:

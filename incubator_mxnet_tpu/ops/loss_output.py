@@ -45,13 +45,19 @@ def _softmax_output(params, data, label):
         label = label.reshape(orig_shape[0])
         flattened = True
 
+    # softmax and its (softmax - onehot) gradient run in fp32 even for
+    # bf16 activations: exp/sum in 8-bit mantissa loses real accuracy and
+    # costs nothing to avoid (the matmuls stay bf16 on the MXU)
+    in_dtype = data.dtype
+
     @jax.custom_vjp
     def f(d, l):
-        return jax.nn.softmax(d, axis=axis)
+        return jax.nn.softmax(d.astype(jnp.float32), axis=axis) \
+            .astype(in_dtype)
 
     def fwd(d, l):
-        out = jax.nn.softmax(d, axis=axis)
-        return out, (out, l)
+        out = jax.nn.softmax(d.astype(jnp.float32), axis=axis)
+        return out.astype(in_dtype), (out, l)
 
     def bwd(res, g):
         out, l = res
@@ -77,8 +83,8 @@ def _softmax_output(params, data, label):
             grad = grad / valid
         grad = grad * scale
         if params["out_grad"]:
-            grad = grad * g
-        return grad, jnp.zeros_like(l)
+            grad = grad * g.astype(out.dtype)
+        return grad.astype(in_dtype), jnp.zeros_like(l)
 
     f.defvjp(fwd, bwd)
     out = f(data, label)

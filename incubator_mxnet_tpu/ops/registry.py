@@ -212,9 +212,18 @@ def _jitted(op_name, frozen_params):
 
 
 def eager_call(op: OpDef, params: dict, arrays):
-    """Execute an op eagerly; returns tuple of jax arrays (outputs then aux)."""
-    frozen = tuple(sorted(params.items()))
-    out = _jitted(op.name, frozen)(*arrays)
+    """Execute an op eagerly; returns tuple of jax arrays (outputs then aux).
+
+    Inside an outer jax trace (fused train step / CachedOp), the compute
+    function is called directly: nesting a jit per op would bloat the outer
+    program with hundreds of call-ops and multiply compile time.
+    """
+    import jax
+    if any(isinstance(a, jax.core.Tracer) for a in arrays):
+        out = op.fn(dict(params), *arrays)
+    else:
+        frozen = tuple(sorted(params.items()))
+        out = _jitted(op.name, frozen)(*arrays)
     if not isinstance(out, (tuple, list)):
         out = (out,)
     return tuple(out)

@@ -394,7 +394,9 @@ class Adam(Optimizer):
         t = self._index_update_count[index]
         coef1 = 1.0 - self.beta1 ** t
         coef2 = 1.0 - self.beta2 ** t
-        lr *= math.sqrt(coef2) / coef1
+        # ** 0.5, not math.sqrt: works for host floats AND the traced step
+        # counts the fused train path injects (fused.py _apply_traced)
+        lr = lr * coef2 ** 0.5 / coef1
         mean, var = state
         nd.adam_update(weight, grad, mean, var, lr=lr, wd=wd,
                        beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,

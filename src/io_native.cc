@@ -11,6 +11,20 @@
 #include <cstdint>
 #include <cstring>
 
+// 3-channel inner row: fixed channel mapping and no per-pixel branches so
+// the compiler can vectorize (the c==3 case is every image pipeline).
+template <int kStep, int kC0, int kC1, int kC2>
+static inline void row3(const uint8_t* px, float* d0, float* d1, float* d2,
+                        int64_t n, const float* mean, const float* stdinv) {
+  const float m0 = mean[0], m1 = mean[1], m2 = mean[2];
+  const float s0 = stdinv[0], s1 = stdinv[1], s2 = stdinv[2];
+  for (int64_t x = 0; x < n; ++x, px += kStep) {
+    d0[x] = (static_cast<float>(px[kC0]) - m0) * s0;
+    d1[x] = (static_cast<float>(px[kC1]) - m1) * s1;
+    d2[x] = (static_cast<float>(px[kC2]) - m2) * s2;
+  }
+}
+
 extern "C" {
 
 // dmlc recordio framing: [u32 magic 0xced7230a][u32 cflag<<29|len][payload]
@@ -46,13 +60,36 @@ int64_t mxtpu_recordio_index(const uint8_t* buf, int64_t len,
 // Crop + optional horizontal mirror + per-channel normalize + HWC u8 ->
 // CHW f32.  `stdinv` is 1/std (precomputed; multiply beats divide).
 // The three channel planes are written contiguously: dst[(c)(out_h)(out_w)].
+// `channel_reverse` flips the channel order on the way through (BGR
+// source -> RGB planes), letting callers skip a separate cvtColor pass.
 void mxtpu_augment_to_chw(const uint8_t* src, int64_t h, int64_t w,
                           int64_t c, int64_t crop_y, int64_t crop_x,
                           int64_t out_h, int64_t out_w, int mirror,
                           const float* mean, const float* stdinv,
-                          float* dst) {
+                          float* dst, int channel_reverse) {
   (void)h;
   const int64_t plane = out_h * out_w;
+  if (c == 3) {
+    for (int64_t y = 0; y < out_h; ++y) {
+      const uint8_t* row = src + ((crop_y + y) * w + crop_x) * 3;
+      float* d0 = dst + y * out_w;
+      float* d1 = d0 + plane;
+      float* d2 = d1 + plane;
+      const uint8_t* px = mirror ? row + (out_w - 1) * 3 : row;
+      if (channel_reverse) {  // BGR source -> RGB planes
+        if (mirror)
+          row3<-3, 2, 1, 0>(px, d0, d1, d2, out_w, mean, stdinv);
+        else
+          row3<3, 2, 1, 0>(px, d0, d1, d2, out_w, mean, stdinv);
+      } else {
+        if (mirror)
+          row3<-3, 0, 1, 2>(px, d0, d1, d2, out_w, mean, stdinv);
+        else
+          row3<3, 0, 1, 2>(px, d0, d1, d2, out_w, mean, stdinv);
+      }
+    }
+    return;
+  }
   for (int64_t y = 0; y < out_h; ++y) {
     const uint8_t* row = src + ((crop_y + y) * w + crop_x) * c;
     float* drow = dst + y * out_w;
@@ -60,25 +97,27 @@ void mxtpu_augment_to_chw(const uint8_t* src, int64_t h, int64_t w,
       int64_t sx = mirror ? (out_w - 1 - x) : x;
       const uint8_t* px = row + sx * c;
       for (int64_t ch = 0; ch < c; ++ch) {
-        drow[ch * plane + x] = (static_cast<float>(px[ch]) - mean[ch])
-                               * stdinv[ch];
+        int64_t oc = channel_reverse ? (c - 1 - ch) : ch;
+        drow[oc * plane + x] = (static_cast<float>(px[ch]) - mean[oc])
+                               * stdinv[oc];
       }
     }
   }
 }
 
-// Batched variant: one call finishes a whole batch with OpenMP threads.
+// Batched variant: one ctypes call finishes a whole batch (OpenMP when
+// cores exist; on a 1-core host it simply amortizes call overhead).
 void mxtpu_augment_batch(const uint8_t** srcs, const int64_t* hs,
                          const int64_t* ws, int64_t c,
                          const int64_t* crop_ys, const int64_t* crop_xs,
                          int64_t out_h, int64_t out_w, const int* mirrors,
                          const float* mean, const float* stdinv, float* dst,
-                         int64_t n) {
+                         int64_t n, int channel_reverse) {
 #pragma omp parallel for schedule(static)
   for (int64_t i = 0; i < n; ++i) {
     mxtpu_augment_to_chw(srcs[i], hs[i], ws[i], c, crop_ys[i], crop_xs[i],
                          out_h, out_w, mirrors[i], mean, stdinv,
-                         dst + i * c * out_h * out_w);
+                         dst + i * c * out_h * out_w, channel_reverse);
   }
 }
 

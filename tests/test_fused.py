@@ -1,0 +1,203 @@
+"""Fused public train path: Module.fit / Trainer.step must run as one
+donated XLA program AND match the unfused reference semantics exactly.
+
+This is the round-3 contract (bulk-exec + fused optimizer parity with
+reference `graph_executor.cc:1194-1316` / `optimizer_op.cc`): the numbers a
+user gets from the fast path are the numbers the per-op path produces.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, fused, gluon, io, nd, sym
+
+
+def _make_symbol():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _data(n=64, d=16, k=4):
+    rng = np.random.RandomState(0)
+    return rng.randn(n, d).astype("f4"), \
+        rng.randint(0, k, n).astype("f4")
+
+
+def _run_module(fused_on, optimizer, opt_params, contexts=None, steps=6,
+                metric_name="acc"):
+    os.environ["MXNET_FUSED_TRAIN_STEP"] = "1" if fused_on else "0"
+    try:
+        np.random.seed(7)
+        mx.random.seed(7)
+        X, y = _data()
+        it = io.NDArrayIter(X, y, batch_size=32, shuffle=False,
+                            label_name="softmax_label")
+        mod = mx.mod.Module(_make_symbol(),
+                            context=contexts or mx.cpu())
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod.init_params(mx.initializer.Xavier())
+        mod.init_optimizer(kvstore="device", optimizer=optimizer,
+                           optimizer_params=opt_params)
+        metric = mx.metric.create(metric_name)
+        batches = list(it)
+        for s in range(steps):
+            mod.fit_step(batches[s % len(batches)], metric)
+        args, _ = mod.get_params()
+        return ({k: v.asnumpy() for k, v in args.items()},
+                dict(metric.get_name_value()), mod)
+    finally:
+        os.environ.pop("MXNET_FUSED_TRAIN_STEP", None)
+
+
+@pytest.mark.parametrize("optimizer,opt_params", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-3}),
+    ("adam", {"learning_rate": 0.01}),
+    ("rmsprop", {"learning_rate": 0.01}),
+    ("ftml", {"learning_rate": 0.01}),
+    ("nag", {"learning_rate": 0.05, "momentum": 0.9}),
+])
+def test_fused_matches_unfused(optimizer, opt_params):
+    a, ma, mod = _run_module(True, optimizer, opt_params)
+    b, mb, _ = _run_module(False, optimizer, opt_params)
+    assert mod._fused_step is not None and not mod._fused_step.broken, \
+        "fused step must actually engage"
+    for k in a:
+        np.testing.assert_allclose(a[k], b[k], rtol=2e-5, atol=2e-6,
+                                   err_msg=k)
+    for k in ma:
+        assert abs(ma[k] - mb[k]) < 1e-6, (k, ma, mb)
+
+
+def test_fused_multi_device_matches_single():
+    ctxs = [mx.cpu(i) for i in range(4)]
+    a, ma, mod = _run_module(True, "sgd",
+                             {"learning_rate": 0.1, "momentum": 0.9},
+                             contexts=ctxs)
+    assert mod._fused_step is not None and not mod._fused_step.broken
+    b, mb, _ = _run_module(True, "sgd",
+                           {"learning_rate": 0.1, "momentum": 0.9})
+    for k in a:
+        np.testing.assert_allclose(a[k], b[k], rtol=1e-5, atol=1e-6,
+                                   err_msg=k)
+    assert ma == mb
+
+
+def test_fused_lr_scheduler_is_dynamic():
+    """A per-step lr schedule must take effect WITHOUT retriggering
+    compilation (lr is a traced input, not a baked constant)."""
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    a, _, mod = _run_module(True, "sgd",
+                            {"learning_rate": 0.2, "lr_scheduler": sched})
+    sched2 = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    b, _, _ = _run_module(False, "sgd",
+                          {"learning_rate": 0.2, "lr_scheduler": sched2})
+    assert mod._fused_step is not None and not mod._fused_step.broken
+    for k in a:
+        np.testing.assert_allclose(a[k], b[k], rtol=2e-5, atol=1e-6,
+                                   err_msg=k)
+
+
+def test_fused_metric_composite_in_graph():
+    comp = mx.metric.CompositeEvalMetric(
+        metrics=[mx.metric.Accuracy(), mx.metric.CrossEntropy()])
+    os.environ["MXNET_FUSED_TRAIN_STEP"] = "1"
+    try:
+        np.random.seed(7)
+        mx.random.seed(7)
+        X, y = _data()
+        it = io.NDArrayIter(X, y, batch_size=32, shuffle=False,
+                            label_name="softmax_label")
+        mod = mx.mod.Module(_make_symbol(), context=mx.cpu())
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod.init_params(mx.initializer.Xavier())
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.05})
+        batches = list(it)
+        # host-side reference accumulation on identical outputs
+        ref_acc, ref_ce = mx.metric.Accuracy(), mx.metric.CrossEntropy()
+        for b in batches[:4]:
+            mod.fit_step(b, comp)
+            ref_acc.update(b.label, mod.get_outputs())
+            ref_ce.update(b.label, mod.get_outputs())
+        got = dict(comp.get_name_value())
+        assert abs(got["accuracy"] - ref_acc.get()[1]) < 1e-6
+        assert abs(got["cross-entropy"] - ref_ce.get()[1]) < 1e-4
+        assert mod._fused_step is not None and not mod._fused_step.broken
+    finally:
+        os.environ.pop("MXNET_FUSED_TRAIN_STEP", None)
+
+
+def test_fused_optimizer_state_save_load_roundtrip():
+    a, _, mod = _run_module(True, "adam", {"learning_rate": 0.01}, steps=3)
+    assert mod._fused_step is not None and not mod._fused_step.broken
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        f = os.path.join(d, "opt.states")
+        mod.save_optimizer_states(f)
+        mod.load_optimizer_states(f)
+    # states survived the round trip and training continues
+    X, y = _data()
+    it = io.NDArrayIter(X, y, batch_size=32, label_name="softmax_label")
+    m = mx.metric.create("acc")
+    mod.fit_step(next(iter(it)), m)
+    assert not mod._fused_step.broken
+
+
+def test_trainer_fused_update_matches_manual_sgd():
+    """gluon.Trainer.step applies every update in ONE program
+    (fused.FusedOptimizer) and must equal hand-computed SGD-momentum."""
+    np.random.seed(3)
+    mx.random.seed(3)
+    net = gluon.nn.Dense(4, in_units=8)
+    net.initialize(mx.initializer.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    x = nd.array(np.random.randn(16, 8).astype("f4"))
+    params = {p.name: p for p in net.collect_params().values()}
+    ref = {k: (p.data().asnumpy().copy(),
+               np.zeros_like(p.data().asnumpy()))
+           for k, p in params.items()}
+    for _ in range(3):
+        with autograd.record():
+            out = net(x)
+            loss = (out * out).sum()
+        loss.backward()
+        trainer.step(1)
+        for k, p in params.items():
+            w, mom = ref[k]
+            g = p.grad().asnumpy()
+            mom = 0.9 * mom - 0.1 * g
+            w = w + mom
+            ref[k] = (w, mom)
+    assert trainer._fused is not None and not trainer._fused[0]._broken, \
+        "Trainer must use the fused multi-tensor apply"
+    for k, p in params.items():
+        np.testing.assert_allclose(p.data().asnumpy(), ref[k][0],
+                                   rtol=1e-5, atol=1e-6, err_msg=k)
+
+
+def test_fused_optimizer_fallback_is_safe():
+    """An untraceable optimizer must fall back to the per-parameter path
+    and still produce the correct result."""
+
+    @mx.optimizer.register
+    class HostRng(mx.optimizer.Optimizer):
+        def update(self, index, weight, grad, state):
+            self._update_count(index)
+            # host-side numpy draw: cannot trace -> must fall back
+            noise = float(np.random.RandomState(0).rand())
+            weight -= self._get_lr(index) * (grad + 0 * noise)
+
+    opt = HostRng(learning_rate=0.5)
+    fo = fused.FusedOptimizer(opt)
+    w = nd.array(np.ones(4, "f4"))
+    g = nd.array(np.full(4, 2.0, "f4"))
+    fo([0], [w], [g], [None])
+    np.testing.assert_allclose(w.asnumpy(), np.zeros(4), atol=1e-6)
+    del mx.optimizer.Optimizer.opt_registry["hostrng"]

@@ -87,16 +87,21 @@ def test_native_augment_matches_numpy():
     stdinv = (1.0 / np.array([58.4, 57.1, 57.4], np.float32))
     for mirror in (0, 1):
         out = np.empty((3, 32, 32), np.float32)
-        lib.mxtpu_augment_to_chw(
-            img.ctypes.data_as(ctypes.c_void_p), 40, 50, 3, 5, 7, 32, 32,
-            mirror, mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-            stdinv.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
-        crop = img[5:5 + 32, 7:7 + 32]
-        if mirror:
-            crop = crop[:, ::-1]
-        ref = ((crop.astype(np.float32) - mean) * stdinv).transpose(2, 0, 1)
-        np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-5)
+        for reverse in (0, 1):
+            out = np.empty((3, 32, 32), np.float32)
+            lib.mxtpu_augment_to_chw(
+                img.ctypes.data_as(ctypes.c_void_p), 40, 50, 3, 5, 7, 32, 32,
+                mirror, mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                stdinv.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), reverse)
+            crop = img[5:5 + 32, 7:7 + 32]
+            if reverse:
+                crop = crop[:, :, ::-1]
+            if mirror:
+                crop = crop[:, ::-1]
+            ref = ((crop.astype(np.float32) - mean) * stdinv) \
+                .transpose(2, 0, 1)
+            np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-5)
 
 
 def test_record_iter_delivers_all_samples(tmp_path):
