@@ -284,16 +284,17 @@ def _pure_jax_resnet50(batch, image, dtype):
         h = h.mean((2, 3)).astype(jnp.float32)
         return h @ p["fc.w"].astype(jnp.float32).T + p["fc.b"], new_aux
 
-    def cast(a):
-        return a.astype(dtype) if a.dtype == np.float32 and \
-            dtype != "float32" else a
-
-    w = {k: jnp.asarray(cast(v)) for k, v in params.items()}
+    # master weights and momentum stay fp32; low-precision lanes cast the
+    # weights to `dtype` inside the step (exactly the framework's
+    # multi-precision semantics, so the ratio compares equal work)
+    low = dtype != "float32"
+    w = {k: jnp.asarray(v) for k, v in params.items()}
     m = {k: jnp.zeros_like(v) for k, v in w.items()}
     aux = {k: jnp.asarray(v) for k, v in auxs.items()}
 
     def loss_fn(w, img, label, aux):
-        logits, new_aux = forward(w, aux, img)
+        wl = {k: v.astype(dtype) for k, v in w.items()} if low else w
+        logits, new_aux = forward(wl, aux, img)
         logp = jax.nn.log_softmax(logits)
         ll = jnp.take_along_axis(logp, label[:, None], -1)
         return -jnp.mean(ll), new_aux

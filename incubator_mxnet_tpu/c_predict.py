@@ -1,0 +1,81 @@
+"""Python backend for the C predict ABI (`src/c_predict_api.cc`).
+
+The reference ships a standalone inference ABI
+(`include/mxnet/c_predict_api.h:78-200`: create a predictor from saved
+symbol JSON + params bytes, set inputs, forward, read outputs) used by the
+amalgamation/mobile builds.  The TPU build keeps the same surface: the C
+shared library embeds CPython and drives THIS module, whose predictor
+binds the symbol through the ordinary executor (one XLA program per
+signature), so C callers get the same compiled inference path as Python
+callers.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Predictor", "create"]
+
+
+class Predictor:
+    def __init__(self, symbol_json, param_bytes, dev_type, dev_id,
+                 input_shapes):
+        from . import context as ctx_mod
+        from . import symbol as sym_mod
+        from .compat.mxnet_params import load_params
+        from .executor import Executor
+
+        ctx = (ctx_mod.cpu(dev_id) if dev_type == 1 else
+               ctx_mod.tpu(dev_id))
+        self._ctx = ctx
+        sym = sym_mod.load_json(symbol_json)
+        arg_names = sym.list_arguments()
+        aux_names = sym.list_auxiliary_states()
+        self._input_names = list(input_shapes)
+        self._exec = Executor._simple_bind(sym, ctx, "null", None,
+                                           dict(input_shapes))
+        params = load_params(param_bytes)
+        args, auxs = {}, {}
+        for k, v in params.items():
+            if ":" in k:
+                kind, name = k.split(":", 1)
+                (args if kind == "arg" else auxs)[name] = v
+            elif k in arg_names:
+                args[k] = v
+            elif k in aux_names:
+                auxs[k] = v
+        self._exec.copy_params_from(args, auxs, allow_extra_params=True)
+        self._outputs = None
+
+    def output_count(self):
+        return len(self._exec._symbol.list_outputs())
+
+    def set_input(self, name, flat_f32):
+        tgt = self._exec.arg_dict[name]
+        arr = np.asarray(flat_f32, dtype=np.float32).reshape(tgt.shape)
+        from .ndarray.ndarray import array
+        self._exec.arg_dict[name]._set_data(
+            array(arr, ctx=self._ctx, dtype=tgt.dtype)._data)
+
+    def set_input_bytes(self, name, view):
+        """C ABI path: `view` is a read-only memoryview over float32."""
+        self.set_input(name, np.frombuffer(view, dtype=np.float32))
+
+    def forward(self):
+        self._outputs = self._exec.forward(is_train=False)
+
+    def output_shape(self, index):
+        if self._outputs is None:
+            self.forward()
+        return tuple(self._outputs[index].shape)
+
+    def output(self, index):
+        """Flat float32 bytes of output `index`."""
+        out = self._outputs[index].asnumpy().astype(np.float32, copy=False)
+        return np.ascontiguousarray(out).tobytes()
+
+
+def create(symbol_json, param_bytes, dev_type, dev_id, input_names,
+           input_shapes):
+    """ABI entry: input_names list[str], input_shapes list[tuple]."""
+    return Predictor(symbol_json, param_bytes, dev_type, dev_id,
+                     dict(zip(input_names, [tuple(s) for s in input_shapes])))

@@ -91,18 +91,22 @@ class Context:
 
 def _accel_devices():
     import jax
-    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    # LOCAL devices only: under jax.distributed, jax.devices() includes
+    # other processes' (non-addressable) devices — a context must never
+    # resolve to a device this process can't write
+    devs = [d for d in jax.local_devices() if d.platform != "cpu"]
     if devs:
         return devs
-    return jax.devices()  # CPU fallback (virtual-device test mesh)
+    return jax.local_devices()  # CPU fallback (virtual-device test mesh)
 
 
 def _cpu_devices():
     import jax
     try:
-        return jax.devices("cpu")
+        devs = [d for d in jax.local_devices() if d.platform == "cpu"]
+        return devs or jax.local_devices()
     except RuntimeError:
-        return jax.devices()
+        return jax.local_devices()
 
 
 def _resolve_device(ctx):

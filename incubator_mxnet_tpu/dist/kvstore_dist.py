@@ -16,7 +16,8 @@ import os
 import pickle
 
 from ..base import MXNetError
-from ..kvstore import KVStoreTPU, _normalize, _normalize_push, _key
+from ..kvstore import (KVStoreTPU, _normalize, _normalize_push, _key,
+                       _updater_key)
 from .transport import Channel
 
 
@@ -35,6 +36,23 @@ class KVStoreDist(KVStoreTPU):
         self._num_workers = reply["num_workers"]
         self._push_count = {}    # key -> completed sync pushes by this worker
         self._update_on_kvstore = False
+        # collective data plane: gradients all-reduce over the global device
+        # mesh (ICI/DCN via XLA collectives — the reference's NCCL/ps-lite
+        # data role done the TPU way, SURVEY §2.4); the socket server is
+        # then control plane only (registration, init, barriers).  sync
+        # mode only: async semantics need a mailbox, which is the server.
+        self._collective = None
+        if self._sync and self._num_workers > 1 and \
+                os.environ.get("MXNET_KVSTORE_COLLECTIVE", "1") != "0":
+            try:
+                self._collective = _CollectivePlane(self._rank,
+                                                    self._num_workers)
+            except Exception as e:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "collective data plane unavailable (%s); gradients go "
+                    "through the parameter server", str(e)[:200])
+                self._collective = None
 
     # -- identity ------------------------------------------------------------
     @property
@@ -59,10 +77,52 @@ class KVStoreDist(KVStoreTPU):
         self._barrier()
         # keep a local copy so pull() can place results on local devices
         for k, v in zip(keys, values):
-            self._store[_key(k)] = v.copyto(self._store_ctx)
+            if self._collective is not None:
+                # broadcast rank 0's init over the mesh so every worker's
+                # local copy is IDENTICAL (the socket path trusts each
+                # worker to have initialized equally; the collective path
+                # enforces it)
+                import jax.numpy as jnp
+                src = v._data if self._rank == 0 else \
+                    jnp.zeros(v.shape, v.dtype)
+                from ..ndarray.ndarray import NDArray
+                summed = self._collective.allreduce(src)
+                self._store[_key(k)] = NDArray(summed, ctx=self._store_ctx)
+            else:
+                self._store[_key(k)] = v.copyto(self._store_ctx)
+
+    def _collective_push(self, sk, vals):
+        """Sync push over XLA collectives: local chip reduce, then ONE
+        global all-reduce; optimizer (if shipped) applies identically on
+        every worker; zero gradient bytes on the socket."""
+        from ..ndarray.ndarray import NDArray
+        merged = self._reduce(vals)
+        if self._compression is not None:
+            # error-feedback quantization BEFORE the collective: summing
+            # quantized terms matches the server-side accumulate semantics
+            merged = self._compress(sk, merged)
+        # allreduce already returns a fresh worker-local array; wrap without
+        # another device copy
+        summed = self._collective.allreduce(merged._data)
+        summed_nd = NDArray(summed, ctx=self._store_ctx)
+        if self._updater is not None:
+            self._updater(_updater_key(sk), summed_nd, self._store[sk])
+        else:
+            self._store[sk] = summed_nd
+        self._record_key_mesh(sk, vals)
 
     def push(self, key, value, priority=0):
         keys, values = _normalize_push(key, value)
+        if self._collective is not None:
+            for k, vals in zip(keys, values):
+                sk = _key(k)
+                if sk not in self._store:
+                    raise MXNetError(f"Key {k} has not been initialized")
+                self._collective_push(sk, vals)
+            return
+        self._socket_push(keys, values)
+
+    def _socket_push(self, keys, values):
         for k, vals in zip(keys, values):
             sk = _key(k)
             if sk not in self._store:
@@ -90,6 +150,12 @@ class KVStoreDist(KVStoreTPU):
         if out is None:
             raise MXNetError("pull requires out=")
         keys, outs = _normalize_push(key, out)
+        if self._collective is not None:
+            # the all-reduce left an identical fresh value on every worker;
+            # fan out locally, no socket round trip
+            for k, tgt_list in zip(keys, outs):
+                super().pull(k, out=tgt_list)
+            return
         for k, tgt_list in zip(keys, outs):
             sk = _key(k)
             reply = self._chan.request(
@@ -108,9 +174,20 @@ class KVStoreDist(KVStoreTPU):
     # -- control plane -------------------------------------------------------
     def set_optimizer(self, optimizer):
         """Ship the optimizer to the server (reference pickles it through
-        MXKVStoreSendCommmandToServers, `python/mxnet/kvstore.py:535`)."""
+        MXKVStoreSendCommmandToServers, `python/mxnet/kvstore.py:535`).
+
+        Collective mode: the server never sees gradients, so the optimizer
+        runs worker-side instead — every worker applies the identical
+        update to the identical all-reduced gradient (the 'sharded server'
+        role collapses into replicated local application; ZeRO-style
+        sharded application lives in `parallel/zero.py`)."""
         self._optimizer = optimizer
         self._update_on_kvstore = True
+        if self._collective is not None:
+            from .. import optimizer as _opt
+            self._updater = _opt.get_updater(optimizer)
+            self._barrier()
+            return
         if self._rank == 0:
             reply = self._chan.request(
                 {"cmd": "set_optimizer",
@@ -138,3 +215,50 @@ def _check(reply):
     if "error" in reply:
         raise MXNetError(reply["error"])
     return reply
+
+
+class _CollectivePlane:
+    """Global all-reduce over one representative device per worker process.
+
+    Bootstraps `jax.distributed` (dist/collective.py) and builds a 1-D
+    mesh with one device column per worker; `allreduce` sums each worker's
+    contribution with ONE XLA collective riding ICI/DCN (Gloo on the CPU
+    test mesh).  This is the data plane the reference implements with
+    range-sharded ps-lite servers (`kvstore_dist.h:44-412`) — on TPU the
+    wires are the interconnect and the server keeps only control duties.
+    """
+
+    def __init__(self, rank, num_workers):
+        import jax
+        import numpy as np
+        from . import collective
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        collective.init_process_group(num_processes=num_workers,
+                                      process_id=rank)
+        if jax.process_count() != num_workers:
+            raise RuntimeError(
+                f"jax process_count {jax.process_count()} != "
+                f"num_workers {num_workers}")
+        reps = []
+        for p in range(num_workers):
+            devs = [d for d in jax.devices() if d.process_index == p]
+            if not devs:
+                raise RuntimeError(f"no devices visible for process {p}")
+            reps.append(devs[0])
+        self._mesh = Mesh(np.array(reps), ("workers",))
+        self._local_dev = reps[jax.process_index()]
+        self._in_sharding = NamedSharding(self._mesh, P("workers"))
+        self._out_sharding = NamedSharding(self._mesh, P())
+        self._sum = jax.jit(lambda x: x.sum(axis=0),
+                            out_shardings=self._out_sharding)
+
+    def allreduce(self, arr):
+        """Sum `arr` across all workers; returns the replicated result's
+        local view (a jax array on this worker's device)."""
+        import jax
+        local = jax.device_put(arr, self._local_dev)[None]
+        garr = jax.make_array_from_single_device_arrays(
+            (self._mesh.size,) + tuple(local.shape[1:]),
+            self._in_sharding, [local])
+        out = self._sum(garr)
+        return [s.data for s in out.addressable_shards][0]

@@ -215,25 +215,32 @@ class Executor:
                          allow_extra_params=False):
         """Reference `executor.py copy_params_from`.  Each array keeps ITS
         OWN context: group2ctx-placed parameters stay on their group's
-        device (that residency is the point of the feature)."""
-        for k, v in arg_params.items():
-            if k in self.arg_dict:
-                tgt = self.arg_dict[k]
-                src = v._data if isinstance(v, NDArray) else jnp.asarray(v)
-                tgt._data = jax.device_put(src.astype(tgt.dtype),
-                                           tgt.context.jax_device)
-            elif not allow_extra_params:
-                raise MXNetError(f"Found name {k} not in arguments")
-        if aux_params:
-            for k, v in aux_params.items():
-                if k in self.aux_dict:
-                    tgt = self.aux_dict[k]
-                    src = v._data if isinstance(v, NDArray) else \
-                        jnp.asarray(v)
-                    tgt._data = jax.device_put(src.astype(tgt.dtype),
-                                               tgt.context.jax_device)
+        device (that residency is the point of the feature).  All values
+        move in ONE batched transfer — per-param round trips dominate on a
+        remote chip."""
+        plan = []   # (target NDArray, host/src value)
+
+        def gather(params, table, what):
+            for k, v in params.items():
+                if k in table:
+                    tgt = table[k]
+                    src = v._data if isinstance(v, NDArray) else v
+                    if hasattr(src, "astype") and src.dtype != tgt.dtype:
+                        src = src.astype(tgt.dtype)
+                    plan.append((tgt, src))
                 elif not allow_extra_params:
-                    raise MXNetError(f"Found name {k} not in aux states")
+                    raise MXNetError(f"Found name {k} not in {what}")
+
+        gather(arg_params, self.arg_dict, "arguments")
+        if aux_params:
+            gather(aux_params, self.aux_dict, "aux states")
+        if plan:
+            moved = jax.device_put(
+                [_np.asarray(s) if isinstance(s, (list, tuple)) else s
+                 for _, s in plan],
+                [t.context.jax_device for t, _ in plan])
+            for (tgt, _), v in zip(plan, moved):
+                tgt._data = v
 
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
         """Re-bind with new shapes (reference `executor.py reshape`); jit
@@ -296,22 +303,34 @@ class Executor:
                     if g is not None and g in group2ctx:
                         var_group[node.name] = group2ctx[g]
 
+        # allocate every array in ONE batched transfer: per-array
+        # device_put costs a host<->device round trip each — ~300 arrays
+        # over a remote-chip link dominates bind time otherwise
+        plan = []   # (host_buffer, device) in creation order
+
         def make(shape, name):
             dt = np_dtype(type_dict.get(name, _np.float32))
             dev_ctx = var_group.get(name, ctx)
-            return NDArray(jax.device_put(jnp.zeros(shape, dt),
-                                          dev_ctx.jax_device), ctx=dev_ctx)
+            plan.append((_np.zeros(shape, dt), dev_ctx.jax_device))
+            return dev_ctx
 
-        args = [make(s, n) for n, s in zip(arg_names, arg_shapes)]
+        arg_ctxs = [make(s, n) for n, s in zip(arg_names, arg_shapes)]
         if isinstance(grad_req, str):
             reqs = {n: grad_req for n in arg_names}
         elif isinstance(grad_req, (list, tuple)):
             reqs = dict(zip(arg_names, grad_req))
         else:
             reqs = {n: grad_req.get(n, "null") for n in arg_names}
-        grads = [make(s, n) if reqs.get(n, "null") != "null" else None
-                 for n, s in zip(arg_names, arg_shapes)]
-        auxs = [make(s, n) for n, s in zip(aux_names, aux_shapes)]
+        grad_ctxs = [make(s, n) if reqs.get(n, "null") != "null" else None
+                     for n, s in zip(arg_names, arg_shapes)]
+        aux_ctxs = [make(s, n) for n, s in zip(aux_names, aux_shapes)]
+
+        bufs = jax.device_put([b for b, _ in plan], [d for _, d in plan])
+        it = iter(bufs)
+        args = [NDArray(next(it), ctx=c) for c in arg_ctxs]
+        grads = [NDArray(next(it), ctx=c) if c is not None else None
+                 for c in grad_ctxs]
+        auxs = [NDArray(next(it), ctx=c) for c in aux_ctxs]
         return Executor(symbol, ctx, args, grads, reqs, auxs)
 
     @staticmethod

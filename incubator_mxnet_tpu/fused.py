@@ -103,6 +103,33 @@ class _TMap(dict):
         return NDArray(self._t_vec[self._pos[index]], ctx=self._ctx)
 
 
+def _constrain_like(value, sharding):
+    """Pin a traced output (pytree) to the input arrays' NamedShardings so
+    a donated update hands back buffers with the SAME layout (GSPMD would
+    otherwise pick its own, silently re-laying-out TP/ZeRO-sharded
+    tensors)."""
+    import jax
+    from jax.sharding import NamedSharding
+    if value is None:
+        return None
+    if isinstance(value, (tuple, list)):
+        return tuple(_constrain_like(v, s)
+                     for v, s in zip(value, sharding))
+    if isinstance(sharding, NamedSharding):
+        return jax.lax.with_sharding_constraint(value, sharding)
+    return value
+
+
+def _sharding_tree(x):
+    """Mirror an NDArray-state pytree with each leaf's current sharding."""
+    if x is None:
+        return None
+    if isinstance(x, (tuple, list)):
+        return tuple(_sharding_tree(v) for v in x)
+    data = getattr(x, "_data", x)
+    return getattr(data, "sharding", None)
+
+
 def _apply_traced(opt, indices, ws, gs, ss, ctx, lr_vec, wd_vec, t_vec,
                   rescale):
     """Trace the PUBLIC optimizer over all parameters at once.
@@ -138,21 +165,24 @@ def _apply_traced(opt, indices, ws, gs, ss, ctx, lr_vec, wd_vec, t_vec,
 
 
 class _AotCall:
-    """AOT trace→compile→execute wrapper around a donating jit.
+    """Validate-then-dispatch wrapper around a donating jit.
 
     Donation deletes the caller's persistent buffers (weights, optimizer
     state) at dispatch — so a jit call whose TRACE fails can destroy the
-    arrays the fallback path then needs.  Lowering and compiling first
-    (`jax.jit(...).lower(args).compile()`) consumes nothing; only the
-    compiled executable — which can no longer fail to trace — touches the
-    donated buffers.  One executable is kept per input signature
-    (shape/dtype/sharding), mirroring CachedOp's signature-keyed cache
-    (reference `cached_op.cc:265 SetForwardGraph`).
+    arrays the fallback path then needs.  For each new input signature
+    (shape/dtype/sharding — CachedOp's cache key, reference
+    `cached_op.cc:265 SetForwardGraph`), the function is first LOWERED
+    without executing (`jit.lower(*args)` consumes nothing): any
+    untraceable construct raises here, with the buffers intact.  Execution
+    then goes through the normal jit dispatch, which keeps the C++
+    fast path AND the persistent compilation cache (an explicit AOT
+    `lower().compile()` would bypass that cache and re-pay the multi-minute
+    XLA compile every process).
     """
 
     def __init__(self, jit_fn):
         self._jit = jit_fn
-        self._execs = {}
+        self._validated = set()
 
     @staticmethod
     def _sig(args):
@@ -166,11 +196,10 @@ class _AotCall:
 
     def __call__(self, *args):
         sig = self._sig(args)
-        exe = self._execs.get(sig)
-        if exe is None:
-            exe = self._jit.lower(*args).compile()
-            self._execs[sig] = exe
-        return exe(*args)
+        if sig not in self._validated:
+            self._jit.lower(*args)  # trace check only; nothing is donated
+            self._validated.add(sig)
+        return self._jit(*args)
 
 
 def _no_rng():
@@ -218,9 +247,14 @@ class FusedOptimizer:
         opt = self._opt
 
         def step(ws, gs, ss, lr_vec, wd_vec, t_vec, rescale):
-            return _apply_traced(opt, self._call_indices, ws, gs, ss,
-                                 self._call_ctx, lr_vec, wd_vec, t_vec,
-                                 rescale)
+            new_ws, new_ss = _apply_traced(opt, self._call_indices, ws, gs,
+                                           ss, self._call_ctx, lr_vec,
+                                           wd_vec, t_vec, rescale)
+            new_ws = [_constrain_like(w, s)
+                      for w, s in zip(new_ws, self._call_w_shardings)]
+            new_ss = tuple(_constrain_like(s, sh)
+                           for s, sh in zip(new_ss, self._call_s_shardings))
+            return new_ws, new_ss
 
         self._jit = _AotCall(jax.jit(step, donate_argnums=(0, 2)))
 
@@ -255,6 +289,8 @@ class FusedOptimizer:
         ss = tuple(_state_data(s) for s in states)
         self._call_indices = list(indices)
         self._call_ctx = weights[0].context
+        self._call_w_shardings = [getattr(w, "sharding", None) for w in ws]
+        self._call_s_shardings = tuple(_sharding_tree(s) for s in states)
         # counts were already advanced; replay through the raw update on
         # fallback (not update_multi_precision, which would double-count)
         try:
@@ -352,31 +388,54 @@ class FusedTrainStep:
     # Every call normalizes buffer shardings (a no-op once placed): other
     # code paths — set_params at epoch boundaries, checkpoint loads — may
     # legally repoint these NDArrays at single-device arrays between steps.
-    def _place_nd(self, a):
-        import jax
+    def _collect_misplaced(self, a, out):
         if getattr(a._data, "sharding", None) != self._rep_sharding:
-            a._set_data(jax.device_put(a._data, self._rep_sharding))
+            out.append(a)
 
-    def _place_state(self, s):
+    def _place_state(self, s, out):
         if isinstance(s, NDArray):
-            self._place_nd(s)
+            self._collect_misplaced(s, out)
         elif isinstance(s, (tuple, list)):
             for x in s:
-                self._place_state(x)
+                self._place_state(x, out)
 
     def _place_all(self):
+        import jax
+        from . import engine as _engine
         exec0 = self._exec0
-        for n in self._param_names + self._fixed_names:
-            self._place_nd(exec0.arg_dict[n])
-        for n in self._aux_names:
-            self._place_nd(exec0.aux_dict[n])
         upd = self._updater
-        for i, n in zip(self._indices, self._param_names):
-            if i not in upd.states:
-                upd.states[i] = self._opt.create_state_multi_precision(
-                    i, exec0.arg_dict[n])
-                upd.states_synced[i] = True
-            self._place_state(upd.states[i])
+        need = [(i, n) for i, n in zip(self._indices, self._param_names)
+                if i not in upd.states]
+        if need:
+            # optimizer-state creation without per-parameter dispatches:
+            # fetch every needed weight in ONE batched host read, run the
+            # optimizer's create_state on host-staged shells under a bulk
+            # scope (zeros/astype/copy stay host-side), and let the
+            # placement pass below upload everything in one transfer
+            host_ws = jax.device_get(
+                [exec0.arg_dict[n]._data for _, n in need])
+            with _engine.bulk(1 << 16):
+                for (i, n), hw in zip(need, host_ws):
+                    tgt = exec0.arg_dict[n]
+                    shell = NDArray(_np.asarray(hw), ctx=tgt.context)
+                    _engine.stage(shell)
+                    upd.states[i] = self._opt.create_state_multi_precision(
+                        i, shell)
+                    upd.states_synced[i] = True
+                    _engine.unstage(shell)  # scratch; never uploaded
+        todo = []
+        for n in self._param_names + self._fixed_names:
+            self._collect_misplaced(exec0.arg_dict[n], todo)
+        for n in self._aux_names:
+            self._collect_misplaced(exec0.aux_dict[n], todo)
+        for i in self._indices:
+            self._place_state(upd.states[i], todo)
+        if todo:
+            # ONE batched transfer instead of a round trip per array
+            moved = jax.device_put([a._data for a in todo],
+                                   self._rep_sharding)
+            for a, v in zip(todo, moved):
+                a._set_data(v)
 
     # -- the traced step -----------------------------------------------------
     def _build(self, metric_fns):
@@ -421,6 +480,14 @@ class FusedTrainStep:
             (grads,) = vjp(cts)
             new_ws, new_ss = _apply_traced(opt, indices, ws, grads, ss, ctx,
                                            lr_vec, wd_vec, t_vec, rescale)
+            # keep the persistent carries in their input layout (replicated
+            # for DP; whatever the user sharded for TP/ZeRO)
+            new_ws = [_constrain_like(w, s)
+                      for w, s in zip(new_ws, self._call_w_shardings)]
+            new_ss = tuple(_constrain_like(s, sh)
+                           for s, sh in zip(new_ss, self._call_s_shardings))
+            new_aux = tuple(_constrain_like(a, s)
+                            for a, s in zip(new_aux, self._call_a_shardings))
             labels = inputs[len(inputs) - n_label:] if n_label else ()
             new_mcarry = []
             for (fn, _), (msum, mnum) in zip(metric_fns, mcarry):
@@ -474,36 +541,54 @@ class FusedTrainStep:
         data = list(data_batch.data) + list(data_batch.label or [])
         if len(data) != len(self._input_names):
             return False
-        inputs = []
-        for v, name in zip(data, self._input_names):
-            raw = v._data if isinstance(v, NDArray) else _np.asarray(v)
-            tgt = exec0.arg_dict[name]
-            if hasattr(raw, "astype") and raw.dtype != tgt.dtype and \
-                    name not in self._mod._exec_group.label_names:
-                raw = raw.astype(tgt.dtype)
-            inputs.append(jax.device_put(raw, self._data_sharding))
-        fixed = [exec0.arg_dict[n]._data for n in self._fixed_names]
+        ndev = len(self._contexts)
+        if ndev > 1 and any(
+                (v.shape[0] if hasattr(v, "shape") and v.shape else 0) % ndev
+                for v in data):
+            # e.g. a partial tail batch: not shardable over the mesh —
+            # this batch takes the unfused path, the step stays usable
+            return False
+        try:
+            inputs = []
+            for v, name in zip(data, self._input_names):
+                raw = v._data if isinstance(v, NDArray) else _np.asarray(v)
+                tgt = exec0.arg_dict[name]
+                if hasattr(raw, "astype") and raw.dtype != tgt.dtype and \
+                        name not in self._mod._exec_group.label_names:
+                    raw = raw.astype(tgt.dtype)
+                inputs.append(jax.device_put(raw, self._data_sharding))
+            fixed = [exec0.arg_dict[n]._data for n in self._fixed_names]
+            ws = [exec0.arg_dict[n]._data for n in self._param_names]
+            states = [self._updater.states[i] for i in self._indices]
+            ss = tuple(_state_data(s) for s in states)
+            auxs = [exec0.aux_dict[n]._data for n in self._aux_names]
+            self._call_w_shardings = [getattr(w, "sharding", None)
+                                      for w in ws]
+            self._call_s_shardings = tuple(_sharding_tree(s) for s in states)
+            self._call_a_shardings = [getattr(a, "sharding", None)
+                                      for a in auxs]
 
-        ws = [exec0.arg_dict[n]._data for n in self._param_names]
-        states = [self._updater.states[i] for i in self._indices]
-        ss = tuple(_state_data(s) for s in states)
-        auxs = [exec0.aux_dict[n]._data for n in self._aux_names]
+            mcarry = []
+            for fn, m in metric_fns:
+                pend = getattr(m, "_device_totals", None)
+                if pend is None:
+                    import jax.numpy as jnp
+                    pend = (jax.device_put(jnp.zeros((), jnp.float32),
+                                           self._rep_sharding),
+                            jax.device_put(jnp.zeros((), jnp.int32),
+                                           self._rep_sharding))
+                mcarry.append(tuple(pend))
 
-        mcarry = []
-        for fn, m in metric_fns:
-            pend = getattr(m, "_device_totals", None)
-            if pend is None:
-                import jax.numpy as jnp
-                pend = (jax.device_put(jnp.zeros((), jnp.float32),
-                                       self._rep_sharding),
-                        jax.device_put(jnp.zeros((), jnp.int32),
-                                       self._rep_sharding))
-            mcarry.append(tuple(pend))
-
-        if self._key is None:
-            from . import random as _random
-            self._key = jax.device_put(_random.next_key(),
-                                       self._rep_sharding)
+            if self._key is None:
+                from . import random as _random
+                self._key = jax.device_put(_random.next_key(),
+                                           self._rep_sharding)
+        except Exception as e:
+            # placement/staging failure: this batch runs unfused; the
+            # fused step itself stays usable for the next one
+            _log.warning("fused step input staging failed (%s); running "
+                         "this batch unfused", str(e)[:200])
+            return False
 
         opt = self._opt
         # snapshot counts so a failed attempt doesn't double-count the step

@@ -11,8 +11,18 @@ __all__ = ["Trainer"]
 
 
 class Trainer:
+    """Parameter updater (reference `gluon/trainer.py:27`).
+
+    TPU extensions: updates apply as ONE donated XLA program
+    (`fused.FusedOptimizer`); ``zero=mesh`` (or ``(mesh, axis)``) shards
+    every optimizer-state tensor over the mesh's first (or named) axis —
+    ZeRO state partitioning, the mesh reading of the reference's
+    range-sharded parameter servers.  Combine with
+    `parallel.shard_block` for tensor-parallel parameters.
+    """
+
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
-                 compression_params=None, update_on_kvstore=None):
+                 compression_params=None, update_on_kvstore=None, zero=None):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -40,6 +50,9 @@ class Trainer:
         self._kvstore = None
         self._update_on_kvstore = None
         self._fused = None
+        if zero is not None and not isinstance(zero, tuple):
+            zero = (zero, list(zero.shape.keys())[0])
+        self._zero = zero  # (mesh, axis) for sharded optimizer state
 
     def _check_contexts(self):
         contexts = None
@@ -143,11 +156,33 @@ class Trainer:
                     upd.states[i] = \
                         upd.optimizer.create_state_multi_precision(i, arr)
                     upd.states_synced[i] = True
+                    self._place_state(upd.states[i], arr)
                 indices.append(i)
                 ws.append(arr)
                 gs.append(grad)
                 ss.append(upd.states[i])
             self._fused[k](indices, ws, gs, ss)
+
+    def _place_state(self, state, weight):
+        """Lay freshly-created optimizer state out to match the weight's
+        residency: ZeRO-sharded when ``zero=`` was given, replicated on the
+        weight's mesh when the weight is mesh-sharded (mixing mesh weights
+        with single-device state would fail the fused update jit)."""
+        from ..parallel.gluon_bridge import shard_state_for_zero
+        if self._zero is not None:
+            shard_state_for_zero(state, *self._zero)
+            return
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        ws = getattr(weight._data, "sharding", None)
+        if not isinstance(ws, NamedSharding):
+            return
+        rep = NamedSharding(ws.mesh, P())
+        from ..ndarray.ndarray import NDArray
+        for leaf in jax.tree_util.tree_leaves(
+                state, is_leaf=lambda x: isinstance(x, NDArray)):
+            if isinstance(leaf, NDArray):
+                leaf._set_data(jax.device_put(leaf._data, rep))
 
     def save_states(self, fname):
         assert self._optimizer is not None

@@ -241,7 +241,12 @@ class TopKAccuracy(EvalMetric):
         dsum, dnum = 0.0, 0.0
         for label, pred in zip(labels, preds):
             if pred.ndim != 2:
-                continue
+                # raising at trace time makes the fused path fall back to
+                # the host update, which surfaces the shape problem the
+                # same way the reference does (silent skipping would
+                # report NaN accuracy instead)
+                raise ValueError(
+                    f"TopKAccuracy expects 2-D predictions, got {pred.shape}")
             top_k = min(pred.shape[1], self.top_k)
             top = jnp.argsort(pred.astype(jnp.float32), axis=1)[:, -top_k:]
             lab = label.reshape(-1).astype(jnp.int32)

@@ -122,16 +122,24 @@ class Module(BaseModule):
         if initializer is None:
             initializer = Uniform(0.01)
 
-        if self._arg_params is None:
-            self._arg_params = {
-                name: nd.zeros(self._exec_group.execs[0].arg_dict[name].shape,
-                               dtype=self._exec_group.execs[0].arg_dict[name].dtype)
-                for name in self._param_names}
-        if self._aux_params is None:
-            self._aux_params = {
-                name: nd.zeros(self._exec_group.execs[0].aux_dict[name].shape,
-                               dtype=self._exec_group.execs[0].aux_dict[name].dtype)
-                for name in self._aux_names}
+        from .. import engine as _engine
+        # bulk scope: parameter drafts and initializer writes host-stage and
+        # flush as batched transfers — per-array device round trips dominate
+        # init on a remote chip otherwise (reference analogue: deferred
+        # alloc + engine bulk, `include/mxnet/engine.h:308`)
+        with _engine.bulk(1 << 16):
+            if self._arg_params is None:
+                self._arg_params = {
+                    name: nd.zeros(
+                        self._exec_group.execs[0].arg_dict[name].shape,
+                        dtype=self._exec_group.execs[0].arg_dict[name].dtype)
+                    for name in self._param_names}
+            if self._aux_params is None:
+                self._aux_params = {
+                    name: nd.zeros(
+                        self._exec_group.execs[0].aux_dict[name].shape,
+                        dtype=self._exec_group.execs[0].aux_dict[name].dtype)
+                    for name in self._aux_names}
 
         def _impl(desc, arr, cache):
             # desc carries the variable's attr dict (__init__ etc.) — the
@@ -151,12 +159,13 @@ class Module(BaseModule):
                     initializer(desc, arr)
 
         attrs = self._symbol.attr_dict()
-        for name, arr in sorted(self._arg_params.items()):
-            desc = InitDesc(name, attrs.get(name, None))
-            _impl(desc, arr, arg_params)
-        for name, arr in sorted(self._aux_params.items()):
-            desc = InitDesc(name, attrs.get(name, None))
-            _impl(desc, arr, aux_params)
+        with _engine.bulk(1 << 16):
+            for name, arr in sorted(self._arg_params.items()):
+                desc = InitDesc(name, attrs.get(name, None))
+                _impl(desc, arr, arg_params)
+            for name, arr in sorted(self._aux_params.items()):
+                desc = InitDesc(name, attrs.get(name, None))
+                _impl(desc, arr, aux_params)
 
         self.params_initialized = True
         self._params_dirty = False
@@ -238,6 +247,16 @@ class Module(BaseModule):
             batch_size *= kvstore.num_workers
         rescale_grad = 1.0 / batch_size
 
+        # TPU fast path eligibility must be decided BEFORE the kvstore /
+        # updater wiring: when the fused step will own the optimizer, the
+        # kvstore must never get an optimizer installed (a later unfused
+        # update() would then apply it to its own weight copies and pull
+        # weights back as gradients) and idx2name must use the per-device
+        # layout the local updater / fused indices share
+        fusable = self._fusable(kvstore)
+        if fusable:
+            update_on_kvstore = False
+
         idx2name = {}
         if update_on_kvstore:
             idx2name.update(enumerate(self._exec_group.param_names))
@@ -282,17 +301,14 @@ class Module(BaseModule):
         # TPU fast path: compile forward+backward+optimizer+metric into ONE
         # donated XLA program per signature (fused.FusedTrainStep) — the
         # public equivalent of the reference's bulk-exec segments + fused
-        # update ops (`graph_executor.cc:1194-1316`, `optimizer_op.cc`)
+        # update ops (`graph_executor.cc:1194-1316`, `optimizer_op.cc`).
+        # Optimizer state lives in self._updater either way, so the
+        # fused path and the unfused fallback share one state store.
         self._fused_step = None
-        if self._fusable(kvstore):
+        if fusable:
             try:
                 from .. import fused as _fused
-                updater = self._updater or opt.get_updater(optimizer)
-                self._fused_step = _fused.FusedTrainStep(self, updater)
-                # optimizer state now lives in the updater (save/load go
-                # through it, not a kvstore-side optimizer)
-                self._updater = updater
-                self._update_on_kvstore = False
+                self._fused_step = _fused.FusedTrainStep(self, self._updater)
             except Exception as e:  # never block training on the fast path
                 self.logger.warning(
                     "fused train step unavailable (%s); Module.fit uses "

@@ -134,9 +134,20 @@ class NDArray:
         d = np_dtype(dtype)
         if not copy and self.dtype == d:
             return self
+        if isinstance(self._data, _np.ndarray) and _engine.bulk_active():
+            # bulk mode: host-staged value casts on the host; the engine
+            # flush batches the eventual transfer (one dispatch per op
+            # would defeat bulk init/state creation)
+            out = NDArray(self._data.astype(d), ctx=self._ctx)
+            _engine.stage(out)
+            return out
         return _apply_op("Cast", [self], {"dtype": dtype_name(d)})
 
     def copy(self):
+        if isinstance(self._data, _np.ndarray) and _engine.bulk_active():
+            out = NDArray(self._data.copy(), ctx=self._ctx)
+            _engine.stage(out)
+            return out
         return _apply_op("_copy", [self], {})
 
     def copyto(self, other):

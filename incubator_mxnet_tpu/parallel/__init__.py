@@ -17,6 +17,8 @@ and reduction trees, training steps are jit-compiled SPMD programs over a
 * `pipeline.py` — pipeline-parallel microbatch schedule over `pp`
 """
 from .mesh import make_mesh, mesh_axes, local_mesh
+from .gluon_bridge import (shard_block, block_shardings,
+                           shard_state_for_zero, put)
 from .collectives import (all_reduce, all_gather, reduce_scatter, ppermute,
                           broadcast)
 from .data_parallel import data_parallel_step, replicate, unreplicate

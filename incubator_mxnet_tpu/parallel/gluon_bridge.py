@@ -1,0 +1,123 @@
+"""Mesh parallelism through the Gluon front-end.
+
+The reference's model parallelism asks users to pin layers to devices by
+hand (`ctx_group` attrs + `group2ctx`, `symbol.py:1336-1439`); its data
+parallelism copies parameters per device.  On TPU both collapse into
+sharding annotations: parameters live as ONE global array laid out over
+the `jax.sharding.Mesh`, eager and hybridized compute propagates the
+shardings, and XLA/GSPMD inserts every collective (the all-gathers and
+partial-sum reductions the reference's `_CrossDeviceCopy` op and NCCL
+reduce did by hand).
+
+Usage::
+
+    mesh = mx.parallel.make_mesh(tp=2, dp=4)
+    net.initialize(ctx=mx.cpu())           # single global copy
+    mx.parallel.shard_block(net, mesh, ShardingRules.megatron("tp"))
+    trainer = gluon.Trainer(net.collect_params(), "adam", ...,
+                            zero=mesh)     # ZeRO: optimizer state sharded
+
+Training then proceeds with the ordinary autograd/Trainer loop; tensor
+parallelism, the data-parallel gradient reduction, and ZeRO state
+sharding all happen inside the compiled steps.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .tensor_parallel import ShardingRules
+
+__all__ = ["shard_block", "block_shardings", "shard_state_for_zero", "put"]
+
+
+def put(x, mesh, spec=P()):
+    """Place an NDArray (or raw array) on the mesh with `spec` — e.g.
+    ``put(batch, mesh, P("dp"))`` shards the batch dim for data
+    parallelism, the input-side counterpart of `shard_block`."""
+    from ..ndarray.ndarray import NDArray
+    sharding = NamedSharding(mesh, spec)
+    if isinstance(x, NDArray):
+        x._set_data(jax.device_put(x._data, sharding))
+        return x
+    return jax.device_put(x, sharding)
+
+
+def _clean_spec(shape, spec, mesh):
+    """Drop sharded axes that do not divide the dimension."""
+    ext = tuple(spec) + (None,) * (len(shape) - len(tuple(spec)))
+    clean = []
+    for dim, ax in zip(shape, ext):
+        if ax is None:
+            clean.append(None)
+        else:
+            size = mesh.shape[ax] if isinstance(ax, str) else 1
+            clean.append(ax if size and dim % size == 0 else None)
+    return P(*clean)
+
+
+def block_shardings(block, mesh, rules=None):
+    """{param name: NamedSharding} for every parameter of `block`."""
+    rules = rules or ShardingRules()
+    out = {}
+    for p in block.collect_params().values():
+        spec = _clean_spec(p.shape, rules.spec_for(p.name), mesh)
+        out[p.name] = NamedSharding(mesh, spec)
+    return out
+
+
+def shard_block(block, mesh, rules=None):
+    """Lay every initialized parameter (and its gradient buffer) of
+    `block` out over `mesh` per `rules`.
+
+    Parameters must be initialized on a SINGLE context (one global copy);
+    after this call each parameter's array is mesh-sharded and all
+    subsequent forward/backward/update compute follows the layout.
+    Returns the {name: NamedSharding} map applied.
+    """
+    shardings = block_shardings(block, mesh, rules)
+    for p in block.collect_params().values():
+        datas = p._data
+        if datas is None:
+            raise ValueError(
+                f"Parameter {p.name} is not initialized; call "
+                "initialize(ctx=<one ctx>) before shard_block")
+        if len(datas) != 1:
+            raise ValueError(
+                f"Parameter {p.name} is replicated over {len(datas)} "
+                "contexts; mesh sharding needs a single global copy "
+                "(initialize with one ctx)")
+        s = shardings[p.name]
+        datas[0]._set_data(jax.device_put(datas[0]._data, s))
+        if p._grad:
+            for g in p._grad:
+                g._set_data(jax.device_put(g._data, s))
+    return shardings
+
+
+def shard_state_for_zero(state, mesh, axis):
+    """Shard optimizer-state NDArrays over `axis` (ZeRO: each rank holds
+    1/N of every state tensor; XLA partitions the update elementwise and
+    all-gathers the fresh weights because the weights stay replicated —
+    the TPU reading of the reference's range-sharded parameter servers,
+    `kvstore_dist_server.h`).  Leaves whose leading dim doesn't divide the
+    axis stay replicated."""
+    from ..ndarray.ndarray import NDArray
+
+    n = mesh.shape[axis]
+
+    def place(leaf):
+        if leaf is None or not isinstance(leaf, NDArray):
+            return
+        if leaf.ndim and leaf.shape[0] % n == 0:
+            spec = P(axis)
+        else:
+            spec = P()
+        leaf._set_data(jax.device_put(leaf._data, NamedSharding(mesh, spec)))
+
+    if isinstance(state, NDArray) or state is None:
+        place(state)
+    else:
+        for leaf in jax.tree_util.tree_leaves(
+                state, is_leaf=lambda x: isinstance(x, NDArray)):
+            place(leaf)

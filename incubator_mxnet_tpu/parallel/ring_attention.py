@@ -65,12 +65,17 @@ def blockwise_attention(q, k, v, block_size=None, causal=False):
     return o / l.transpose(0, 2, 1)[..., None]
 
 
-def ring_attention(q, k, v, axis_name, causal=False):
+def ring_attention(q, k, v, axis_name, causal=False, use_pallas=False):
     """Exact attention over sequence shards on `axis_name`.
 
     Call inside shard_map with q/k/v sharded on the sequence dim:
     q,k,v local shapes (B, T_local, H, D).  K/V rotate n-1 times around the
     ring; each step contributes one block to the online softmax.
+
+    ``use_pallas=True`` computes each local block with the flash-attention
+    Pallas kernel (`ops/flash_attention.py`) — O(T_local·D) VMEM streaming
+    instead of a materialized (T_local, T_local) score block — while the
+    ring protocol (ppermute + online-softmax merge) is unchanged.
     """
     n = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
@@ -83,13 +88,22 @@ def ring_attention(q, k, v, axis_name, causal=False):
         m, l, o, k_cur, v_cur = carry
         # which device's shard are we currently holding? source = my_idx - i
         src = (my_idx - i) % n
-        bias = None
-        if causal:
-            q_pos = my_idx * Tl + jnp.arange(Tl)
-            k_pos = src * Tl + jnp.arange(Tl)
-            mask = q_pos[:, None] >= k_pos[None, :]
-            bias = jnp.where(mask, 0.0, neg)[None, None]
-        bo, bm, bl = _block_attn(q, k_cur, v_cur, bias)
+        if use_pallas:
+            from ..ops.flash_attention import flash_attention_partial
+            bo, bm, bl = flash_attention_partial(
+                q, k_cur, v_cur, q_off=my_idx * Tl, k_off=src * Tl,
+                causal=causal)
+            bm = bm.astype(m.dtype)
+            bl = bl.astype(l.dtype)
+            bo = bo.astype(o.dtype)
+        else:
+            bias = None
+            if causal:
+                q_pos = my_idx * Tl + jnp.arange(Tl)
+                k_pos = src * Tl + jnp.arange(Tl)
+                mask = q_pos[:, None] >= k_pos[None, :]
+                bias = jnp.where(mask, 0.0, neg)[None, None]
+            bo, bm, bl = _block_attn(q, k_cur, v_cur, bias)
         m_new = jnp.maximum(m, bm)
         alpha = jnp.exp(m - m_new)
         beta = jnp.exp(bm - m_new)

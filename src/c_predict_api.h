@@ -1,0 +1,72 @@
+/* Standalone C inference ABI for incubator_mxnet_tpu.
+ *
+ * Role of the reference's predict-only ABI
+ * (`include/mxnet/c_predict_api.h:78-200`): load an exported model
+ * (symbol JSON + params container), feed float32 inputs, run forward,
+ * read float32 outputs — from any language with a C FFI, no Python
+ * required at the call site.  The implementation embeds CPython and
+ * drives the framework's compiled-executor path
+ * (incubator_mxnet_tpu/c_predict.py).
+ *
+ * All functions return 0 on success, -1 on failure; call
+ * MXTPUGetLastError() for the message.
+ */
+#ifndef MXTPU_C_PREDICT_API_H_
+#define MXTPU_C_PREDICT_API_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void *PredictorHandle;
+
+/* Latest error message (thread-local). */
+const char *MXTPUGetLastError(void);
+
+/* Create a predictor.
+ *   symbol_json       : NUL-terminated symbol JSON (the -symbol.json file)
+ *   param_bytes/size  : contents of the .params container
+ *   dev_type          : 1 = cpu, 2 = accelerator (tpu)
+ *   dev_id            : device ordinal
+ *   num_input_nodes   : number of model inputs
+ *   input_keys        : input names
+ *   input_shape_indptr: CSR-style offsets into input_shape_data,
+ *                       length num_input_nodes + 1
+ *   input_shape_data  : concatenated input shapes
+ */
+int MXTPUPredCreate(const char *symbol_json,
+                    const void *param_bytes, size_t param_size,
+                    int dev_type, int dev_id,
+                    uint32_t num_input_nodes,
+                    const char **input_keys,
+                    const uint32_t *input_shape_indptr,
+                    const uint32_t *input_shape_data,
+                    PredictorHandle *out);
+
+/* Copy a float32 input by name (size = element count). */
+int MXTPUPredSetInput(PredictorHandle handle, const char *key,
+                      const float *data, uint32_t size);
+
+/* Run the forward pass. */
+int MXTPUPredForward(PredictorHandle handle);
+
+/* Shape of output `index`; *shape_data stays owned by the predictor
+ * until the next call on this handle. */
+int MXTPUPredGetOutputShape(PredictorHandle handle, uint32_t index,
+                            uint32_t **shape_data, uint32_t *shape_ndim);
+
+/* Copy output `index` into caller memory (size = element count). */
+int MXTPUPredGetOutput(PredictorHandle handle, uint32_t index,
+                       float *data, uint32_t size);
+
+/* Release the predictor. */
+int MXTPUPredFree(PredictorHandle handle);
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif
+
+#endif  /* MXTPU_C_PREDICT_API_H_ */

@@ -26,6 +26,16 @@ from incubator_mxnet_tpu import nd
 kv = mx.kv.create("dist_sync")
 rank, nw = kv.rank, kv.num_workers
 assert nw == int(os.environ["DMLC_NUM_WORKER"]), (rank, nw)
+if os.environ.get("MXNET_KVSTORE_COLLECTIVE") == "1":
+    assert kv._collective is not None, "collective data plane must engage"
+    # gradient bytes must never transit the socket in collective mode
+    from incubator_mxnet_tpu.dist import transport
+    _orig_send = transport.send_msg
+    def _no_push(sock, obj):
+        assert not (isinstance(obj, dict) and obj.get("cmd") == "push"), \
+            "gradient push escaped to the socket in collective mode"
+        return _orig_send(sock, obj)
+    transport.send_msg = _no_push
 
 # round-trip 1: plain aggregation (no optimizer -> pull returns the sum)
 kv.init("3", nd.zeros((4, 2)))
@@ -74,8 +84,13 @@ print("worker %d OK" % rank)
 """
 
 
-@pytest.mark.parametrize("n_workers", [2, 4])
-def test_dist_sync_multiprocess(tmp_path, n_workers):
+@pytest.mark.parametrize("n_workers,collective", [(2, "0"), (4, "0"),
+                                                  (2, "1")])
+def test_dist_sync_multiprocess(tmp_path, n_workers, collective):
+    """collective="0": gradients transit the parameter server (socket data
+    plane).  collective="1": gradients all-reduce over the global device
+    mesh (XLA collectives; server = control plane) — same observable
+    semantics either way."""
     from incubator_mxnet_tpu.dist.server import ParameterServer
 
     script = tmp_path / "worker.py"
@@ -86,6 +101,7 @@ def test_dist_sync_multiprocess(tmp_path, n_workers):
                DMLC_PS_ROOT_PORT=str(server.port),
                DMLC_NUM_WORKER=str(n_workers),
                DMLC_ROLE="worker",
+               MXNET_KVSTORE_COLLECTIVE=collective,
                JAX_PLATFORMS="cpu",
                XLA_FLAGS="--xla_force_host_platform_device_count=4",
                PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
@@ -139,6 +155,7 @@ def spy(sock, obj):
     return orig(sock, obj)
 transport.send_msg = spy
 
+os.environ["MXNET_KVSTORE_COLLECTIVE"] = "0"  # this test probes the socket wire
 kv = mx.kv.create("dist_sync")
 rank, nw = kv.rank, kv.num_workers
 kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
