@@ -335,6 +335,52 @@ def _measure_control(step, w, m, aux, img, label, steps):
     return compile_s, img.shape[0] * steps / dt
 
 
+def _run_real_data(batch, image, steps, dtype="float32"):
+    """Module.fit fed by the REAL input pipeline (ImageRecordIter over a
+    synthetic JPEG .rec corpus) — measures end-to-end img/s including
+    decode/augment/transfer, the reference's `train_imagenet.py` shape."""
+    import shutil
+    import tempfile
+    d = tempfile.mkdtemp()
+    try:
+        return _run_real_data_in(d, batch, image, steps, dtype)
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def _run_real_data_in(d, batch, image, steps, dtype):
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import io as mxio
+    rec = os.path.join(d, "bench.rec")
+    import sys as _sys
+    _sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    from bench_io import build_corpus
+    warm = 2
+    n_img = batch * (warm + steps + 1)
+    build_corpus(rec, n=n_img, size=image + 32)
+
+    mx.random.seed(0)
+    mod, ctx = _build_module(mx, batch, image, dtype)
+    probe = _Probe(warm, steps, batch)
+    it = mxio.ImageRecordIter(
+        path_imgrec=rec, data_shape=(3, image, image), batch_size=batch,
+        rand_crop=True, rand_mirror=True, shuffle=True,
+        mean_r=123.68, mean_g=116.78, mean_b=103.94,
+        std_r=58.4, std_g=57.1, std_b=57.4,
+        preprocess_threads=4, prefetch_buffer=8, label_width=1)
+    mod.fit(it, num_epoch=1,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9,
+                              "rescale_grad": 1.0 / batch},
+            eval_metric="acc",
+            initializer=mx.initializer.Xavier(rnd_type="gaussian",
+                                              factor_type="in", magnitude=2),
+            batch_end_callback=probe, kvstore=None)
+    assert probe.img_s is not None, "real-data probe missed its window"
+    return probe.img_s
+
+
 def main():
     batch = int(os.environ.get("BENCH_BATCH", 128))
     image = int(os.environ.get("BENCH_IMAGE", 224))
@@ -403,6 +449,19 @@ def main():
                 _RESULT["ratio_vs_pure_jax"] = round(img32 / c32, 3)
         except Exception as e:
             _RESULT["fp32_error"] = repr(e)[:200]
+
+    # -- real-data lane: the full input pipeline feeds the chip -------------
+    if os.environ.get("BENCH_REAL_DATA", "1") == "1" and left() > 180:
+        _RESULT["phase"] = "real-data"
+        try:
+            real = _run_real_data(batch, image, min(steps, 10), "float32")
+            _RESULT["real_data_img_s"] = round(real, 2)
+            # ratio only against the same-dtype synthetic lane
+            base = _RESULT.get("fp32_img_s") if dtype != "float32" else img_s
+            if base:
+                _RESULT["real_data_vs_synthetic"] = round(real / base, 3)
+        except Exception as e:
+            _RESULT["real_data_error"] = repr(e)[:200]
 
     _RESULT["phase"] = "done"
     signal.alarm(0)

@@ -53,26 +53,31 @@ def _use_kernel():
 
 def _fwd_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref,
                 o_ref, m_ref, l_ref, acc_scr, m_scr, l_scr,
-                *, block_k, causal, scale, kv_len):
+                *, block_k, causal, kv_len):
     from jax.experimental import pallas as pl
 
-    q = q_ref[0]                                # (BQ, D)
+    q = q_ref[0]                                # (BQ, D), PRE-SCALED
     bq = q.shape[0]
     nk = pl.cdiv(kv_len, block_k)
-    q_pos = qoff_ref[0] + pl.program_id(1) * bq + \
-        jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
 
     m_scr[:] = jnp.full(m_scr.shape, _NEG, jnp.float32)
     l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
     acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
 
-    def body(i, _):
+    q_start = qoff_ref[0] + pl.program_id(1) * bq
+    if causal:
+        q_pos = q_start + \
+            jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    def compute(i, masked=True):
         ks = k_ref[0, pl.ds(i * block_k, block_k), :]   # (BK, D)
         vs = v_ref[0, pl.ds(i * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, ks, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # (BQ, BK)
-        if causal:
+            preferred_element_type=jnp.float32)          # (BQ, BK)
+        if causal and masked:
+            # only blocks touching the diagonal need the mask; interior
+            # blocks skip the iota/compare/select VPU passes
             k_pos = koff_ref[0] + i * block_k + \
                 jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG)
@@ -85,9 +90,25 @@ def _fwd_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref,
             p.astype(vs.dtype), vs, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[:, 0] = m_new
-        return 0
 
-    jax.lax.fori_loop(0, nk, body, 0)
+    if causal:
+        # split at the diagonal: blocks strictly above it are fully masked
+        # and never execute (the structural causal win the unfused path
+        # cannot have — it always materializes all T x T scores); blocks
+        # strictly below need no mask at all; only diagonal-touching
+        # blocks pay the mask's VPU passes.  Offsets are traced ring
+        # positions, so both bounds are dynamic.
+        koff = koff_ref[0]
+        n_unmasked = jnp.clip((q_start - koff) // block_k, 0, nk)
+        last = (q_start + bq - 1 - koff) // block_k
+        nk_run = jnp.clip(last + 1, 0, nk)
+        jax.lax.fori_loop(0, n_unmasked,
+                          lambda i, _: (compute(i, masked=False), 0)[1], 0)
+        jax.lax.fori_loop(n_unmasked, nk_run,
+                          lambda i, _: (compute(i, masked=True), 0)[1], 0)
+    else:
+        jax.lax.fori_loop(0, nk,
+                          lambda i, _: (compute(i, masked=False), 0)[1], 0)
     o_ref[0] = acc_scr[:].astype(o_ref.dtype)
     m_ref[0] = m_scr[:, 0]
     l_ref[0] = l_scr[:, 0]
@@ -108,11 +129,14 @@ def _partial_tpu(q3, k3, v3, q_off, k_off, causal, block_q, block_k,
         block_q //= 2
     while kv_len % block_k:
         block_k //= 2
+    # fold the softmax scale into q once (saves a full VPU pass over the
+    # (BQ, BK) score block per inner iteration)
     scale = 1.0 / (D ** 0.5)
+    q3 = (q3.astype(jnp.float32) * scale).astype(q3.dtype)
     grid = (BH, pl.cdiv(Tq, block_q))
 
     kernel = functools.partial(_fwd_kernel, block_k=block_k, causal=causal,
-                               scale=scale, kv_len=kv_len)
+                               kv_len=kv_len)
     o, m, l = pl.pallas_call(
         kernel,
         grid=grid,

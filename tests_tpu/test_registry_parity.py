@@ -25,9 +25,9 @@ from incubator_mxnet_tpu.ops import registry as _reg
 from incubator_mxnet_tpu.test_utils import check_consistency
 
 
-def _case(shapes, grad_req="write", tol=None, scale=1.0, **params):
+def _case(shapes, grad_req="write", tol=None, data_scale=1.0, **params):
     return {"shapes": shapes, "grad_req": grad_req, "tol": tol,
-            "scale": scale, "params": params}
+            "scale": data_scale, "params": params}
 
 
 V = (3, 4)          # generic vector-ish input
@@ -88,6 +88,11 @@ CASES = {
     "_power_scalar": _case({"data": V}, grad_req="null", scalar=2.0),
     "_rpower_scalar": _case({"data": V}, grad_req="null", scalar=2.0),
     # shape/index manipulation
+    "broadcast_to": _case({"data": (1, 4)}, shape=(3, 4)),
+    "Reshape": _case({"data": V}, shape=(4, 3)),
+    "_contrib_MultiBoxPrior": _case({"data": IMG}, grad_req="null",
+                                    sizes=(0.5, 0.25), ratios=(1.0, 2.0)),
+    "_contrib_BilinearResize2D": _case({"data": IMG}, height=4, width=4),
     "expand_dims": _case({"data": V}, axis=1),
     "one_hot": _case({"data": None}, grad_req="null"),  # built below
     "repeat": _case({"data": V}, repeats=2),
@@ -125,6 +130,9 @@ SKIP = {
                                    "test_image_detection.py",
     "_contrib_MultiBoxTarget": "detection target assembly; covered in "
                                "test_image_detection.py",
+    "_contrib_MultiBoxDetection": "nms/decode pipeline needing structured "
+                                  "(cls_prob, loc_pred, anchor) inputs; "
+                                  "covered in test_image_detection.py",
     "linalg_syevd": "eigenvector sign/ordering is backend-defined; "
                     "reconstruction-based checks live in test_operator.py",
     "linalg_gelqf": "LQ factor signs are backend-defined; reconstruction "
@@ -151,6 +159,11 @@ TWEAKS = {
     "reciprocal": dict(use_abs=True),
     "gamma": dict(use_abs=True), "gammaln": dict(use_abs=True),
     "arccosh": dict(shift=2.0),
+    "erfinv": dict(scale=0.3),
+    "InstanceNorm": dict(shapes={"data": IMG}),
+    "_contrib_AdaptiveAvgPooling2D": dict(shapes={"data": IMG},
+                                          params={"output_size": (2, 2)}),
+    "broadcast_power": dict(use_abs=True),
     "arcsin": dict(scale=0.3), "arccos": dict(scale=0.3),
     "arctanh": dict(scale=0.3),
     "Pooling": dict(shapes={"data": IMG}),
@@ -251,6 +264,52 @@ def _run_case(name):
         check_consistency(s, ctxs, grad_req="null",
                           arg_params={"data": idx})
         return
+    if name == "pick":
+        s = S.pick(S.Variable("data"), S.Variable("index"))
+        idx = np.random.randint(0, 5, (6,)).astype("f4")
+        ctxs = [{"ctx": mx.cpu(), "data": (6, 5), "index": (6,)},
+                {"ctx": mx.tpu(), "data": (6, 5), "index": (6,)}]
+        check_consistency(s, ctxs, grad_req="null",
+                          arg_params={"index": idx})
+        return
+    if name == "batch_take":
+        s = S.batch_take(S.Variable("data"), S.Variable("indices"))
+        idx = np.random.randint(0, 5, (6,)).astype("f4")
+        ctxs = [{"ctx": mx.cpu(), "data": (6, 5), "indices": (6,)},
+                {"ctx": mx.tpu(), "data": (6, 5), "indices": (6,)}]
+        check_consistency(s, ctxs, grad_req="null",
+                          arg_params={"indices": idx})
+        return
+    if name == "_contrib_box_iou":
+        s = getattr(S, "_internal")._contrib_box_iou(
+            S.Variable("lhs"), S.Variable("rhs"))
+        rng = np.random.RandomState(0)
+        mk = lambda n: np.sort(rng.rand(n, 2, 2), axis=1) \
+            .reshape(n, 4).astype("f4")  # valid (xmin, ymin, xmax, ymax)
+        ctxs = [{"ctx": mx.cpu(), "lhs": (3, 4), "rhs": (5, 4)},
+                {"ctx": mx.tpu(), "lhs": (3, 4), "rhs": (5, 4)}]
+        check_consistency(s, ctxs, grad_req="null",
+                          arg_params={"lhs": mk(3), "rhs": mk(5)})
+        return
+    if name == "_contrib_index_copy":
+        s = getattr(S, "_internal")._contrib_index_copy(
+            S.Variable("data"), S.Variable("index"), S.Variable("new"))
+        idx = np.array([0, 2], "f4")
+        ctxs = [{"ctx": mx.cpu(), "data": (4, 3), "index": (2,),
+                 "new": (2, 3)},
+                {"ctx": mx.tpu(), "data": (4, 3), "index": (2,),
+                 "new": (2, 3)}]
+        check_consistency(s, ctxs, grad_req="null",
+                          arg_params={"index": idx})
+        return
+    if name == "gather_nd":
+        s = S.gather_nd(S.Variable("data"), S.Variable("indices"))
+        idx = np.random.randint(0, 4, (2, 5)).astype("f4")
+        ctxs = [{"ctx": mx.cpu(), "data": (4, 4), "indices": (2, 5)},
+                {"ctx": mx.tpu(), "data": (4, 4), "indices": (2, 5)}]
+        check_consistency(s, ctxs, grad_req="null",
+                          arg_params={"indices": idx})
+        return
 
     if case is not None:
         shapes = dict(case["shapes"])
@@ -299,6 +358,13 @@ def _run_case(name):
 
 
 ALL_NAMES = sorted(set(list(_distinct_ops())) - set(SKIP))
+
+# optional sharding for slow single-chip runs: MXNET_PARITY_SHARD="i/n"
+import os as _os
+_shard = _os.environ.get("MXNET_PARITY_SHARD")
+if _shard:
+    _i, _n = (int(x) for x in _shard.split("/"))
+    ALL_NAMES = ALL_NAMES[_i::_n]
 
 
 @pytest.mark.parametrize("name", ALL_NAMES)
