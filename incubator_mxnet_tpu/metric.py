@@ -394,7 +394,25 @@ class Perplexity(EvalMetric):
         self.sum_metric += loss
         self.num_inst += num
 
+    def device_update(self, labels, preds):
+        import jax.numpy as jnp
+        dsum, dnum = 0.0, 0.0
+        for label, pred in zip(labels, preds):
+            lab = label.reshape(-1).astype(jnp.int32)
+            pred = pred.astype(jnp.float32)
+            if pred.ndim > 2:
+                pred = pred.reshape(-1, pred.shape[-1])
+            probs = jnp.take_along_axis(pred, lab[:, None], axis=1)[:, 0]
+            if self.ignore_label is not None:
+                ignore = lab == int(self.ignore_label)
+                probs = jnp.where(ignore, 1.0, probs)
+                dnum = dnum - ignore.sum()
+            dsum = dsum - jnp.log(jnp.maximum(1e-10, probs)).sum()
+            dnum = dnum + lab.shape[0]
+        return dsum, dnum
+
     def get(self):
+        self._materialize()
         if self.num_inst == 0:
             return (self.name, float("nan"))
         return (self.name, math.exp(self.sum_metric / self.num_inst))

@@ -446,6 +446,13 @@ def invoke(op, data, kwargs, out=None):
     if op.mode_dependent:
         params["_train"] = _autograd.is_training()
 
+    # sparse inputs densify first (the documented TPU stance — reference
+    # MKLDNN fallback does the same storage-type fallback); checked inline
+    # to keep the common dense case free of extra passes
+    for i, d in enumerate(data):
+        if getattr(d, "_stype", "default") != "default":
+            data = list(data)
+            data[i] = d.tostype("default")
     # promote host-staged inputs to their claimed device first, so the op
     # result is committed to the right device and the output ctx is honest
     for d in data:

@@ -173,6 +173,72 @@ def _clip(og):
     return og if og is not None and og > 0 else -1.0
 
 
+# ---------------------------------------------------------------------------
+# Lazy row-sparse updates (reference `src/operator/optimizer_op.cc`
+# sgd/adam `lazy_update` kernels): when the gradient is a RowSparseNDArray
+# (embedding-style workloads), only the TOUCHED rows of the weight and the
+# optimizer state are read, updated, and scattered back — one jitted
+# gather→update→scatter program per signature instead of densifying the
+# gradient over the full table.  Untouched rows keep weight AND state
+# unchanged (the reference's documented lazy semantics).
+# ---------------------------------------------------------------------------
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=None)
+def _lazy_sgd_jit(momentum):
+    import jax
+
+    def run(w, m, idx, g, lr, wd, rescale, clip):
+        rows_w = w[idx]
+        g = g * rescale
+        g = jax.numpy.where(clip > 0, jax.numpy.clip(g, -clip, clip), g)
+        g = (g + wd * rows_w).astype(w.dtype)
+        if momentum:
+            new_m = momentum * m[idx] - lr.astype(w.dtype) * g
+            return w.at[idx].add(new_m), m.at[idx].set(new_m)
+        return w.at[idx].add(-lr.astype(w.dtype) * g), m
+
+    # no donation: callers may hold aliases (detach() shares the buffer)
+    return jax.jit(run)
+
+
+@_functools.lru_cache(maxsize=None)
+def _lazy_adam_jit(beta1, beta2, eps):
+    import jax
+    jnp = jax.numpy
+
+    def run(w, mean, var, idx, g, lr, wd, rescale, clip):
+        rows_w = w[idx]
+        g = g * rescale
+        g = jnp.where(clip > 0, jnp.clip(g, -clip, clip), g)
+        g = (g + wd * rows_w).astype(w.dtype)
+        new_mean = beta1 * mean[idx] + (1 - beta1) * g
+        new_var = beta2 * var[idx] + (1 - beta2) * jnp.square(g)
+        upd = lr.astype(w.dtype) * new_mean / (jnp.sqrt(new_var) + eps)
+        return (w.at[idx].add(-upd), mean.at[idx].set(new_mean),
+                var.at[idx].set(new_var))
+
+    # no donation: callers may hold aliases (detach() shares the buffer)
+    return jax.jit(run)
+
+
+_EMPTY_ROWS = object()
+
+
+def _row_sparse_grad(grad):
+    """(indices, values) of a row-sparse grad, `_EMPTY_ROWS` when it has no
+    touched rows (the lazy contract: a no-op step, NOT a dense decay), or
+    None for dense grads."""
+    from .ndarray.sparse import RowSparseNDArray
+    if isinstance(grad, RowSparseNDArray):
+        if len(grad._np_indices) == 0:
+            return _EMPTY_ROWS
+        return grad._np_indices, grad._np_data
+    return None
+
+
 @register
 class SGD(Optimizer):
     """SGD with momentum and multi-precision (reference `optimizer.py:445`)."""
@@ -199,6 +265,24 @@ class SGD(Optimizer):
         self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
+        rs = _row_sparse_grad(grad) if self.lazy_update else None
+        if rs is _EMPTY_ROWS:
+            return  # no touched rows: lazy step is a no-op
+        if rs is not None:
+            import numpy as _onp
+            idx, vals = rs
+            run = _lazy_sgd_jit(float(self.momentum))
+            mom = state._data if state is not None else \
+                _onp.zeros((1,), weight.dtype)
+            new_w, new_m = run(weight._data, mom, idx,
+                               vals.astype(weight.dtype),
+                               _onp.float32(lr), _onp.float32(wd),
+                               _onp.float32(self.rescale_grad),
+                               _onp.float32(_clip(self.clip_gradient)))
+            weight._set_data(new_w)
+            if state is not None:
+                state._set_data(new_m)
+            return
         kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
                   clip_gradient=_clip(self.clip_gradient))
         if state is not None:
@@ -398,6 +482,23 @@ class Adam(Optimizer):
         # counts the fused train path injects (fused.py _apply_traced)
         lr = lr * coef2 ** 0.5 / coef1
         mean, var = state
+        rs = _row_sparse_grad(grad) if self.lazy_update else None
+        if rs is _EMPTY_ROWS:
+            return  # no touched rows: lazy step is a no-op
+        if rs is not None:
+            import numpy as _onp
+            idx, vals = rs
+            run = _lazy_adam_jit(float(self.beta1), float(self.beta2),
+                                 float(self.epsilon))
+            new_w, new_mean, new_var = run(
+                weight._data, mean._data, var._data, idx,
+                vals.astype(weight.dtype), _onp.float32(lr),
+                _onp.float32(wd), _onp.float32(self.rescale_grad),
+                _onp.float32(_clip(self.clip_gradient)))
+            weight._set_data(new_w)
+            mean._set_data(new_mean)
+            var._set_data(new_var)
+            return
         nd.adam_update(weight, grad, mean, var, lr=lr, wd=wd,
                        beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
                        rescale_grad=self.rescale_grad,

@@ -1,0 +1,116 @@
+"""Lazy row-sparse optimizer updates (reference `optimizer_op.cc`
+sgd/adam lazy_update kernels): touched rows get the exact dense update,
+untouched rows keep weight AND state untouched, and the work scales with
+the number of touched rows, not the table size."""
+import numpy as np
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+
+def _row_sparse(rows, vals, shape):
+    return RowSparseNDArray(vals, rows, shape)
+
+
+def test_sgd_momentum_lazy_row_sparse():
+    rng = np.random.RandomState(0)
+    V, D = 20, 8
+    w0 = rng.randn(V, D).astype("f4")
+    m0 = rng.randn(V, D).astype("f4") * 0.1
+    rows = np.array([2, 5, 11], np.int64)
+    gvals = rng.randn(3, D).astype("f4")
+
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.01,
+                           rescale_grad=0.5, lazy_update=True)
+    w = nd.array(w0)
+    mom = nd.array(m0)
+    opt.update(0, w, _row_sparse(rows, gvals, (V, D)), mom)
+    got_w, got_m = w.asnumpy(), mom.asnumpy()
+
+    # reference lazy semantics, computed by hand
+    exp_w, exp_m = w0.copy(), m0.copy()
+    g = gvals * 0.5 + 0.01 * w0[rows]
+    new_m = 0.9 * m0[rows] - 0.1 * g
+    exp_m[rows] = new_m
+    exp_w[rows] = w0[rows] + new_m
+    np.testing.assert_allclose(got_w, exp_w, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(got_m, exp_m, rtol=1e-5, atol=1e-6)
+    # untouched rows: bitwise identical (no momentum decay — lazy contract)
+    untouched = [i for i in range(V) if i not in rows]
+    np.testing.assert_array_equal(got_w[untouched], w0[untouched])
+    np.testing.assert_array_equal(got_m[untouched], m0[untouched])
+
+
+def test_adam_lazy_row_sparse():
+    rng = np.random.RandomState(1)
+    V, D = 16, 4
+    w0 = rng.randn(V, D).astype("f4")
+    rows = np.array([0, 7], np.int64)
+    gvals = rng.randn(2, D).astype("f4")
+
+    opt = mx.optimizer.Adam(learning_rate=0.01, lazy_update=True)
+    w = nd.array(w0)
+    mean = nd.zeros((V, D))
+    var = nd.zeros((V, D))
+    opt.update(0, w, _row_sparse(rows, gvals, (V, D)), (mean, var))
+    got_w = w.asnumpy()
+
+    # dense-equivalent math on touched rows (t=1 bias correction)
+    lr = 0.01 * np.sqrt(1 - 0.999) / (1 - 0.9)
+    m1 = 0.1 * gvals
+    v1 = 0.001 * np.square(gvals)
+    exp_rows = w0[rows] - lr * m1 / (np.sqrt(v1) + 1e-8)
+    np.testing.assert_allclose(got_w[rows], exp_rows, rtol=1e-4, atol=1e-5)
+    untouched = [i for i in range(V) if i not in rows]
+    np.testing.assert_array_equal(got_w[untouched], w0[untouched])
+    np.testing.assert_array_equal(mean.asnumpy()[untouched],
+                                  np.zeros((V - 2, D), "f4"))
+
+
+def test_lazy_empty_grad_is_noop():
+    """A row-sparse grad with zero touched rows must change NOTHING —
+    neither weights nor momentum decay (the lazy contract)."""
+    V, D = 5, 3
+    w0 = np.ones((V, D), "f4")
+    m0 = np.full((V, D), 0.5, "f4")
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.01,
+                           lazy_update=True)
+    w = nd.array(w0)
+    mom = nd.array(m0)
+    empty = _row_sparse(np.zeros((0,), np.int64), np.zeros((0, D), "f4"),
+                        (V, D))
+    opt.update(0, w, empty, mom)
+    np.testing.assert_array_equal(w.asnumpy(), w0)
+    np.testing.assert_array_equal(mom.asnumpy(), m0)
+
+
+def test_lazy_update_does_not_invalidate_aliases():
+    """detach()'d views of the weight must stay readable after a lazy
+    step (no buffer donation on this path)."""
+    V, D = 6, 2
+    w = nd.array(np.ones((V, D), "f4"))
+    snap = w.detach()
+    opt = mx.optimizer.SGD(learning_rate=0.1, lazy_update=True)
+    g = _row_sparse(np.array([1], np.int64), np.ones((1, D), "f4"), (V, D))
+    opt.update(0, w, g, None)
+    np.testing.assert_array_equal(snap.asnumpy(), np.ones((V, D), "f4"))
+
+
+def test_lazy_update_off_densifies():
+    """lazy_update=False keeps the reference's dense behavior: momentum
+    decays on EVERY row."""
+    V, D = 6, 3
+    w0 = np.ones((V, D), "f4")
+    m0 = np.full((V, D), 0.5, "f4")
+    rows = np.array([1], np.int64)
+    gvals = np.ones((1, D), "f4")
+
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                           lazy_update=False)
+    w = nd.array(w0)
+    mom = nd.array(m0)
+    opt.update(0, w, _row_sparse(rows, gvals, (V, D)), mom)
+    got_m = mom.asnumpy()
+    # untouched rows decayed: m = 0.9 * 0.5 = 0.45
+    assert np.allclose(got_m[0], 0.45), got_m[0]
