@@ -194,11 +194,15 @@ class _AotCall:
             (getattr(a, "shape", None), getattr(a, "dtype", None),
              getattr(a, "sharding", None)) for a in leaves))
 
-    def __call__(self, *args):
-        sig = self._sig(args)
-        if sig not in self._validated:
-            self._jit.lower(*args)  # trace check only; nothing is donated
-            self._validated.add(sig)
+    def __call__(self, *args, known_sig=False):
+        """known_sig=True: the caller guarantees this exact signature ran
+        before (steady-state carry), so the lower-validation bookkeeping is
+        skipped and dispatch goes straight to the jit's C++ fast path."""
+        if not known_sig:
+            sig = self._sig(args)
+            if sig not in self._validated:
+                self._jit.lower(*args)  # trace check only; nothing donated
+                self._validated.add(sig)
         return self._jit(*args)
 
 
@@ -383,6 +387,7 @@ class FusedTrainStep:
         self._jit = None
         self.last_outputs = None
         self.broken = False
+        self._carry = None  # steady-state fast-path cache (see __call__)
 
     # -- placement of persistent buffers -------------------------------------
     # Every call normalizes buffer shardings (a no-op once placed): other
@@ -531,11 +536,38 @@ class FusedTrainStep:
         metric_fns = self._metric_leaves(eval_metric)
         if metric_fns is None:
             return False
-        self._place_all()
+        # steady-state fast path: when every persistent buffer is still the
+        # array WE wrote back last step (verified by identity), placement,
+        # sharding collection and signature validation are all known-good
+        # and skipped — per-step host work drops to the hyper scalars and
+        # the dispatch itself
+        carry = self._carry if getattr(self, "_carry", None) else None
+        exec0 = self._exec0
+        if carry is not None:
+            cw, cs, ca = carry
+            # load_optimizer_states swaps the whole states dict — identity
+            # of the dict covers external state replacement; the input
+            # signature must also match (a new batch shape needs the full
+            # validation path before the donating dispatch)
+            in_sig = tuple(
+                (getattr(v, "shape", None), getattr(v, "dtype", None))
+                for v in list(data_batch.data) + list(data_batch.label or []))
+            ok = getattr(self, "_carry_sdict", None) is \
+                self._updater.states and \
+                in_sig == getattr(self, "_carry_in_sig", None) and \
+                all(exec0.arg_dict[n]._data is w
+                    for n, w in zip(self._param_names, cw)) and \
+                all(exec0.aux_dict[n]._data is a
+                    for n, a in zip(self._aux_names, ca))
+            if not ok:
+                carry = None
+        if carry is None:
+            self._place_all()
         if self._jit is None or metric_fns_changed(self._metric_sig(),
                                                    metric_fns):
             self._metric_ids = [id(m) for _, m in metric_fns]
             self._build(metric_fns)
+            carry = None
 
         exec0 = self._exec0
         data = list(data_batch.data) + list(data_batch.label or [])
@@ -558,15 +590,19 @@ class FusedTrainStep:
                     raw = raw.astype(tgt.dtype)
                 inputs.append(jax.device_put(raw, self._data_sharding))
             fixed = [exec0.arg_dict[n]._data for n in self._fixed_names]
-            ws = [exec0.arg_dict[n]._data for n in self._param_names]
             states = [self._updater.states[i] for i in self._indices]
-            ss = tuple(_state_data(s) for s in states)
-            auxs = [exec0.aux_dict[n]._data for n in self._aux_names]
-            self._call_w_shardings = [getattr(w, "sharding", None)
-                                      for w in ws]
-            self._call_s_shardings = tuple(_sharding_tree(s) for s in states)
-            self._call_a_shardings = [getattr(a, "sharding", None)
-                                      for a in auxs]
+            if carry is not None:
+                ws, ss, auxs = carry  # shardings unchanged (constrained)
+            else:
+                ws = [exec0.arg_dict[n]._data for n in self._param_names]
+                ss = tuple(_state_data(s) for s in states)
+                auxs = [exec0.aux_dict[n]._data for n in self._aux_names]
+                self._call_w_shardings = [getattr(w, "sharding", None)
+                                          for w in ws]
+                self._call_s_shardings = tuple(_sharding_tree(s)
+                                               for s in states)
+                self._call_a_shardings = [getattr(a, "sharding", None)
+                                          for a in auxs]
 
             mcarry = []
             for fn, m in metric_fns:
@@ -607,9 +643,11 @@ class FusedTrainStep:
             with _no_rng():
                 new_ws, new_ss, new_aux, new_mcarry, new_key, outs = \
                     self._jit(ws, tuple(ss), auxs, mcarry, self._key, inputs,
-                              fixed, lrs, wds, ts, rescale)
+                              fixed, lrs, wds, ts, rescale,
+                              known_sig=carry is not None)
         except Exception as e:
             self.broken = True
+            self._carry = None
             opt._index_update_count = counts_before
             opt.num_update = num_update_before
             _log.warning("fused train step unavailable (%s); Module.fit "
@@ -633,6 +671,12 @@ class FusedTrainStep:
         ctx0 = self._contexts[0]
         self.last_outputs = [NDArray(o, ctx=ctx0) for o in outs]
         mod._params_dirty = True
+        # arm the steady-state fast path for the next call
+        self._carry = (list(new_ws), tuple(new_ss), list(new_aux))
+        self._carry_sdict = self._updater.states
+        self._carry_in_sig = tuple(
+            (getattr(v, "shape", None), getattr(v, "dtype", None))
+            for v in list(data_batch.data) + list(data_batch.label or []))
         return True
 
     def _metric_sig(self):

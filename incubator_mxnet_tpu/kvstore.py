@@ -217,10 +217,23 @@ class KVStore:
             self._updater.set_states(f.read())
 
     def _barrier(self):
-        pass
+        """Single-process stores have nothing to synchronize: engine order
+        already serializes per-buffer access (WaitToRead semantics).  The
+        distributed subclasses override this with a REAL rendezvous
+        (`dist/kvstore_dist.py`); a single-process store is never a valid
+        stand-in for one — assert loudly if someone treats it as such."""
+        if self.num_workers != 1:
+            raise MXNetError(
+                f"{type(self).__name__} reports num_workers="
+                f"{self.num_workers} but has no distributed barrier — use "
+                "kv.create('dist_sync'/'dist_async')")
 
     def _send_command_to_servers(self, head, body):
-        pass
+        """No server processes exist for single-process stores; commands
+        are meaningful only on the dist subclasses (which override)."""
+        if self.num_workers != 1:
+            raise MXNetError(
+                "no servers to command on a single-process kvstore")
 
 
 def _updater_key(k):
