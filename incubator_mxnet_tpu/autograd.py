@@ -242,12 +242,183 @@ def _compute_gradients_recorded(heads, head_grads, retain_graph):
     return grad_map
 
 
-def _compute_gradients(heads, head_grads, retain_graph=False):
+from collections import OrderedDict
+
+_FUSED_BWD_CACHE = OrderedDict()   # tape signature -> jitted replay (LRU)
+_FUSED_BWD_CACHE_MAX = 64          # bounds variable-shape workloads
+_FUSED_BWD_WARNED = [False]
+
+
+def _tape_plan(tape, heads, head_grads, wanted_ids):
+    """One reverse pass over the tape building a POSITIONAL execution plan
+    (no array values captured) plus this call's concrete feed.
+
+    Returns (signature, plan, feed, cts, key_of).  Two backward calls with
+    equal signatures walk identically, so the jitted replay compiled for
+    the first serves the second — the CachedOp idea applied to the
+    autograd tape itself.
+    """
+    key_of = {}          # id(NDArray object) -> dense key int
+
+    def key(obj):
+        k = key_of.get(id(obj))
+        if k is None:
+            k = len(key_of)
+            key_of[id(obj)] = k
+        return k
+
+    feed_pos = {}        # id(jax array) -> feed index
+    feed = []
+
+    def feed_ix(v):
+        p = feed_pos.get(id(v))
+        if p is None:
+            p = len(feed)
+            feed_pos[id(v)] = p
+            feed.append(v)
+        return p
+
+    head_spec = []
+    cts = []
+    live = set()
+    for h, hg in zip(heads, head_grads):
+        hk = key(h)
+        live.add(hk)
+        if hg is not None:
+            head_spec.append((hk, len(cts), None, None))
+            cts.append(hg._data)
+        else:
+            head_spec.append((hk, None, tuple(h.shape),
+                              str(h._data.dtype)))
+
+    plan = []
+    visited = set()
+    for entry in reversed(tape):
+        if isinstance(entry, _FunctionTapeEntry):
+            out_keys = [key_of.get(id(o)) for o in entry.outputs]
+            if any(k in live for k in out_keys if k is not None):
+                return None    # user-python backward: not traceable
+            continue
+        out_keys = [key_of.get(id(o)) for o in entry.outputs]
+        if not any(k in live for k in out_keys if k is not None):
+            continue
+        visited.add(id(entry))
+        out_meta = tuple(
+            (key(o), tuple(o.shape), str(o._data.dtype))
+            for o in entry.outputs)
+        in_pos = tuple(feed_ix(v) for v in entry.input_values)
+        in_keys = []
+        for inp in entry.inputs:
+            if inp is None or not getattr(inp, "_requires_grad", False):
+                in_keys.append(None)
+            else:
+                k = key(inp)
+                live.add(k)
+                in_keys.append(k)
+        plan.append((entry.op.name,
+                     tuple(sorted(entry.params.items())),
+                     in_pos, out_meta, tuple(in_keys)))
+
+    wanted = tuple(sorted(key_of[i] for i in wanted_ids
+                          if i in key_of and key_of[i] in live))
+    signature = (tuple(head_spec), tuple(plan), wanted)
+    return signature, plan, feed, cts, key_of, head_spec, wanted, visited
+
+
+def _build_fused_backward(head_spec, plan, wanted):
+    """Compile the positional tape replay: (feed, cts) -> wanted grads."""
+    import jax
+    import jax.numpy as jnp
+    from .ops import registry as _reg
+
+    def run(feed, cts):
+        gm = {}
+        for hk, ci, shape, dtype in head_spec:
+            g = cts[ci] if ci is not None else jnp.ones(shape, dtype=dtype)
+            gm[hk] = gm[hk] + g if hk in gm else g
+        for opname, pitems, in_pos, out_meta, in_keys in plan:
+            op = _reg.get(opname)
+            params = dict(pitems)
+            vals = [feed[p] for p in in_pos]
+
+            def fwd(*xs, _op=op, _params=params):
+                out = _op.fn(_params, *xs)
+                return out if isinstance(out, tuple) else (out,)
+
+            primals, vjp = jax.vjp(fwd, *vals)
+            cots = []
+            for (k, shape, dtype), p in zip(out_meta, primals):
+                g = gm.get(k)
+                cots.append(g if g is not None
+                            else jnp.zeros(shape, dtype=dtype))
+            cots += [jnp.zeros_like(p) for p in primals[len(out_meta):]]
+            igrads = vjp(tuple(cots))
+            for k, ig in zip(in_keys, igrads):
+                if k is None or ig is None:
+                    continue
+                gm[k] = gm[k] + ig if k in gm else ig
+        return tuple(gm[k] for k in wanted)
+
+    return jax.jit(run)
+
+
+def _compute_gradients_fused(heads, head_grads, retain_graph, wanted_ids):
+    """One-dispatch backward: the whole reverse walk as a single jitted
+    XLA program per tape structure (the TPU answer to the reference's
+    per-op `RunGraph` backward, `src/imperative/imperative.cc:270` — on
+    TPU each op dispatch is a host round trip, so the tape compiles).
+
+    Returns dict id -> grad array for `wanted_ids`, or None when the tape
+    cannot fuse (custom Function entries).
+    """
+    st = _st()
+    out = _tape_plan(st.tape, heads, head_grads, wanted_ids)
+    if out is None:
+        return None
+    signature, plan, feed, cts, key_of, head_spec, wanted, visited = out
+    fn = _FUSED_BWD_CACHE.get(signature)
+    if fn is None:
+        fn = _build_fused_backward(head_spec, plan, wanted)
+        _FUSED_BWD_CACHE[signature] = fn
+        while len(_FUSED_BWD_CACHE) > _FUSED_BWD_CACHE_MAX:
+            _FUSED_BWD_CACHE.popitem(last=False)
+    else:
+        _FUSED_BWD_CACHE.move_to_end(signature)
+    results = fn(feed, cts)
+    by_key = dict(zip(wanted, results))
+    grad_map = {}
+    for i in wanted_ids:
+        k = key_of.get(i)
+        if k is not None and k in by_key:
+            grad_map[i] = by_key[k]
+    if not retain_graph:
+        st.tape = [e for e in st.tape if id(e) not in visited]
+    return grad_map
+
+
+def _compute_gradients(heads, head_grads, retain_graph=False,
+                       wanted_ids=None):
     """Reverse tape walk; returns dict id(NDArray) -> jax grad array."""
+    import os
     import jax.numpy as jnp
 
     st = _st()
     tape = st.tape
+    if wanted_ids is not None and \
+            os.environ.get("MXNET_FUSED_BACKWARD", "1") != "0":
+        try:
+            fused = _compute_gradients_fused(heads, head_grads,
+                                             retain_graph, wanted_ids)
+        except Exception as e:
+            fused = None
+            if not _FUSED_BWD_WARNED[0]:
+                _FUSED_BWD_WARNED[0] = True
+                import logging
+                logging.getLogger(__name__).warning(
+                    "fused tape backward unavailable (%s); using the "
+                    "per-op walk", str(e)[:200])
+        if fused is not None:
+            return fused
     grad_map = {}
     for h, hg in zip(heads, head_grads):
         g = hg._data if hg is not None else jnp.ones(h.shape, dtype=h._data.dtype)
@@ -322,7 +493,8 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             seen.add(id(h))
             marked.append(h)
 
-    grad_map = _compute_gradients(heads, head_grads, retain_graph)
+    grad_map = _compute_gradients(heads, head_grads, retain_graph,
+                                  wanted_ids={id(v) for v in marked})
 
     for v in marked:
         g = grad_map.get(id(v))
@@ -354,7 +526,8 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
     if create_graph:
         grad_map = _compute_gradients_recorded(heads, head_grads, retain)
     else:
-        grad_map = _compute_gradients(heads, head_grads, retain)
+        grad_map = _compute_gradients(heads, head_grads, retain,
+                                      wanted_ids={id(v) for v in variables})
     out = []
     for v in variables:
         g = grad_map.get(id(v))

@@ -53,6 +53,11 @@ class Predictor:
         tgt = self._exec.arg_dict[name]
         arr = np.asarray(flat_f32, dtype=np.float32).reshape(tgt.shape)
         from .ndarray.ndarray import array
+        if self._ctx.device_type != "cpu":
+            # device_put is ASYNC and may read the caller's buffer after
+            # this call returns; the ABI promises copy semantics, so take a
+            # private host copy before handing it to the transfer
+            arr = np.array(arr, copy=True)
         self._exec.arg_dict[name]._set_data(
             array(arr, ctx=self._ctx, dtype=tgt.dtype)._data)
 

@@ -206,6 +206,19 @@ class _AotCall:
         return self._jit(*args)
 
 
+def _donated_invalidated(*trees):
+    """True when any jax-array leaf in the given pytrees was deleted by a
+    donating dispatch.  A failed fused call whose donation already consumed
+    the persistent buffers must NOT fall back onto them — the eager replay
+    would raise on deleted arrays and leave training state unrecoverable."""
+    import jax
+    for t in trees:
+        for leaf in jax.tree_util.tree_leaves(t):
+            if getattr(leaf, "is_deleted", None) and leaf.is_deleted():
+                return True
+    return False
+
+
 def _no_rng():
     """Context forbidding host RNG draws during a fused trace: a key drawn
     at trace time would bake the SAME randomness into every compiled step."""
@@ -301,6 +314,12 @@ class FusedOptimizer:
             with _no_rng():
                 new_ws, new_ss = self._jit(ws, gs, ss, lrs, wds, ts, rescale)
         except Exception as e:
+            if _donated_invalidated(ws, ss):
+                raise RuntimeError(
+                    "fused optimizer apply failed AFTER its donating "
+                    "dispatch consumed the weight/state buffers; training "
+                    "state is unrecoverable — restart from a checkpoint "
+                    f"(cause: {str(e)[:300]})") from e
             self._broken = True
             _log.warning(
                 "fused optimizer apply unavailable for %s (%s); using the "
@@ -458,8 +477,13 @@ class FusedTrainStep:
         ctx = self._contexts[0]
         n_rng = self._n_rng
 
-        def step(ws, ss, auxs, mcarry, key, inputs, fixed,
-                 lr_vec, wd_vec, t_vec, rescale):
+        def step(ws, ss, auxs, mcarry, key, t_vec, inputs, fixed,
+                 lr_vec, wd_vec, rescale):
+            # t advances IN-GRAPH (donated carry): the host passes the
+            # update counts once when (re)arming and never re-uploads the
+            # vector — keeping every steady-state dispatch argument a
+            # device array so the C++ fast dispatch path engages
+            t_vec = t_vec + jnp.float32(1.0)
             if n_rng:
                 key, sub = jax.random.split(key)
             else:
@@ -502,9 +526,10 @@ class FusedTrainStep:
                 new_mcarry.append((msum + jnp.asarray(dsum, jnp.float32),
                                    mnum + jnp.asarray(dnum, jnp.int32)))
             return new_ws, new_ss, tuple(new_aux), tuple(new_mcarry), key, \
-                tuple(outs)
+                t_vec, tuple(outs)
 
-        self._jit = _AotCall(jax.jit(step, donate_argnums=(0, 1, 2, 3, 4)))
+        self._jit = _AotCall(jax.jit(step,
+                                     donate_argnums=(0, 1, 2, 3, 4, 5)))
 
     # -- per-call ------------------------------------------------------------
     def _metric_leaves(self, eval_metric):
@@ -535,6 +560,7 @@ class FusedTrainStep:
 
         metric_fns = self._metric_leaves(eval_metric)
         if metric_fns is None:
+            self.flush()
             return False
         # steady-state fast path: when every persistent buffer is still the
         # array WE wrote back last step (verified by identity), placement,
@@ -544,11 +570,13 @@ class FusedTrainStep:
         carry = self._carry if getattr(self, "_carry", None) else None
         exec0 = self._exec0
         if carry is not None:
-            cw, cs, ca = carry
             # load_optimizer_states swaps the whole states dict — identity
             # of the dict covers external state replacement; the input
             # signature must also match (a new batch shape needs the full
-            # validation path before the donating dispatch)
+            # validation path before the donating dispatch).  The exec
+            # buffers are compared against what WE last physically wrote
+            # (`_seen_*`): in steady state write-backs are deferred (see
+            # flush()), so the dicts still hold the last-flushed arrays.
             in_sig = tuple(
                 (getattr(v, "shape", None), getattr(v, "dtype", None))
                 for v in list(data_batch.data) + list(data_batch.label or []))
@@ -556,22 +584,33 @@ class FusedTrainStep:
                 self._updater.states and \
                 in_sig == getattr(self, "_carry_in_sig", None) and \
                 all(exec0.arg_dict[n]._data is w
-                    for n, w in zip(self._param_names, cw)) and \
+                    for n, w in zip(self._param_names, self._seen_ws)) and \
                 all(exec0.aux_dict[n]._data is a
-                    for n, a in zip(self._aux_names, ca))
+                    for n, a in zip(self._aux_names, self._seen_aux))
             if not ok:
                 carry = None
-        if carry is None:
-            self._place_all()
+        # a metric change forces the cold path too — decide BEFORE the
+        # flush block, which must run whenever the cold path will read the
+        # exec-dict arrays (in steady state they were donated last step)
         if self._jit is None or metric_fns_changed(self._metric_sig(),
                                                    metric_fns):
             self._metric_ids = [id(m) for _, m in metric_fns]
             self._build(metric_fns)
             carry = None
+        if carry is None:
+            if self._owns_exec_buffers():
+                self.flush()
+            else:
+                # an external writer repointed the exec buffers (its values
+                # win — Module's hooks flush beforehand on every public
+                # path); stale pending results must not clobber them
+                self._flushed = True
+            self._place_all()
 
         exec0 = self._exec0
         data = list(data_batch.data) + list(data_batch.label or [])
         if len(data) != len(self._input_names):
+            self.flush()   # caller runs unfused on the public buffers
             return False
         ndev = len(self._contexts)
         if ndev > 1 and any(
@@ -579,6 +618,7 @@ class FusedTrainStep:
                 for v in data):
             # e.g. a partial tail batch: not shardable over the mesh —
             # this batch takes the unfused path, the step stays usable
+            self.flush()
             return False
         try:
             inputs = []
@@ -588,12 +628,15 @@ class FusedTrainStep:
                 if hasattr(raw, "astype") and raw.dtype != tgt.dtype and \
                         name not in self._mod._exec_group.label_names:
                     raw = raw.astype(tgt.dtype)
-                inputs.append(jax.device_put(raw, self._data_sharding))
+                if getattr(raw, "sharding", None) == self._data_sharding:
+                    inputs.append(raw)  # already placed; skip the dispatch
+                else:
+                    inputs.append(jax.device_put(raw, self._data_sharding))
             fixed = [exec0.arg_dict[n]._data for n in self._fixed_names]
-            states = [self._updater.states[i] for i in self._indices]
             if carry is not None:
                 ws, ss, auxs = carry  # shardings unchanged (constrained)
             else:
+                states = [self._updater.states[i] for i in self._indices]
                 ws = [exec0.arg_dict[n]._data for n in self._param_names]
                 ss = tuple(_state_data(s) for s in states)
                 auxs = [exec0.aux_dict[n]._data for n in self._aux_names]
@@ -624,6 +667,7 @@ class FusedTrainStep:
             # fused step itself stays usable for the next one
             _log.warning("fused step input staging failed (%s); running "
                          "this batch unfused", str(e)[:200])
+            self.flush()
             return False
 
         opt = self._opt
@@ -633,51 +677,111 @@ class FusedTrainStep:
         num_update_before = opt.num_update
         for i in self._indices:
             opt._update_count(i)
-        lrs = _np.asarray([opt._get_lr(i) for i in self._indices], _np.float32)
-        wds = _np.asarray([opt._get_wd(i) for i in self._indices], _np.float32)
-        ts = _np.asarray([opt._index_update_count[i] for i in self._indices],
-                         _np.float32)
-        rescale = _np.float32(opt.rescale_grad)
+        lrs = [float(opt._get_lr(i)) for i in self._indices]
+        wds = [float(opt._get_wd(i)) for i in self._indices]
+        rescale = float(opt.rescale_grad)
+        # hyper scalars live on device and are re-uploaded only when a
+        # scheduler actually changes them: every steady-state dispatch
+        # argument stays a jax array (C++ fast dispatch path)
+        hv = (tuple(lrs), tuple(wds), rescale)
+        if getattr(self, "_hyper_vals", None) != hv:
+            self._hyper_dev = jax.device_put(
+                [_np.asarray(lrs, _np.float32),
+                 _np.asarray(wds, _np.float32),
+                 _np.float32(rescale)], self._rep_sharding)
+            self._hyper_vals = hv
+        lr_dev, wd_dev, rescale_dev = self._hyper_dev
+        t_vec = getattr(self, "_t_vec", None) if carry is not None else None
+        if t_vec is None:
+            # seed the in-graph counter with counts BEFORE this step (the
+            # program itself adds the +1 the host just applied)
+            t_vec = jax.device_put(_np.asarray(
+                [opt._index_update_count[i] - 1 for i in self._indices],
+                _np.float32), self._rep_sharding)
 
         try:
             with _no_rng():
-                new_ws, new_ss, new_aux, new_mcarry, new_key, outs = \
-                    self._jit(ws, tuple(ss), auxs, mcarry, self._key, inputs,
-                              fixed, lrs, wds, ts, rescale,
+                new_ws, new_ss, new_aux, new_mcarry, new_key, new_t, outs = \
+                    self._jit(ws, tuple(ss), auxs, mcarry, self._key, t_vec,
+                              inputs, fixed, lr_dev, wd_dev, rescale_dev,
                               known_sig=carry is not None)
         except Exception as e:
-            self.broken = True
-            self._carry = None
             opt._index_update_count = counts_before
             opt.num_update = num_update_before
+            if _donated_invalidated(ws, ss, auxs):
+                self.broken = True
+                self._carry = None
+                self._t_vec = None
+                raise RuntimeError(
+                    "fused train step failed AFTER its donating dispatch "
+                    "consumed the weight/optimizer-state buffers; training "
+                    "state is unrecoverable — restart from a checkpoint "
+                    f"(cause: {str(e)[:300]})") from e
+            self.flush()   # pending results from prior steps are intact
+            self._carry = None
+            self._t_vec = None
+            self.broken = True
             _log.warning("fused train step unavailable (%s); Module.fit "
                          "falls back to forward_backward+update",
                          str(e)[:300])
             return False
 
-        # repoint persistent buffers (donation invalidated the old ones)
-        groups = mod._exec_group
-        for n, nw in zip(self._param_names, new_ws):
-            for e in groups.execs:
-                e.arg_dict[n]._set_data(nw)
-        for s, ns in zip(states, new_ss):
-            _state_write_back(s, ns)
-        for n, na in zip(self._aux_names, new_aux):
-            for e in groups.execs:
-                e.aux_dict[n]._set_data(na)
         for (fn, m), pend in zip(metric_fns, new_mcarry):
             m._device_totals = tuple(pend)
         self._key = new_key
+        self._t_vec = new_t
         ctx0 = self._contexts[0]
         self.last_outputs = [NDArray(o, ctx=ctx0) for o in outs]
         mod._params_dirty = True
-        # arm the steady-state fast path for the next call
+        # arm the steady-state fast path; the ~600 NDArray write-backs are
+        # DEFERRED (donation invalidated the old buffers, but nothing reads
+        # them until an external consumer calls flush() via Module) — on a
+        # one-core host the per-step Python was serializing with the device
+        was_cold = carry is None
         self._carry = (list(new_ws), tuple(new_ss), list(new_aux))
         self._carry_sdict = self._updater.states
         self._carry_in_sig = tuple(
             (getattr(v, "shape", None), getattr(v, "dtype", None))
             for v in list(data_batch.data) + list(data_batch.label or []))
+        self._flushed = False
+        if was_cold:
+            # first step of a signature: write through immediately so the
+            # `_seen_*` identity snapshots exist for the fast-path check
+            self.flush()
         return True
+
+    def _owns_exec_buffers(self):
+        """True while the exec dicts still hold the arrays WE last wrote
+        (nobody repointed them externally since the last flush)."""
+        seen = getattr(self, "_seen_ws", None)
+        if seen is None:
+            return True
+        exec0 = self._exec0
+        return all(exec0.arg_dict[n]._data is w
+                   for n, w in zip(self._param_names, seen))
+
+    def flush(self):
+        """Write the pending step results (deferred donated-carry arrays)
+        into the public NDArrays: parameters, optimizer state, aux states.
+        Steady-state training never needs this; any external reader —
+        get_params, checkpointing, the unfused fallback, a forward() —
+        must see current values, so Module routes through here first."""
+        if getattr(self, "_flushed", True) or self._carry is None:
+            return
+        self._flushed = True
+        new_ws, new_ss, new_aux = self._carry
+        groups = self._mod._exec_group
+        for n, nw in zip(self._param_names, new_ws):
+            for e in groups.execs:
+                e.arg_dict[n]._set_data(nw)
+        states = [self._updater.states[i] for i in self._indices]
+        for s, ns in zip(states, new_ss):
+            _state_write_back(s, ns)
+        for n, na in zip(self._aux_names, new_aux):
+            for e in groups.execs:
+                e.aux_dict[n]._set_data(na)
+        self._seen_ws = list(new_ws)
+        self._seen_aux = list(new_aux)
 
     def _metric_sig(self):
         return getattr(self, "_metric_ids", None)

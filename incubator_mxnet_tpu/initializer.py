@@ -9,6 +9,7 @@ import numpy as np
 
 from .base import MXNetError
 from .ndarray.ndarray import NDArray
+from . import random as _random
 
 _INIT_REGISTRY = {}
 
@@ -83,7 +84,7 @@ class Initializer:
             try:
                 self._init_weight(name, arr)
             except Exception:
-                self._set(arr, np.random.uniform(-0.07, 0.07, arr.shape))
+                self._set(arr, _random.host_rng().uniform(-0.07, 0.07, arr.shape))
         else:
             self._init_default(name, arr)
 
@@ -156,7 +157,7 @@ class Uniform(Initializer):
         self.scale = scale
 
     def _init_weight(self, _, arr):
-        self._set(arr, np.random.uniform(-self.scale, self.scale, arr.shape))
+        self._set(arr, _random.host_rng().uniform(-self.scale, self.scale, arr.shape))
 
 
 @register
@@ -166,7 +167,7 @@ class Normal(Initializer):
         self.sigma = sigma
 
     def _init_weight(self, _, arr):
-        self._set(arr, np.random.normal(0, self.sigma, arr.shape))
+        self._set(arr, _random.host_rng().normal(0, self.sigma, arr.shape))
 
 
 @register
@@ -200,9 +201,9 @@ class Xavier(Initializer):
             raise ValueError("Incorrect factor type")
         scale = np.sqrt(self.magnitude / factor)
         if self.rnd_type == "uniform":
-            self._set(arr, np.random.uniform(-scale, scale, shape))
+            self._set(arr, _random.host_rng().uniform(-scale, scale, shape))
         elif self.rnd_type == "gaussian":
-            self._set(arr, np.random.normal(0, scale, shape))
+            self._set(arr, _random.host_rng().normal(0, scale, shape))
         else:
             raise ValueError("Unknown random type")
 
@@ -226,9 +227,9 @@ class Orthogonal(Initializer):
         nout = arr.shape[0]
         nin = int(np.prod(arr.shape[1:]))
         if self.rand_type == "uniform":
-            tmp = np.random.uniform(-1.0, 1.0, (nout, nin))
+            tmp = _random.host_rng().uniform(-1.0, 1.0, (nout, nin))
         else:
-            tmp = np.random.normal(0.0, 1.0, (nout, nin))
+            tmp = _random.host_rng().normal(0.0, 1.0, (nout, nin))
         u, _, v = np.linalg.svd(tmp, full_matrices=False)
         res = u if u.shape == tmp.shape else v
         self._set(arr, (self.scale * res).reshape(arr.shape))

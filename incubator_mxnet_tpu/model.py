@@ -49,7 +49,19 @@ def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
 
 
 def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
-    """Reference `model.py:145`: push grads, pull updated weights."""
+    """Reference `model.py:145`: push grads, pull updated weights.
+
+    Stores that prefer batching (collective data plane) get the FULL key
+    list in one push/pull pair so the step costs ~one fused all-reduce
+    instead of one collective per parameter (reference batched NCCL push,
+    `model.py:125`)."""
+    if getattr(kvstore, "prefers_batched_push", False):
+        idxs = [i for i, g in enumerate(grad_arrays) if g[0] is not None]
+        if idxs:
+            names = [param_names[i] for i in idxs]
+            kvstore.push(names, [grad_arrays[i] for i in idxs])
+            kvstore.pull(names, [param_arrays[i] for i in idxs])
+        return
     for index, pair in enumerate(zip(param_arrays, grad_arrays)):
         arg_list, grad_list = pair
         if grad_list[0] is None:

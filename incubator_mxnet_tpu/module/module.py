@@ -119,6 +119,7 @@ class Module(BaseModule):
         if self.params_initialized and not force_init:
             return
         assert self.binded, "call bind before initializing the parameters"
+        self._flush_fused()
         if initializer is None:
             initializer = Uniform(0.01)
 
@@ -174,6 +175,7 @@ class Module(BaseModule):
 
     def set_params(self, arg_params, aux_params, allow_missing=False,
                    force_init=True, allow_extra=False):
+        self._flush_fused()
         if not allow_missing:
             self.init_params(initializer=None, arg_params=arg_params,
                              aux_params=aux_params, allow_missing=allow_missing,
@@ -344,6 +346,15 @@ class Module(BaseModule):
                     (s.stop - s.start) != bs // ndev
                     for s in self._exec_group.slices):
                 return False
+            # loss heads with batch/valid normalization divide the gradient
+            # by the batch they SEE: the fused single program sees the
+            # global batch, the unfused per-device path normalizes by the
+            # device slice and sums — a factor-ndev difference.  Keep such
+            # graphs on the unfused (reference-semantics) path.
+            for n in self._symbol._topo():
+                if not n.is_variable and \
+                        n.attrs.get("normalization") in ("batch", "valid"):
+                    return False
         return True
 
     def fit_step(self, data_batch, eval_metric):
@@ -357,15 +368,23 @@ class Module(BaseModule):
         self.update_metric(eval_metric, data_batch.label)
 
     # -- forward/backward ------------------------------------------------------
+    def _flush_fused(self):
+        """Deferred fused-step write-backs must land before anything reads
+        the public param/state/aux NDArrays (see fused.FusedTrainStep.flush)."""
+        if self._fused_step is not None:
+            self._fused_step.flush()
+
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
         if self._fused_step is not None:
             self._fused_step.last_outputs = None
+            self._fused_step.flush()
         self._exec_group.forward(data_batch, is_train)
 
     def forward_backward(self, data_batch):
         """Fused train step (one XLA program per device)."""
         assert self.binded and self.params_initialized
+        self._flush_fused()
         self._exec_group.forward_backward(data_batch)
 
     def backward(self, out_grads=None):
@@ -377,6 +396,7 @@ class Module(BaseModule):
         (reference `module.py:644 update`)."""
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
+        self._flush_fused()
         self._params_dirty = True
         if self._update_on_kvstore:
             _update_params_on_kvstore(self._exec_group.param_arrays,
@@ -384,12 +404,42 @@ class Module(BaseModule):
                                       self._kvstore,
                                       self._exec_group.param_names)
         else:
+            if self._fused_step is not None and len(self._context) > 1:
+                self._seed_fallback_states()
             _update_params(self._exec_group.param_arrays,
                            self._exec_group.grad_arrays,
                            updater=self._updater,
                            num_device=len(self._context),
                            kvstore=self._kvstore,
                            param_names=self._exec_group.param_names)
+
+    def _seed_fallback_states(self):
+        """The fused step keeps optimizer state under device-0 indices
+        (i*ndev) only; the unfused per-device update uses i*ndev+k.  A
+        mid-training fallback batch must not start devices k>=1 from
+        freshly zeroed state — seed them with copies of the fused state so
+        the per-device weight copies stay in lockstep."""
+        from ..ndarray.ndarray import NDArray
+
+        def _copy_state(s):
+            if s is None:
+                return None
+            if isinstance(s, NDArray):
+                return s.copy()
+            if isinstance(s, (tuple, list)):
+                return tuple(_copy_state(x) for x in s)
+            return s
+
+        ndev = len(self._context)
+        upd = self._updater
+        for i in range(len(self._exec_group.param_names)):
+            base = i * ndev
+            if base not in upd.states:
+                continue
+            for k in range(1, ndev):
+                if base + k not in upd.states:
+                    upd.states[base + k] = _copy_state(upd.states[base])
+                    upd.states_synced[base + k] = True
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
@@ -409,6 +459,7 @@ class Module(BaseModule):
     def _sync_params_from_devices(self):
         if self._exec_group is None or not self._params_dirty:
             return
+        self._flush_fused()
         if self._arg_params is None:
             self._arg_params = {}
         if self._aux_params is None:
@@ -421,6 +472,7 @@ class Module(BaseModule):
 
     def save_optimizer_states(self, fname):
         assert self.optimizer_initialized
+        self._flush_fused()
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
@@ -429,6 +481,7 @@ class Module(BaseModule):
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
+        self._flush_fused()  # stale pending state must not clobber the load
         if self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
         else:

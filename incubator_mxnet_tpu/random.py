@@ -30,6 +30,7 @@ def seed(seed_state, ctx="all"):
     """
     import jax
     _state.key = jax.random.PRNGKey(int(seed_state))
+    _state.host_seq = [int(seed_state), 0]
 
 
 def next_key():
@@ -39,3 +40,18 @@ def next_key():
     k, sub = jax.random.split(k)
     _state.key = k
     return sub
+
+
+def host_rng():
+    """numpy Generator for host-side draws (initializers), reproducible
+    under `mx.random.seed(n)` like the reference's seeded mt19937 resource
+    (`src/resource.cc:87-160`).  Purely host-side — a (seed, counter)
+    SeedSequence, NOT a draw from the device key chain: initializing a
+    large model must not issue one device round trip per parameter on a
+    high-latency transport."""
+    import numpy as np
+    seq = getattr(_state, "host_seq", None)
+    if seq is None:
+        seq = _state.host_seq = [0, 0]
+    seq[1] += 1
+    return np.random.default_rng(np.random.SeedSequence(tuple(seq)))

@@ -541,11 +541,14 @@ def graph_eval_fn(symbol, is_train, n_rng_hint=None):
     import jax
     import jax.numpy as jnp
 
+    from ..ops import layout as _layout
+
     topo = symbol._topo()
     aux_ids = symbol._aux_node_ids()
     arg_nodes = [n for n in topo if n.is_variable and id(n) not in aux_ids]
     aux_nodes = [n for n in topo if n.is_variable and id(n) in aux_ids]
     rng_nodes = [n for n in topo if (not n.is_variable) and n.op.needs_rng]
+    use_nhwc = _layout.enabled()
 
     def fn(arg_values, aux_values, key):
         env = {}
@@ -558,6 +561,11 @@ def graph_eval_fn(symbol, is_train, n_rng_hint=None):
         keys = jax.random.split(key, max(len(rng_nodes), 1))
         rng_i = 0
         new_aux = dict(aux_env)
+        # internal execution-layout pass (ops/layout.py): spatial ops run
+        # NHWC (MXU-friendly), elementwise ops flow the tag through, every
+        # other consumer and the graph heads see the API's NCHW — the
+        # reference's cuDNN/MKLDNN layout selection done at graph level
+        tags = {}
         for node in topo:
             if node.is_variable:
                 continue
@@ -565,13 +573,36 @@ def graph_eval_fn(symbol, is_train, n_rng_hint=None):
             if node.op.mode_dependent:
                 params["_train"] = bool(is_train)
             ins = [env[id(src)][idx] for src, idx in node.inputs]
+            op_fn = node.op.fn
+            out_tag = None
+            if use_nhwc:
+                in_tags = [tags.get((id(src), idx))
+                           for src, idx in node.inputs]
+                nat = _layout.NATIVE.get(node.op.name)
+                if nat is not None and nat[1](node.op.name, params, ins[0]):
+                    if in_tags[0] != "NHWC":
+                        ins[0] = _layout.to_nhwc(ins[0])
+                    # non-spatial slots (weights, vectors) must arrive in
+                    # their API layout — untag any computed NHWC feed
+                    ins[1:] = [_layout.to_nchw(v) if t == "NHWC" else v
+                               for v, t in zip(ins[1:], in_tags[1:])]
+                    op_fn = nat[0]
+                    out_tag = "native"   # spatial output 0 only
+                elif node.op.name in _layout.AGNOSTIC and \
+                        any(t == "NHWC" for t in in_tags) and \
+                        all(_layout.layout_safe_input(v, t)
+                            for v, t in zip(ins, in_tags)):
+                    out_tag = "all"
+                else:
+                    ins = [_layout.to_nchw(v) if t == "NHWC" else v
+                           for v, t in zip(ins, in_tags)]
             if node.op.dynamic_params:
                 for pname in node.op.dynamic_params:
                     ins.append(jnp.asarray(params.pop(pname), dtype="float32"))
             if node.op.needs_rng:
                 ins.append(keys[rng_i])
                 rng_i += 1
-            out = node.op.fn(params, *ins)
+            out = op_fn(params, *ins)
             if not isinstance(out, (tuple, list)):
                 out = (out,)
             nout = node.op.num_outputs(params)
@@ -582,7 +613,15 @@ def graph_eval_fn(symbol, is_train, n_rng_hint=None):
                     if id(src) in new_aux:
                         new_aux[id(src)] = upd
             env[id(node)] = tuple(out[:nout])
-        outputs = tuple(env[id(node)][idx] for node, idx in symbol._entries)
+            if out_tag == "native":
+                tags[(id(node), 0)] = "NHWC"
+            elif out_tag == "all":
+                for oi in range(nout):
+                    tags[(id(node), oi)] = "NHWC"
+        outputs = tuple(
+            _layout.to_nchw(env[id(node)][idx])
+            if tags.get((id(node), idx)) == "NHWC" else env[id(node)][idx]
+            for node, idx in symbol._entries)
         aux_out = tuple(new_aux[id(n)] for n in aux_nodes)
         return outputs, aux_out
 

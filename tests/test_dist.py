@@ -67,6 +67,24 @@ md = nd.zeros((2, 2))
 kv.pull("md", out=md)
 np.testing.assert_allclose(md.asnumpy(), len(devs) * nw)
 
+# batched multi-key push/pull: the whole key list rides ONE fused
+# collective dispatch (bucketed all-reduce), not one per key
+if os.environ.get("MXNET_KVSTORE_COLLECTIVE") == "1":
+    bkeys = ["b0", "b1", "b2"]
+    bshapes = [(3,), (2, 2), (5,)]
+    for k, s in zip(bkeys, bshapes):
+        kv.init(k, nd.zeros(s))
+    before = kv._collective.dispatch_count
+    kv.push(bkeys, [nd.ones(s) * (rank + 1) for s in bshapes])
+    after = kv._collective.dispatch_count
+    assert after == before + 1, ("batched push must issue ONE collective",
+                                 before, after)
+    bouts = [nd.zeros(s) for s in bshapes]
+    kv.pull(bkeys, out=bouts)
+    tot = sum(r + 1 for r in range(nw))
+    for o in bouts:
+        np.testing.assert_allclose(o.asnumpy(), tot)
+
 # server-side optimizer: weight = w0 - lr * sum(grads) each round
 kv.init("w", nd.ones((3,)))
 kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0 / nw))

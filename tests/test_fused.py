@@ -201,3 +201,39 @@ def test_fused_optimizer_fallback_is_safe():
     fo([0], [w], [g], [None])
     np.testing.assert_allclose(w.asnumpy(), np.zeros(4), atol=1e-6)
     del mx.optimizer.Optimizer.opt_registry["hostrng"]
+
+
+def test_fused_metric_swap_mid_training():
+    """Changing the eval metric after steady-state steps must rebuild the
+    program WITHOUT touching the donated (deleted) exec buffers: the
+    deferred write-backs flush first, training continues, and both metric
+    objects report sane values (regression: the metric-change path once
+    demoted to the cold path after the flush decision was made)."""
+    os.environ["MXNET_FUSED_TRAIN_STEP"] = "1"
+    try:
+        np.random.seed(7)
+        mx.random.seed(7)
+        X, y = _data()
+        it = io.NDArrayIter(X, y, batch_size=32, shuffle=False,
+                            label_name="softmax_label")
+        mod = mx.mod.Module(_make_symbol(), context=mx.cpu())
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod.init_params(mx.initializer.Xavier())
+        mod.init_optimizer(kvstore=None, optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        batches = list(it)
+        m1 = mx.metric.create("acc")
+        for s in range(3):   # step 1 cold+flush, 2-3 steady (deferred)
+            mod.fit_step(batches[s % len(batches)], m1)
+        assert not mod._fused_step.broken
+        m2 = mx.metric.create("ce")   # new metric object: program rebuild
+        for s in range(3):
+            mod.fit_step(batches[s % len(batches)], m2)
+        assert not mod._fused_step.broken, \
+            "metric swap must not break the fused step"
+        assert np.isfinite(dict(m2.get_name_value())["cross-entropy"])
+        args, _ = mod.get_params()
+        for k, v in args.items():
+            assert np.isfinite(v.asnumpy()).all(), k
+    finally:
+        os.environ.pop("MXNET_FUSED_TRAIN_STEP", None)

@@ -1,0 +1,121 @@
+"""Fused tape backward: `loss.backward()` compiles the whole reverse walk
+into ONE jitted program per tape structure (reference counterpart: the
+per-op `RunGraph` backward, `src/imperative/imperative.cc:270`, which is
+cheap per-dispatch on GPU but a host round trip per op on TPU)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, nd
+
+
+def _grads_with_env(flag, monkeypatch, seed=3):
+    monkeypatch.setenv("MXNET_FUSED_BACKWARD", flag)
+    rng = np.random.RandomState(seed)
+    x = nd.array(rng.randn(4, 5).astype("f4"))
+    w1 = nd.array(rng.randn(5, 6).astype("f4"))
+    w2 = nd.array(rng.randn(6, 3).astype("f4"))
+    for v in (x, w1, w2):
+        v.attach_grad()
+    with autograd.record():
+        h = nd.dot(x, w1)
+        h = nd.Activation(h, act_type="relu")
+        y = nd.dot(h, w2)
+        loss = nd.sum(y * y)
+    loss.backward()
+    return [v.grad.asnumpy() for v in (x, w1, w2)]
+
+
+def test_fused_backward_matches_eager_walk(monkeypatch):
+    fused = _grads_with_env("1", monkeypatch)
+    eager = _grads_with_env("0", monkeypatch)
+    for f, e in zip(fused, eager):
+        np.testing.assert_allclose(f, e, rtol=1e-5, atol=1e-6)
+
+
+def test_fused_backward_caches_by_structure(monkeypatch):
+    monkeypatch.setenv("MXNET_FUSED_BACKWARD", "1")
+    autograd._FUSED_BWD_CACHE.clear()
+    for _ in range(3):   # same structure, different values
+        _grads_with_env("1", monkeypatch)
+    assert len(autograd._FUSED_BWD_CACHE) == 1, \
+        "repeat steps with one tape structure must reuse ONE compiled program"
+    # a different structure compiles a second program
+    x = nd.array(np.ones((2, 2), "f4"))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.sum(x * 3.0)
+    y.backward()
+    assert len(autograd._FUSED_BWD_CACHE) == 2
+
+
+def test_fused_backward_gluon_trainer_step(monkeypatch):
+    """Whole Gluon train step parity: fused backward vs per-op walk."""
+    from incubator_mxnet_tpu import gluon
+
+    init_rng = np.random.RandomState(5)
+    init = [init_rng.randn(16, 10) * 0.2, np.zeros(16),
+            init_rng.randn(4, 16) * 0.2, np.zeros(4)]
+
+    def run(flag):
+        monkeypatch.setenv("MXNET_FUSED_BACKWARD", flag)
+        net = gluon.nn.Sequential()
+        net.add(gluon.nn.Dense(16, activation="relu"))
+        net.add(gluon.nn.Dense(4))
+        net.initialize(mx.initializer.Xavier())
+        net(nd.array(np.zeros((8, 10), "f4")))  # shape-infer params
+        for p, v in zip(net.collect_params().values(), init):
+            p.set_data(nd.array(v.astype("f4")))
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9})
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        rng = np.random.RandomState(11)
+        data = nd.array(rng.randn(8, 10).astype("f4"))
+        label = nd.array(rng.randint(0, 4, 8).astype("f4"))
+        for _ in range(3):
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(8)
+        return [v.data().asnumpy() for v in net.collect_params().values()]
+
+    fused = run("1")
+    eager = run("0")
+    assert len(fused) == len(eager)
+    for i, (f, e) in enumerate(zip(fused, eager)):
+        np.testing.assert_allclose(f, e, rtol=1e-5, atol=1e-6,
+                                   err_msg=f"param {i}")
+
+
+def test_fused_backward_custom_function_falls_back(monkeypatch):
+    """Tapes containing a user autograd.Function keep the eager walk."""
+    monkeypatch.setenv("MXNET_FUSED_BACKWARD", "1")
+
+    class Square(autograd.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x
+
+        def backward(self, dy):
+            (x,) = self.saved_tensors
+            return 2 * x * dy
+
+    x = nd.array(np.arange(4, dtype="f4"))
+    x.attach_grad()
+    with autograd.record():
+        y = Square()(x)
+        z = nd.sum(y)
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               2 * np.arange(4, dtype="f4"))
+
+
+def test_fused_backward_grad_api(monkeypatch):
+    monkeypatch.setenv("MXNET_FUSED_BACKWARD", "1")
+    x = nd.array(np.array([1.0, 2.0, 3.0], "f4"))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.sum(x * x)
+    (g,) = autograd.grad([y], [x])
+    np.testing.assert_allclose(g.asnumpy(), [2.0, 4.0, 6.0])

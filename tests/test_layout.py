@@ -1,0 +1,88 @@
+"""Internal NHWC execution layout (ops/layout.py + the executor's layout
+pass): results must match NCHW execution exactly — the pass only changes
+the layout convolution/pooling/batchnorm execute in, never semantics.
+(Reference counterpart: cuDNN/MKLDNN layout selection,
+`src/operator/nn/mkldnn/mkldnn_base-inl.h`.)"""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import sym, nd
+
+
+def _conv_graph():
+    data = sym.Variable("data")
+    h = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                        name="c1")
+    h = sym.BatchNorm(h, name="bn1")
+    h = sym.Activation(h, act_type="relu")
+    h = sym.Pooling(h, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    s = sym.Convolution(data, kernel=(1, 1), num_filter=8, stride=(2, 2),
+                        name="ds")
+    h = h + s                      # NHWC-tagged shortcut add
+    h = sym.Pooling(h, global_pool=True, pool_type="avg")
+    h = sym.Flatten(h)
+    h = sym.FullyConnected(h, num_hidden=5, name="fc")
+    return sym.SoftmaxOutput(h, name="softmax")
+
+
+@pytest.mark.parametrize("train", [True, False])
+def test_nhwc_pass_matches_nchw(monkeypatch, train):
+    out = _conv_graph()
+    rng = np.random.RandomState(0)
+    shapes = {"data": (4, 3, 16, 16), "softmax_label": (4,)}
+
+    def run(layout):
+        monkeypatch.setenv("MXNET_INTERNAL_CONV_LAYOUT", layout)
+        mx.random.seed(0)
+        exe = out.simple_bind(mx.cpu(), grad_req="write" if train else "null",
+                              **shapes)
+        for name, arr in exe.arg_dict.items():
+            r = np.random.RandomState(hash(name) % (2**31))
+            if name == "softmax_label":
+                arr[:] = nd.array(r.randint(0, 5, arr.shape).astype("f4"))
+            else:
+                arr[:] = nd.array(r.randn(*arr.shape).astype("f4") * 0.1)
+        outs = exe.forward(is_train=train)
+        res = [o.asnumpy() for o in outs]
+        grads = []
+        if train:
+            exe.backward(out_grads=None)
+            grads = [exe.grad_dict[n].asnumpy()
+                     for n in sorted(exe.grad_dict)
+                     if exe.grad_dict[n] is not None]
+        return res, grads
+
+    (o_nchw, g_nchw) = run("NCHW")
+    (o_nhwc, g_nhwc) = run("NHWC")
+    for a, b in zip(o_nchw, o_nhwc):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+    for a, b in zip(g_nchw, g_nhwc):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_nhwc_module_fit_parity(monkeypatch):
+    """A small conv Module trains to the same weights under both layouts."""
+    from incubator_mxnet_tpu import io
+
+    def run(layout):
+        monkeypatch.setenv("MXNET_INTERNAL_CONV_LAYOUT", layout)
+        mx.random.seed(0)
+        net = _conv_graph()
+        mod = mx.mod.Module(net, context=mx.cpu(),
+                            label_names=("softmax_label",))
+        rng = np.random.RandomState(1)
+        x = rng.rand(16, 3, 16, 16).astype("f4")
+        y = rng.randint(0, 5, 16).astype("f4")
+        it = io.NDArrayIter(x, y, batch_size=8, label_name="softmax_label")
+        mod.fit(it, num_epoch=2, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1},
+                initializer=mx.initializer.Xavier(), kvstore=None)
+        args, _ = mod.get_params()
+        return {k: v.asnumpy() for k, v in args.items()}
+
+    w_nchw = run("NCHW")
+    w_nhwc = run("NHWC")
+    for k in w_nchw:
+        np.testing.assert_allclose(w_nchw[k], w_nhwc[k], rtol=1e-4,
+                                   atol=1e-5, err_msg=k)

@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Run the on-chip registry parity battery (tests_tpu/) and emit a
+driver-visible artifact `TPU_PARITY_r<N>.json` with pass/fail/skip counts
+(reference pattern: `tests/python/gpu/test_operator_gpu.py` re-running the
+CPU suite on the device).
+
+Usage: python tools/run_tpu_parity.py [round_number]
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    rnd = sys.argv[1] if len(sys.argv) > 1 else "04"
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests_tpu", "-q", "--tb=line",
+         "-p", "no:cacheprovider"],
+        cwd=REPO, capture_output=True, text=True, timeout=3000)
+    out = proc.stdout + proc.stderr
+    counts = {"passed": 0, "failed": 0, "skipped": 0, "errors": 0}
+    for key in counts:
+        m = re.search(rf"(\d+) {key[:-1] if key != 'errors' else 'error'}",
+                      out)
+        if m:
+            counts[key] = int(m.group(1))
+    tail = "\n".join(out.strip().splitlines()[-12:])
+    artifact = {
+        "round": rnd,
+        "rc": proc.returncode,
+        **counts,
+        "duration_s": round(time.time() - t0, 1),
+        "cmd": "python -m pytest tests_tpu -q",
+        "tail": tail[-2000:],
+    }
+    path = os.path.join(REPO, f"TPU_PARITY_r{rnd}.json")
+    with open(path, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(json.dumps({k: v for k, v in artifact.items() if k != "tail"}))
+    return 0 if proc.returncode == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
