@@ -196,6 +196,81 @@ def _run_framework(batch, image, steps, dtype):
     return init_s, probe.compile_s, probe.img_s
 
 
+def _run_gluon(batch, image, steps, dtype):
+    """Gluon lane: model_zoo ResNet-50 driven by the PUBLIC
+    `gluon.contrib.estimator.Estimator.fit` loop — the fused Gluon step
+    (gluon/fused_step.py) compiles forward+loss+backward+optimizer+metric
+    into one donated program, the Gluon analogue of the Module lane."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, nd
+    import jax
+
+    mx.random.seed(0)
+    ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
+    net = gluon.model_zoo.vision.resnet50_v1(classes=1000)
+    net.initialize(mx.initializer.Xavier(rnd_type="gaussian",
+                                         factor_type="in", magnitude=2),
+                   ctx=ctx)
+    if dtype != "float32":
+        net.cast(dtype)
+    # materialize deferred params with one eager forward so the FIRST fit
+    # batch can fuse (otherwise batch 0 runs eager and the probe's
+    # compile_s would record the eager step, not the fused XLA compile)
+    net(nd.array(np.zeros((1, 3, image, image), "f4"), ctx=ctx).astype(dtype))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9,
+                             "multi_precision": dtype != "float32",
+                             "rescale_grad": 1.0 / batch})
+    est = gluon.contrib.estimator.Estimator(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(),
+        train_metrics=[mx.metric.Accuracy()], trainer=trainer)
+
+    data = nd.array(np.random.rand(batch, 3, image, image).astype("f4"),
+                    ctx=ctx).astype(dtype)
+    label = nd.array(np.random.randint(0, 1000, batch).astype("f4"), ctx=ctx)
+    warm = 2
+    times = {}
+
+    class Probe:
+        def train_begin(self, est):
+            self.t0 = time.perf_counter()
+
+        def epoch_begin(self, est):
+            pass
+
+        def batch_begin(self, est):
+            pass
+
+        def batch_end(self, est):
+            i = est.batch_idx
+            if i == 0:
+                for m in est.train_metrics:
+                    m.get()          # sync: compile + first step done
+                times["compile"] = time.perf_counter() - self.t0
+            elif i == warm:
+                for m in est.train_metrics:
+                    m.get()
+                times["t0"] = time.perf_counter()
+            elif i == warm + steps:
+                for m in est.train_metrics:
+                    m.get()
+                times["img_s"] = batch * steps / (
+                    time.perf_counter() - times["t0"])
+
+        def epoch_end(self, est):
+            pass
+
+        def train_end(self, est):
+            pass
+
+    batches = [(data, label)] * (warm + steps + 1)
+    est.fit(iter(batches), epochs=1, event_handlers=[Probe()])
+    assert est._fused is not None and not est._fused.broken, \
+        "Estimator must run the fused Gluon step"
+    assert "img_s" in times, "gluon probe missed its window"
+    return times["compile"], times["img_s"]
+
+
 # ---------------------------------------------------------------------------
 # Control path: hand-written raw-JAX ResNet-50 train step (no framework)
 # ---------------------------------------------------------------------------
@@ -466,6 +541,17 @@ def main():
         except Exception as e:  # control failure must not kill the bench
             _RESULT["control_error"] = repr(e)[:200]
 
+    # -- gluon lane (public Estimator loop; fused Gluon step) ---------------
+    if os.environ.get("BENCH_GLUON", "1") == "1" and left() > 150:
+        _RESULT["phase"] = f"gluon-{dtype}"
+        try:
+            g_compile, g_img_s = _run_gluon(batch, image, steps, dtype)
+            _RESULT["gluon_img_s"] = round(g_img_s, 2)
+            _RESULT["gluon_compile_s"] = round(g_compile, 2)
+            _RESULT["gluon_vs_module"] = round(g_img_s / img_s, 3)
+        except Exception as e:
+            _RESULT["gluon_error"] = repr(e)[:200]
+
     # -- fp32 lane ----------------------------------------------------------
     if want_fp32 and dtype != "float32" and left() > 150:
         _RESULT["phase"] = "framework-float32"
@@ -484,17 +570,29 @@ def main():
     if os.environ.get("BENCH_REAL_DATA", "1") == "1" and left() > 180:
         _RESULT["phase"] = "real-data"
         try:
-            real, pipe = _run_real_data(batch, image, steps, "float32")
+            # raw H2D rate: says whether this lane is transfer-bound (dev
+            # tunnel ~90 MB/s) or pipeline-bound (real host, GB/s PCIe)
+            buf = np.random.rand(batch, 3, image, image).astype("f4")
+            t0 = time.perf_counter()
+            jax.block_until_ready(jax.device_put(buf))
+            h2d = buf.nbytes / (time.perf_counter() - t0) / 1e6
+            _RESULT["h2d_MBps"] = round(h2d, 1)
+            # headline dtype: a bf16 model halves the per-batch transfer
+            # (the fused step casts host-side before the device_put)
+            real, pipe = _run_real_data(batch, image, steps, dtype)
             _RESULT["real_data_img_s"] = round(real, 2)
             _RESULT["io_pipeline_img_s"] = round(pipe, 2)
-            # ratio only against the same-dtype synthetic lane
-            base = _RESULT.get("fp32_img_s") if dtype != "float32" else img_s
+            base = img_s
             if base:
                 _RESULT["real_data_vs_synthetic"] = round(real / base, 3)
             if real > 1.15 * max(pipe, 1e-9) and real > 0.9 * (base or real):
                 # can't train faster than the pipeline decodes unless the
                 # window was fed from the prefetch buffer — flag it
                 _RESULT["real_data_buffer_fed"] = True
+            itemsize = 2 if dtype == "bfloat16" else 4
+            xfer_img_s = h2d * 1e6 / (3 * image * image * itemsize)
+            if real < 0.8 * pipe and real < 1.5 * xfer_img_s:
+                _RESULT["real_data_transfer_bound"] = True
         except Exception as e:
             _RESULT["real_data_error"] = repr(e)[:200]
 

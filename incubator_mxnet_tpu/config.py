@@ -96,6 +96,29 @@ KNOBS = {
                                "sets jax default_matmul_precision=highest "
                                "(full-fp32 MXU inputs; this framework's "
                                "own knob)"),
+    # -- TPU-framework-specific knobs ---------------------------------------
+    "MXNET_FUSED_TRAIN_STEP": (_BOOL, True, "honored",
+                               "Module.fit/Estimator.fit single-program "
+                               "fused train step (fused.py)"),
+    "MXNET_FUSED_BACKWARD": (_BOOL, True, "honored",
+                             "eager loss.backward() as ONE jitted tape "
+                             "replay per structure (autograd.py)"),
+    "MXNET_KVSTORE_COLLECTIVE": (_BOOL, True, "honored",
+                                 "dist_sync gradients ride XLA collectives "
+                                 "instead of the socket server"),
+    "MXNET_INTERNAL_CONV_LAYOUT": (str, "NCHW", "honored",
+                                   "NHWC internal conv/pool/BN execution "
+                                   "(ops/layout.py; measured ~parity on "
+                                   "v5e, default off)"),
+    "MXNET_FLASH_INTERPRET": (_BOOL, False, "honored",
+                              "run the Pallas flash-attention kernel in "
+                              "interpreter mode (CPU testing)"),
+    "MXNET_FLASH_VMEM_MB": (float, 10.0, "honored",
+                            "VMEM budget steering the whole-KV kernel vs "
+                            "the KV-streaming grid (long-context) variant"),
+    "MXNET_COMPILATION_CACHE_DIR": (str, "", "honored",
+                                    "persistent XLA compilation cache "
+                                    "directory (bench.py)"),
 }
 
 _warned = set()

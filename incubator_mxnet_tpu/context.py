@@ -104,7 +104,14 @@ def _cpu_devices():
     import jax
     try:
         devs = [d for d in jax.local_devices() if d.platform == "cpu"]
-        return devs or jax.local_devices()
+        if devs:
+            return devs
+        # accelerator-only default platform (e.g. the axon TPU): the host
+        # backend exists but is not among local_devices() — instantiate it
+        # explicitly.  Without this, cpu() silently resolved to the TPU and
+        # every "host" array (decoded batches, staging buffers) crossed the
+        # interconnect/tunnel.
+        return jax.devices("cpu")
     except RuntimeError:
         return jax.local_devices()
 

@@ -164,46 +164,13 @@ def _apply_traced(opt, indices, ws, gs, ss, ctx, lr_vec, wd_vec, t_vec,
         opt.__dict__.update(saved)
 
 
-class _AotCall:
-    """Validate-then-dispatch wrapper around a donating jit.
-
-    Donation deletes the caller's persistent buffers (weights, optimizer
-    state) at dispatch — so a jit call whose TRACE fails can destroy the
-    arrays the fallback path then needs.  For each new input signature
-    (shape/dtype/sharding — CachedOp's cache key, reference
-    `cached_op.cc:265 SetForwardGraph`), the function is first LOWERED
-    without executing (`jit.lower(*args)` consumes nothing): any
-    untraceable construct raises here, with the buffers intact.  Execution
-    then goes through the normal jit dispatch, which keeps the C++
-    fast path AND the persistent compilation cache (an explicit AOT
-    `lower().compile()` would bypass that cache and re-pay the multi-minute
-    XLA compile every process).
-    """
-
-    def __init__(self, jit_fn):
-        self._jit = jit_fn
-        self._validated = set()
-
-    @staticmethod
-    def _sig(args):
-        import jax
-        leaves, treedef = jax.tree_util.tree_flatten(args)
-        # dtype objects (not str()) — hashable, and orders of magnitude
-        # cheaper per leaf on the per-step hot path
-        return (treedef, tuple(
-            (getattr(a, "shape", None), getattr(a, "dtype", None),
-             getattr(a, "sharding", None)) for a in leaves))
-
-    def __call__(self, *args, known_sig=False):
-        """known_sig=True: the caller guarantees this exact signature ran
-        before (steady-state carry), so the lower-validation bookkeeping is
-        skipped and dispatch goes straight to the jit's C++ fast path."""
-        if not known_sig:
-            sig = self._sig(args)
-            if sig not in self._validated:
-                self._jit.lower(*args)  # trace check only; nothing donated
-                self._validated.add(sig)
-        return self._jit(*args)
+# NOTE on donation safety (formerly a _AotCall pre-validation wrapper):
+# donation consumes the caller's persistent buffers only when the compiled
+# executable actually RUNS — a failed trace or compile raises before
+# execution with every buffer intact, and callers triage post-dispatch
+# failures with _raise_if_unrecoverable (is_deleted on the inputs).  A
+# `jit.lower(*args)` pre-validation pass would re-trace the whole
+# multi-thousand-op graph and double first-step latency for no safety.
 
 
 def _donated_invalidated(*trees):
@@ -217,6 +184,32 @@ def _donated_invalidated(*trees):
             if getattr(leaf, "is_deleted", None) and leaf.is_deleted():
                 return True
     return False
+
+
+def _param_dict_mults(opt, indices):
+    """Per-parameter lr/wd multipliers from the optimizer's param_dict
+    (consulted FIRST by _get_lr/_get_wd — gluon Trainer populates it), as
+    a hashable tuple for the hyper-vector cache key: freezing a layer
+    mid-training via `param.lr_mult = 0` must invalidate the cache."""
+    pd = getattr(opt, "param_dict", None) or {}
+    if not pd:
+        return ()
+    return tuple(
+        (getattr(pd[i], "lr_mult", None), getattr(pd[i], "wd_mult", None))
+        if i in pd else None for i in indices)
+
+
+def _raise_if_unrecoverable(kind, exc, *trees):
+    """Shared post-dispatch failure triage for every fused path: when the
+    donating dispatch already consumed the persistent buffers, falling
+    back would replay onto deleted arrays — raise instead.  Returns when a
+    fallback is safe (buffers intact)."""
+    if _donated_invalidated(*trees):
+        raise RuntimeError(
+            f"{kind} failed AFTER its donating dispatch consumed the "
+            "weight/optimizer-state buffers; training state is "
+            "unrecoverable — restart from a checkpoint "
+            f"(cause: {str(exc)[:300]})") from exc
 
 
 def _no_rng():
@@ -273,7 +266,7 @@ class FusedOptimizer:
                            for s, sh in zip(new_ss, self._call_s_shardings))
             return new_ws, new_ss
 
-        self._jit = _AotCall(jax.jit(step, donate_argnums=(0, 2)))
+        self._jit = jax.jit(step, donate_argnums=(0, 2))
 
     def _hyper(self, indices):
         """Advance host-side update counts and collect per-parameter
@@ -314,12 +307,7 @@ class FusedOptimizer:
             with _no_rng():
                 new_ws, new_ss = self._jit(ws, gs, ss, lrs, wds, ts, rescale)
         except Exception as e:
-            if _donated_invalidated(ws, ss):
-                raise RuntimeError(
-                    "fused optimizer apply failed AFTER its donating "
-                    "dispatch consumed the weight/state buffers; training "
-                    "state is unrecoverable — restart from a checkpoint "
-                    f"(cause: {str(e)[:300]})") from e
+            _raise_if_unrecoverable("fused optimizer apply", e, ws, ss)
             self._broken = True
             _log.warning(
                 "fused optimizer apply unavailable for %s (%s); using the "
@@ -528,8 +516,7 @@ class FusedTrainStep:
             return new_ws, new_ss, tuple(new_aux), tuple(new_mcarry), key, \
                 t_vec, tuple(outs)
 
-        self._jit = _AotCall(jax.jit(step,
-                                     donate_argnums=(0, 1, 2, 3, 4, 5)))
+        self._jit = jax.jit(step, donate_argnums=(0, 1, 2, 3, 4, 5))
 
     # -- per-call ------------------------------------------------------------
     def _metric_leaves(self, eval_metric):
@@ -621,17 +608,12 @@ class FusedTrainStep:
             self.flush()
             return False
         try:
-            inputs = []
-            for v, name in zip(data, self._input_names):
-                raw = v._data if isinstance(v, NDArray) else _np.asarray(v)
-                tgt = exec0.arg_dict[name]
-                if hasattr(raw, "astype") and raw.dtype != tgt.dtype and \
-                        name not in self._mod._exec_group.label_names:
-                    raw = raw.astype(tgt.dtype)
-                if getattr(raw, "sharding", None) == self._data_sharding:
-                    inputs.append(raw)  # already placed; skip the dispatch
-                else:
-                    inputs.append(jax.device_put(raw, self._data_sharding))
+            pre = getattr(self, "_prestaged", None)
+            if pre is not None and pre[0] is data_batch:
+                inputs = pre[1]   # transfer already in flight (prepare())
+                self._prestaged = None
+            else:
+                inputs = self._stage_inputs(data)
             fixed = [exec0.arg_dict[n]._data for n in self._fixed_names]
             if carry is not None:
                 ws, ss, auxs = carry  # shardings unchanged (constrained)
@@ -677,19 +659,24 @@ class FusedTrainStep:
         num_update_before = opt.num_update
         for i in self._indices:
             opt._update_count(i)
-        lrs = [float(opt._get_lr(i)) for i in self._indices]
-        wds = [float(opt._get_wd(i)) for i in self._indices]
-        rescale = float(opt.rescale_grad)
-        # hyper scalars live on device and are re-uploaded only when a
-        # scheduler actually changes them: every steady-state dispatch
-        # argument stays a jax array (C++ fast dispatch path)
-        hv = (tuple(lrs), tuple(wds), rescale)
-        if getattr(self, "_hyper_vals", None) != hv:
+        # hyper scalars live on device and are re-uploaded only when the
+        # BASE values move (scheduler step, set_learning_rate, rescale
+        # change) — the per-parameter vectors are base * static multipliers,
+        # so the 2x160 per-parameter host calls are off the steady path
+        sched = getattr(opt, "lr_scheduler", None)
+        base_lr = sched(opt.num_update) if sched is not None else opt.lr
+        base = (float(base_lr), float(opt.wd), float(opt.rescale_grad),
+                tuple(sorted(getattr(opt, "lr_mult", {}).items())),
+                tuple(sorted(getattr(opt, "wd_mult", {}).items())),
+                _param_dict_mults(opt, self._indices))
+        if getattr(self, "_hyper_base", None) != base:
+            lrs = [float(opt._get_lr(i)) for i in self._indices]
+            wds = [float(opt._get_wd(i)) for i in self._indices]
             self._hyper_dev = jax.device_put(
                 [_np.asarray(lrs, _np.float32),
                  _np.asarray(wds, _np.float32),
-                 _np.float32(rescale)], self._rep_sharding)
-            self._hyper_vals = hv
+                 _np.float32(opt.rescale_grad)], self._rep_sharding)
+            self._hyper_base = base
         lr_dev, wd_dev, rescale_dev = self._hyper_dev
         t_vec = getattr(self, "_t_vec", None) if carry is not None else None
         if t_vec is None:
@@ -703,20 +690,17 @@ class FusedTrainStep:
             with _no_rng():
                 new_ws, new_ss, new_aux, new_mcarry, new_key, new_t, outs = \
                     self._jit(ws, tuple(ss), auxs, mcarry, self._key, t_vec,
-                              inputs, fixed, lr_dev, wd_dev, rescale_dev,
-                              known_sig=carry is not None)
+                              inputs, fixed, lr_dev, wd_dev, rescale_dev)
         except Exception as e:
             opt._index_update_count = counts_before
             opt.num_update = num_update_before
-            if _donated_invalidated(ws, ss, auxs):
+            try:
+                _raise_if_unrecoverable("fused train step", e, ws, ss, auxs)
+            except RuntimeError:
                 self.broken = True
                 self._carry = None
                 self._t_vec = None
-                raise RuntimeError(
-                    "fused train step failed AFTER its donating dispatch "
-                    "consumed the weight/optimizer-state buffers; training "
-                    "state is unrecoverable — restart from a checkpoint "
-                    f"(cause: {str(e)[:300]})") from e
+                raise
             self.flush()   # pending results from prior steps are intact
             self._carry = None
             self._t_vec = None
@@ -749,6 +733,41 @@ class FusedTrainStep:
             # `_seen_*` identity snapshots exist for the fast-path check
             self.flush()
         return True
+
+    def _stage_inputs(self, data):
+        """Place a batch's arrays onto the data sharding (dtype-cast
+        host-side first — e.g. fp32 pipeline output to a bf16 model —
+        which also halves the host->device bytes)."""
+        import jax
+        exec0 = self._exec0
+        inputs = []
+        for v, name in zip(data, self._input_names):
+            raw = v._data if isinstance(v, NDArray) else _np.asarray(v)
+            tgt = exec0.arg_dict[name]
+            if hasattr(raw, "astype") and raw.dtype != tgt.dtype and \
+                    name not in self._mod._exec_group.label_names:
+                raw = raw.astype(tgt.dtype)
+            if getattr(raw, "sharding", None) == self._data_sharding:
+                inputs.append(raw)  # already placed; skip the dispatch
+            else:
+                inputs.append(jax.device_put(raw, self._data_sharding))
+        return inputs
+
+    def prestage(self, data_batch):
+        """Start the (async) device placement of a FUTURE batch while the
+        current step's program is still executing — the reference
+        PrefetcherIter's H2D pipelining role (`src/io/iter_prefetcher.h`),
+        driven from `Module.prepare` in the fit loop.  `__call__` adopts
+        the in-flight transfer by batch identity."""
+        if self.broken:
+            return
+        try:
+            data = list(data_batch.data) + list(data_batch.label or [])
+            if len(data) != len(self._input_names):
+                return
+            self._prestaged = (data_batch, self._stage_inputs(data))
+        except Exception:
+            self._prestaged = None
 
     def _owns_exec_buffers(self):
         """True while the exec dicts still hold the arrays WE last wrote

@@ -205,10 +205,24 @@ class Estimator:
 
     def fit(self, train_data, val_data=None, epochs=1, event_handlers=None):
         from ... import autograd
+        import os as _os
         if self.trainer is None:
             from ..trainer import Trainer
             self.trainer = Trainer(self.net.collect_params(), "sgd",
                                    {"learning_rate": 0.01})
+        # TPU fast path: the whole train step (forward + loss + backward +
+        # optimizer + aux + metric) as ONE donated XLA program per input
+        # signature (gluon/fused_step.py), with transparent fallback to
+        # the reference eager loop below
+        fused = getattr(self, "_fused", None)
+        if fused is not None and fused._trainer is not self.trainer:
+            fused = self._fused = None   # trainer replaced: rebuild
+        if _os.environ.get("MXNET_FUSED_TRAIN_STEP", "1") == "0":
+            fused = None
+        elif fused is None:
+            from ..fused_step import GluonFusedStep
+            fused = self._fused = GluonFusedStep.try_build(
+                self.net, self.loss, self.trainer, self.train_metrics)
         handlers = list(event_handlers or [LoggingHandler()])
         try:
             for h in handlers:
@@ -223,6 +237,11 @@ class Estimator:
                     data, label = self._place(data, label)
                     for h in handlers:
                         h.batch_begin(self)
+                    if fused is not None and not fused.broken and \
+                            fused(data, label, data.shape[0]):
+                        for h in handlers:
+                            h.batch_end(self)
+                        continue
                     with autograd.record():
                         out = self.net(data)
                         loss = self.loss(out, label)

@@ -1,0 +1,283 @@
+"""The Gluon training step as ONE donated XLA program.
+
+`Module.fit` got its single-program hot loop in `fused.FusedTrainStep`;
+this is the same treatment for the Gluon side, reachable from the public
+`gluon.contrib.estimator.Estimator.fit` loop (the reference's Estimator,
+`python/mxnet/gluon/contrib/estimator/estimator.py`).  The eager pattern
+
+    with autograd.record():
+        out = net(data); loss = loss_fn(out, label)
+    loss.backward(); trainer.step(batch)
+
+costs a dispatch for the CachedOp forward, one for the (fused) tape
+backward — which must RECOMPUTE the forward for its residuals — and one
+for the optimizer apply.  Here the whole thing traces once per input
+signature into a single program: the net and loss blocks run their nd ops
+on traced shells (every registered op is jax-traceable), `jax.vjp` takes
+the gradients with the forward residuals shared (no recompute), the
+PUBLIC optimizer applies via `fused._apply_traced`, BatchNorm aux states
+and the metric accumulate in-graph, and every persistent buffer is a
+donated carry.
+
+Eligibility (checked at build, with transparent fallback to the eager
+loop): single-context trainer, no ZeRO/TP sharding, no RNG-consuming ops
+(dropout nets fall back), metrics with `device_update`.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as _np
+
+from ..ndarray.ndarray import NDArray
+from .. import autograd as _autograd
+from ..fused import (_apply_traced, _no_rng, _param_dict_mults, _state_data,
+                     _state_write_back, _raise_if_unrecoverable)
+
+__all__ = ["GluonFusedStep"]
+
+_log = logging.getLogger(__name__)
+
+
+class _SwapParams:
+    """Temporarily repoint Parameters' storage at traced shells."""
+
+    def __init__(self, params, shells):
+        self._params = params
+        self._shells = shells
+        self._saved = None
+
+    def __enter__(self):
+        self._saved = [p._data for p in self._params]
+        for p, s in zip(self._params, self._shells):
+            p._data = [s]
+
+    def __exit__(self, *exc):
+        for p, d in zip(self._params, self._saved):
+            p._data = d
+
+
+class GluonFusedStep:
+    """One donated program for Estimator's train step."""
+
+    @classmethod
+    def try_build(cls, net, loss_fn, trainer, metrics):
+        """Returns an instance or None when the configuration cannot fuse
+        (the caller keeps the reference eager loop)."""
+        try:
+            if trainer is None or len(trainer._contexts) != 1:
+                return None
+            if getattr(trainer, "_zero", None) is not None:
+                return None
+            for m in metrics:
+                if getattr(m, "device_update", None) is None:
+                    return None
+            return cls(net, loss_fn, trainer, metrics)
+        except Exception as e:
+            _log.warning("gluon fused step unavailable (%s)", str(e)[:200])
+            return None
+
+    def __init__(self, net, loss_fn, trainer, metrics):
+        self._net = net
+        self._loss_fn = loss_fn
+        self._trainer = trainer
+        self._metrics = list(metrics)
+        self._ctx = trainer._contexts[0]
+        all_params = list(trainer._params)
+        self._train_params = [p for p in all_params
+                              if p.grad_req not in (None, "null")]
+        self._aux_params = [p for p in all_params
+                            if p.grad_req in (None, "null")]
+        self._indices = [trainer._param2idx[p.name]
+                         for p in self._train_params]
+        self._opt = trainer._optimizer
+        self._updater = trainer._updaters[0]
+        self._jit = None
+        self.broken = False
+        self._carry = None
+        self._t_vec = None
+        self.last_loss = None
+        self.last_outputs = None
+
+    # -- build ---------------------------------------------------------------
+    def _build(self):
+        import jax
+        import jax.numpy as jnp
+
+        net, loss_fn = self._net, self._loss_fn
+        tparams, aparams = self._train_params, self._aux_params
+        metrics = self._metrics
+        opt, indices, ctx = self._opt, self._indices, self._ctx
+
+        def step(ws, auxs, ss, mcarry, t_vec, data, label,
+                 lr_vec, wd_vec, rescale):
+            t_vec = t_vec + jnp.float32(1.0)
+
+            def forward(pws):
+                shells = [NDArray(w, ctx=ctx) for w in pws]
+                aux_shells = [NDArray(a, ctx=ctx) for a in auxs]
+                with _SwapParams(tparams, shells), \
+                        _SwapParams(aparams, aux_shells), \
+                        _autograd.pause(train_mode=True):
+                    out = net(NDArray(data, ctx=ctx))
+                    losses = loss_fn(out, NDArray(label, ctx=ctx))
+                # BatchNorm-style aux updates landed in-place on the shells
+                new_aux = tuple(s._data for s in aux_shells)
+                return jnp.sum(losses._data), (out._data, losses._data,
+                                               new_aux)
+
+            loss_sum, vjp, (out, losses, new_aux) = \
+                jax.vjp(forward, list(ws), has_aux=True)
+            (grads,) = vjp(jnp.ones((), loss_sum.dtype))
+            new_ws, new_ss = _apply_traced(opt, indices, ws, grads, ss, ctx,
+                                           lr_vec, wd_vec, t_vec, rescale)
+            new_mcarry = []
+            for m, (msum, mnum) in zip(metrics, mcarry):
+                dsum, dnum = m.device_update([label], [out])
+                new_mcarry.append((msum + jnp.asarray(dsum, jnp.float32),
+                                   mnum + jnp.asarray(dnum, jnp.int32)))
+            mean_loss = loss_sum / losses.size
+            return (new_ws, tuple(new_aux), new_ss, tuple(new_mcarry),
+                    t_vec, mean_loss, out)
+
+        self._jit = jax.jit(step, donate_argnums=(0, 1, 2, 3, 4))
+
+    # -- per step ------------------------------------------------------------
+    def _ensure_states(self):
+        upd = self._updater
+        for i, p in zip(self._indices, self._train_params):
+            if i not in upd.states:
+                upd.states[i] = \
+                    self._opt.create_state_multi_precision(i, p.data())
+                upd.states_synced[i] = True
+
+    def __call__(self, data, label, batch_size):
+        """Run one fused Gluon step; returns True when handled (params,
+        optimizer state, aux and metrics all updated)."""
+        if self.broken:
+            return False
+        import jax
+
+        trainer = self._trainer
+        if not trainer._kv_initialized:
+            trainer._init_kvstore()
+        if trainer._kvstore is not None:
+            return False   # multi-device/dist reductions: eager loop
+        if self._opt is not trainer._optimizer or \
+                self._updater is not trainer._updaters[0]:
+            # load_states() replaces the updater's optimizer (and the
+            # states dict): rebuild around the restored objects
+            self._opt = trainer._optimizer
+            self._updater = trainer._updaters[0]
+            self._jit = None
+            self._carry = None
+            self._t_vec = None
+        opt = self._opt
+        opt.rescale_grad = trainer._scale / batch_size
+        try:
+            self._ensure_states()
+        except Exception:
+            # deferred-init parameters: the eager loop's first forward
+            # materializes them; retry fusing from the next batch
+            return False
+
+        if self._jit is None:
+            self._build()
+
+        data_nd = data if isinstance(data, NDArray) else None
+        label_nd = label if isinstance(label, NDArray) else None
+        if data_nd is None or label_nd is None:
+            return False
+        dev = self._ctx.jax_device
+        dval = jax.device_put(data_nd._data, dev)
+        lval = jax.device_put(label_nd._data, dev)
+
+        in_sig = (dval.shape, str(dval.dtype), lval.shape, str(lval.dtype))
+        carry = self._carry if self._carry is not None and \
+            getattr(self, "_carry_sdict", None) is self._updater.states and \
+            getattr(self, "_carry_sig", None) == in_sig and \
+            all(p._data[0]._data is w
+                for p, w in zip(self._train_params, self._carry[0])) and \
+            all(p._data[0]._data is a
+                for p, a in zip(self._aux_params, self._carry[1])) \
+            else None
+
+        states = [self._updater.states[i] for i in self._indices]
+        if carry is not None:
+            ws, auxs, ss = carry
+        else:
+            ws = [p._data[0]._data for p in self._train_params]
+            auxs = tuple(p._data[0]._data for p in self._aux_params)
+            ss = tuple(_state_data(s) for s in states)
+
+        mcarry = []
+        for m in self._metrics:
+            pend = getattr(m, "_device_totals", None)
+            if pend is None:
+                import jax.numpy as jnp
+                pend = (jax.device_put(jnp.zeros((), jnp.float32), dev),
+                        jax.device_put(jnp.zeros((), jnp.int32), dev))
+            mcarry.append(tuple(pend))
+
+        counts_before = dict(opt._index_update_count)
+        num_update_before = opt.num_update
+        for i in self._indices:
+            opt._update_count(i)
+        # recompute the per-parameter vectors only when the BASE values
+        # move (same scheme as fused.FusedTrainStep: multipliers are
+        # static, so the 2xN per-step host calls stay off the hot path)
+        sched = getattr(opt, "lr_scheduler", None)
+        base_lr = sched(opt.num_update) if sched is not None else opt.lr
+        base = (float(base_lr), float(opt.wd), float(opt.rescale_grad),
+                tuple(sorted(getattr(opt, "lr_mult", {}).items())),
+                tuple(sorted(getattr(opt, "wd_mult", {}).items())),
+                _param_dict_mults(opt, self._indices))
+        if getattr(self, "_hyper_base", None) != base:
+            lrs = [float(opt._get_lr(i)) for i in self._indices]
+            wds = [float(opt._get_wd(i)) for i in self._indices]
+            self._hyper_dev = jax.device_put(
+                [_np.asarray(lrs, _np.float32), _np.asarray(wds, _np.float32),
+                 _np.float32(opt.rescale_grad)], dev)
+            self._hyper_base = base
+        lr_dev, wd_dev, rescale_dev = self._hyper_dev
+        t_vec = self._t_vec if carry is not None else None
+        if t_vec is None:
+            t_vec = jax.device_put(_np.asarray(
+                [opt._index_update_count[i] - 1 for i in self._indices],
+                _np.float32), dev)
+
+        try:
+            with _no_rng():
+                new_ws, new_aux, new_ss, new_mcarry, new_t, mean_loss, out = \
+                    self._jit(list(ws), tuple(auxs), ss, mcarry, t_vec,
+                              dval, lval, lr_dev, wd_dev, rescale_dev)
+        except Exception as e:
+            opt._index_update_count = counts_before
+            opt.num_update = num_update_before
+            self._carry = None
+            self._t_vec = None
+            self.broken = True
+            _raise_if_unrecoverable("gluon fused step", e, ws, ss, auxs)
+            _log.warning("gluon fused step unavailable (%s); Estimator "
+                         "uses the eager loop", str(e)[:300])
+            return False
+
+        # write back (params/aux/optimizer state are shared with the eager
+        # path so the two stay interchangeable)
+        for p, nw in zip(self._train_params, new_ws):
+            p._data[0]._set_data(nw)
+        for p, na in zip(self._aux_params, new_aux):
+            p._data[0]._set_data(na)
+        for s, ns in zip(states, new_ss):
+            _state_write_back(s, ns)
+        for m, pend in zip(self._metrics, new_mcarry):
+            m._device_totals = tuple(pend)
+        self._t_vec = new_t
+        self.last_loss = NDArray(mean_loss, ctx=self._ctx)
+        self.last_outputs = NDArray(out, ctx=self._ctx)
+        self._carry = ([p._data[0]._data for p in self._train_params],
+                       tuple(p._data[0]._data for p in self._aux_params),
+                       tuple(_state_data(s) for s in states))
+        self._carry_sig = in_sig
+        self._carry_sdict = self._updater.states
+        return True

@@ -574,11 +574,22 @@ class ImageRecordIterImpl(DataIter):
                            * self._stdinv).transpose(2, 0, 1)
         label_out = label[:, 0] if self.label_width == 1 else label
 
-        import jax
         from .context import current_context
         ctx = current_context()
-        batch_nd = NDArray(jax.device_put(data, ctx.jax_device), ctx=ctx)
-        return DataBatch(data=[batch_nd], label=[array(label_out)],
+        if ctx.device_type == "cpu":
+            # keep the batch as host numpy behind the NDArray: the
+            # training step's input staging sends it STRAIGHT to its
+            # target device/sharding in one transfer, and eager consumers
+            # promote host-backed arrays on first use (invoke()); wrapping
+            # in a cpu-backend jax array here would add a slow
+            # cross-backend hop on the training hot path
+            batch_nd = NDArray(data, ctx=ctx)
+            label_nd = NDArray(label_out, ctx=ctx)
+        else:
+            import jax
+            batch_nd = NDArray(jax.device_put(data, ctx.jax_device), ctx=ctx)
+            label_nd = array(label_out, ctx=ctx)
+        return DataBatch(data=[batch_nd], label=[label_nd],
                          pad=pad, provide_data=self.provide_data,
                          provide_label=self.provide_label)
 

@@ -368,6 +368,13 @@ class Module(BaseModule):
         self.update_metric(eval_metric, data_batch.label)
 
     # -- forward/backward ------------------------------------------------------
+    def prepare(self, data_batch, sparse_row_id_fn=None):
+        """Pre-stage the upcoming batch's device transfer while the
+        current step computes (reference `PrefetcherIter`'s H2D role)."""
+        super().prepare(data_batch, sparse_row_id_fn=sparse_row_id_fn)
+        if self._fused_step is not None and not self._fused_step.broken:
+            self._fused_step.prestage(data_batch)
+
     def _flush_fused(self):
         """Deferred fused-step write-backs must land before anything reads
         the public param/state/aux NDArrays (see fused.FusedTrainStep.flush)."""

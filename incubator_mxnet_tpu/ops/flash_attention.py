@@ -114,6 +114,118 @@ def _fwd_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref,
     l_ref[0] = l_scr[:, 0]
 
 
+def _fwd_kernel_stream(qoff_ref, koff_ref, q_ref, k_ref, v_ref,
+                       o_ref, m_ref, l_ref, acc_scr, m_scr, l_scr,
+                       *, block_k, causal):
+    """KV-streaming variant: one (BH, q-block, KV-block) grid step per
+    invocation, accumulator carried in VMEM scratch across the innermost
+    grid axis.  Holds only ONE (block_k, D) K/V tile in VMEM at a time, so
+    kv_len is bounded by HBM, not VMEM — the long-context envelope
+    (T=32k+ causal) the whole-KV kernel cannot reach.  Causal grid steps
+    entirely above the diagonal skip their compute via pl.when (their
+    block DMA still happens — the structural-skip win of the whole-KV
+    kernel's dynamic loop bounds is the price of streaming)."""
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+    q = q_ref[0]                                # (BQ, D), PRE-SCALED
+    bq = q.shape[0]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, _NEG, jnp.float32)
+        l_scr[:] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[:] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    q_start = qoff_ref[0] + pl.program_id(1) * bq
+    k_start = koff_ref[0] + j * block_k
+
+    def _compute():
+        ks = k_ref[0]                           # (BK, D)
+        vs = v_ref[0]
+        s = jax.lax.dot_general(
+            q, ks, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (BQ, BK)
+        if causal:
+            q_pos = q_start + \
+                jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = k_start + \
+                jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG)
+        m_prev = m_scr[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:, 0] = l_scr[:, 0] * alpha + jnp.sum(p, axis=-1)
+        acc_scr[:] = acc_scr[:] * alpha[:, None] + jax.lax.dot_general(
+            p.astype(vs.dtype), vs, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:, 0] = m_new
+
+    if causal:
+        @pl.when(q_start + bq - 1 >= k_start)
+        def _run():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        o_ref[0] = acc_scr[:].astype(o_ref.dtype)
+        m_ref[0] = m_scr[:, 0]
+        l_ref[0] = l_scr[:, 0]
+
+
+def _stream_tpu(q3, k3, v3, q_off, k_off, causal, block_q, block_k,
+                interpret=False):
+    """KV-streaming pallas_call (see _fwd_kernel_stream)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, Tq, D = q3.shape
+    kv_len = k3.shape[1]
+    scale = 1.0 / (D ** 0.5)
+    q3 = (q3.astype(jnp.float32) * scale).astype(q3.dtype)
+    grid = (BH, pl.cdiv(Tq, block_q), pl.cdiv(kv_len, block_k))
+    kernel = functools.partial(_fwd_kernel_stream, block_k=block_k,
+                               causal=causal)
+    o, m, l = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # q_off (1,)
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # k_off (1,)
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tq, D), q3.dtype),
+            jax.ShapeDtypeStruct((BH, Tq), jnp.float32),
+            jax.ShapeDtypeStruct((BH, Tq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(jnp.asarray([q_off], jnp.int32), jnp.asarray([k_off], jnp.int32),
+      q3, k3, v3)
+    return o, m, l
+
+
+def _vmem_budget_bytes():
+    import os
+    return int(float(os.environ.get("MXNET_FLASH_VMEM_MB", 10)) * 2 ** 20)
+
+
 def _partial_tpu(q3, k3, v3, q_off, k_off, causal, block_q, block_k,
                  interpret=False):
     """(BH, Tq, D) partial attention on TPU via the Pallas kernel."""
@@ -129,6 +241,13 @@ def _partial_tpu(q3, k3, v3, q_off, k_off, causal, block_q, block_k,
         block_q //= 2
     while kv_len % block_k:
         block_k //= 2
+    # whole-KV kernel maps (kv_len, D) K and V blocks into VMEM (fast, and
+    # its dynamic loop bounds skip above-diagonal blocks entirely); past
+    # the VMEM budget, stream KV tiles through the grid instead
+    kv_bytes = 2 * kv_len * D * q3.dtype.itemsize
+    if kv_bytes > _vmem_budget_bytes():
+        return _stream_tpu(q3, k3, v3, q_off, k_off, causal,
+                           block_q, block_k, interpret=interpret)
     # fold the softmax scale into q once (saves a full VPU pass over the
     # (BQ, BK) score block per inner iteration)
     scale = 1.0 / (D ** 0.5)

@@ -59,7 +59,6 @@ def _conv_nhwc(params, x, weight, *rest):
     """2-D Convolution on NHWC activations; weight stays OIHW at the API
     (checkpoints unchanged), transposed to HWIO inside the program (XLA
     folds the small weight transpose into its own layout assignment)."""
-    kernel = tuple(params["kernel"])
     stride = _tup(params["stride"], 2, 1)
     dilate = _tup(params["dilate"], 2, 1)
     pad = _tup(params["pad"], 2, 0)

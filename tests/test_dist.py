@@ -283,3 +283,80 @@ def test_async_push_applies_immediately():
             else:
                 os.environ[k] = v
         server.shutdown()
+
+
+WORKER_COLLECTIVE_COMPRESS = r"""
+import os
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.dist import kvstore_dist
+
+os.environ["MXNET_KVSTORE_COLLECTIVE"] = "1"
+kv = mx.kv.create("dist_sync")
+rank, nw = kv.rank, kv.num_workers
+assert kv._collective is not None
+
+# spy on the collective payload dtype: compressed gradients must ride the
+# interconnect at bf16 (half of fp32) — the collective-mode reading of the
+# reference's wire compression (gradient_compression.h)
+payload_dtypes = []
+orig_many = kv._collective.allreduce_many
+orig_one = kv._collective.allreduce
+def spy_many(arrs):
+    payload_dtypes.extend(str(a.dtype) for a in arrs)
+    return orig_many(arrs)
+def spy_one(a):
+    payload_dtypes.append(str(a.dtype))
+    return orig_one(a)
+kv._collective.allreduce_many = spy_many
+kv._collective.allreduce = spy_one
+
+kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+n = 64
+kv.init("g", nd.zeros((n,)))
+payload_dtypes.clear()           # init broadcast stays full width
+grad = np.linspace(-1, 1, n).astype("f4") * (rank + 1)
+kv.push("g", nd.array(grad))
+out = nd.zeros((n,))
+kv.pull("g", out=out)
+expect = np.zeros(n, "f4")
+for r in range(nw):
+    g = np.linspace(-1, 1, n).astype("f4") * (r + 1)
+    expect += np.where(g >= .5, .5, np.where(g <= -.5, -.5, 0.)).astype("f4")
+np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-2, atol=1e-3)
+assert payload_dtypes and all(d == "bfloat16" for d in payload_dtypes), \
+    payload_dtypes
+kv._barrier()
+kv.close()
+print("worker %d OK" % rank)
+"""
+
+
+def test_dist_collective_compression_halves_payload(tmp_path):
+    """Collective mode + 2-bit compression: gradients quantize with error
+    feedback device-side and the global all-reduce payload is bf16."""
+    from incubator_mxnet_tpu.dist.server import ParameterServer
+
+    n_workers = 2
+    script = tmp_path / "worker_cc.py"
+    script.write_text(WORKER_COLLECTIVE_COMPRESS)
+    server = ParameterServer(num_workers=n_workers).start()
+    env = dict(os.environ,
+               DMLC_PS_ROOT_URI="127.0.0.1",
+               DMLC_PS_ROOT_PORT=str(server.port),
+               DMLC_NUM_WORKER=str(n_workers),
+               DMLC_ROLE="worker",
+               JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    procs = [subprocess.Popen([sys.executable, str(script)],
+                              env=dict(env, DMLC_RANK=str(r)),
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT)
+             for r in range(n_workers)]
+    outs = [p.communicate(timeout=240)[0].decode() for p in procs]
+    server.shutdown()
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {r} failed:\n{out}"
