@@ -404,8 +404,8 @@ def _compute_gradients(heads, head_grads, retain_graph=False,
 
     st = _st()
     tape = st.tape
-    if wanted_ids is not None and \
-            os.environ.get("MXNET_FUSED_BACKWARD", "1") != "0":
+    from . import config as _config
+    if wanted_ids is not None and _config.get("MXNET_FUSED_BACKWARD"):
         try:
             fused = _compute_gradients_fused(heads, head_grads,
                                              retain_graph, wanted_ids)

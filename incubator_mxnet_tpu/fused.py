@@ -395,6 +395,7 @@ class FusedTrainStep:
         self.last_outputs = None
         self.broken = False
         self._carry = None  # steady-state fast-path cache (see __call__)
+        self._derive_ws = False  # set by _build (see _master_positions)
 
     # -- placement of persistent buffers -------------------------------------
     # Every call normalizes buffer shardings (a no-op once placed): other
@@ -449,6 +450,51 @@ class FusedTrainStep:
             for a, v in zip(todo, moved):
                 a._set_data(v)
 
+    # -- derived low-precision weights ---------------------------------------
+    def _master_positions(self):
+        """For every trainable param, the leaf index of its fp32 master in
+        the optimizer-state pytree — or None when any param lacks one.
+
+        When every weight has a master (bf16/fp16 multi-precision
+        training), the low-precision weights need not be dispatch
+        arguments at all: the program derives them from the masters at
+        entry (one cast XLA fuses into the first consumer), dropping
+        n_params input leaves + donation aliases from every step."""
+        import jax
+        exec0 = self._exec0
+        upd = self._updater
+        pos = []
+        for i, n in zip(self._indices, self._param_names):
+            w = exec0.arg_dict[n]
+            if _np.dtype(w.dtype) == _np.float32:
+                return None
+            leaves = jax.tree_util.tree_leaves(
+                _state_data(upd.states.get(i)))
+            cands = [j for j, lf in enumerate(leaves)
+                     if str(getattr(lf, "dtype", "")) == "float32"
+                     and tuple(getattr(lf, "shape", ())) == tuple(w.shape)]
+            if len(cands) == 1:
+                pos.append(cands[0])
+                continue
+            if not cands:
+                return None
+            # ambiguous (e.g. adam: mean/var/master all fp32 of the same
+            # shape): probe the optimizer's state structure with a tiny
+            # nonzero weight and find the leaf equal to its fp32 copy
+            from .ndarray.ndarray import array as _arr
+            tw = _arr(_np.linspace(0.1, 0.9, 4, dtype=_np.float32),
+                      ctx=w.context, dtype=w.dtype)
+            ps = self._opt.create_state_multi_precision(i, tw)
+            pl = jax.tree_util.tree_leaves(_state_data(ps))
+            target = _np.asarray(tw._data, _np.float32)
+            hit = [j for j in cands
+                   if j < len(pl) and
+                   _np.array_equal(_np.asarray(pl[j], _np.float32), target)]
+            if len(hit) != 1:
+                return None
+            pos.append(hit[0])
+        return pos
+
     # -- the traced step -----------------------------------------------------
     def _build(self, metric_fns):
         import jax
@@ -464,9 +510,13 @@ class FusedTrainStep:
         indices = self._indices
         ctx = self._contexts[0]
         n_rng = self._n_rng
+        mp_pos = self._master_positions()
+        self._derive_ws = mp_pos is not None and len(mp_pos) > 0
+        w_dtypes = [self._exec0.arg_dict[n].dtype
+                    for n in self._param_names]
 
-        def step(ws, ss, auxs, mcarry, key, t_vec, inputs, fixed,
-                 lr_vec, wd_vec, rescale):
+        def step_body(ws, ss, auxs, mcarry, key, t_vec, inputs, fixed,
+                      lr_vec, wd_vec, rescale):
             # t advances IN-GRAPH (donated carry): the host passes the
             # update counts once when (re)arming and never re-uploads the
             # vector — keeping every steady-state dispatch argument a
@@ -516,7 +566,21 @@ class FusedTrainStep:
             return new_ws, new_ss, tuple(new_aux), tuple(new_mcarry), key, \
                 t_vec, tuple(outs)
 
-        self._jit = jax.jit(step, donate_argnums=(0, 1, 2, 3, 4, 5))
+        if self._derive_ws:
+            def step(ss, auxs, mcarry, key, t_vec, inputs, fixed,
+                     lr_vec, wd_vec, rescale):
+                import jax as _jax
+                ws = [_jax.tree_util.tree_leaves(s)[p].astype(dt)
+                      for s, p, dt in zip(ss, mp_pos, w_dtypes)]
+                return step_body(ws, ss, auxs, mcarry, key, t_vec, inputs,
+                                 fixed, lr_vec, wd_vec, rescale)
+            self._jit = jax.jit(step, donate_argnums=(0, 1, 2, 3, 4))
+        else:
+            def step(ws, ss, auxs, mcarry, key, t_vec, inputs, fixed,
+                     lr_vec, wd_vec, rescale):
+                return step_body(ws, ss, auxs, mcarry, key, t_vec, inputs,
+                                 fixed, lr_vec, wd_vec, rescale)
+            self._jit = jax.jit(step, donate_argnums=(0, 1, 2, 3, 4, 5))
 
     # -- per-call ------------------------------------------------------------
     def _metric_leaves(self, eval_metric):
@@ -578,11 +642,13 @@ class FusedTrainStep:
                 carry = None
         # a metric change forces the cold path too — decide BEFORE the
         # flush block, which must run whenever the cold path will read the
-        # exec-dict arrays (in steady state they were donated last step)
-        if self._jit is None or metric_fns_changed(self._metric_sig(),
-                                                   metric_fns):
+        # exec-dict arrays (in steady state they were donated last step);
+        # the build itself runs AFTER placement (it probes the optimizer
+        # states _place_all creates)
+        need_build = self._jit is None or \
+            metric_fns_changed(self._metric_sig(), metric_fns)
+        if need_build:
             self._metric_ids = [id(m) for _, m in metric_fns]
-            self._build(metric_fns)
             carry = None
         if carry is None:
             if self._owns_exec_buffers():
@@ -593,6 +659,8 @@ class FusedTrainStep:
                 # path); stale pending results must not clobber them
                 self._flushed = True
             self._place_all()
+        if need_build:
+            self._build(metric_fns)
 
         exec0 = self._exec0
         data = list(data_batch.data) + list(data_batch.label or [])
@@ -688,9 +756,19 @@ class FusedTrainStep:
 
         try:
             with _no_rng():
-                new_ws, new_ss, new_aux, new_mcarry, new_key, new_t, outs = \
-                    self._jit(ws, tuple(ss), auxs, mcarry, self._key, t_vec,
-                              inputs, fixed, lr_dev, wd_dev, rescale_dev)
+                if self._derive_ws:
+                    # low-precision weights are derived from the fp32
+                    # masters inside the program: n_params fewer input
+                    # leaves and donation aliases per dispatch
+                    new_ws, new_ss, new_aux, new_mcarry, new_key, new_t, \
+                        outs = self._jit(tuple(ss), auxs, mcarry, self._key,
+                                         t_vec, inputs, fixed, lr_dev,
+                                         wd_dev, rescale_dev)
+                else:
+                    new_ws, new_ss, new_aux, new_mcarry, new_key, new_t, \
+                        outs = self._jit(ws, tuple(ss), auxs, mcarry,
+                                         self._key, t_vec, inputs, fixed,
+                                         lr_dev, wd_dev, rescale_dev)
         except Exception as e:
             opt._index_update_count = counts_before
             opt.num_update = num_update_before

@@ -214,10 +214,14 @@ class Estimator:
         # optimizer + aux + metric) as ONE donated XLA program per input
         # signature (gluon/fused_step.py), with transparent fallback to
         # the reference eager loop below
+        from ... import config as _config
         fused = getattr(self, "_fused", None)
-        if fused is not None and fused._trainer is not self.trainer:
-            fused = self._fused = None   # trainer replaced: rebuild
-        if _os.environ.get("MXNET_FUSED_TRAIN_STEP", "1") == "0":
+        if fused is not None and (
+                fused._trainer is not self.trainer or
+                fused._loss_fn is not self.loss or
+                fused._metrics != list(self.train_metrics)):
+            fused = self._fused = None   # trainer/loss/metrics replaced
+        if not _config.get("MXNET_FUSED_TRAIN_STEP"):
             fused = None
         elif fused is None:
             from ..fused_step import GluonFusedStep

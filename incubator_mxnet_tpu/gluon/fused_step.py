@@ -69,6 +69,14 @@ class GluonFusedStep:
                 return None
             if getattr(trainer, "_zero", None) is not None:
                 return None
+            # every net parameter must be trainer-owned: anything outside
+            # trainer._params would trace as a CONSTANT, silently ignoring
+            # later set_data/load_parameters on e.g. frozen layers
+            owned = {p.name for p in trainer._params}
+            net_params = set(net.collect_params().keys()) \
+                if hasattr(net, "collect_params") else owned
+            if not net_params <= owned:
+                return None
             for m in metrics:
                 if getattr(m, "device_update", None) is None:
                     return None

@@ -324,8 +324,8 @@ class Module(BaseModule):
 
     def _fusable(self, kvstore):
         """Whether fit can run the single-program fused train step."""
-        import os
-        if os.environ.get("MXNET_FUSED_TRAIN_STEP", "1") == "0":
+        from .. import config as _config
+        if not _config.get("MXNET_FUSED_TRAIN_STEP"):
             return False
         if self._state_names or self.inputs_need_grad or not self.for_training:
             return False
@@ -372,8 +372,12 @@ class Module(BaseModule):
         """Pre-stage the upcoming batch's device transfer while the
         current step computes (reference `PrefetcherIter`'s H2D role)."""
         super().prepare(data_batch, sparse_row_id_fn=sparse_row_id_fn)
-        if self._fused_step is not None and not self._fused_step.broken:
-            self._fused_step.prestage(data_batch)
+        fs = self._fused_step
+        if fs is not None and not fs.broken and fs._carry is not None:
+            # only while the fused path is ACTIVE (a step has run and the
+            # carry is armed): otherwise the eager path would transfer the
+            # batch a second time
+            fs.prestage(data_batch)
 
     def _flush_fused(self):
         """Deferred fused-step write-backs must land before anything reads

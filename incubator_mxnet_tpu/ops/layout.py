@@ -43,8 +43,8 @@ __all__ = ["enabled", "to_nhwc", "to_nchw", "NATIVE", "AGNOSTIC",
 
 
 def enabled():
-    return os.environ.get("MXNET_INTERNAL_CONV_LAYOUT",
-                          "NCHW").upper() == "NHWC"
+    from .. import config as _config
+    return str(_config.get("MXNET_INTERNAL_CONV_LAYOUT")).upper() == "NHWC"
 
 
 def to_nhwc(x):

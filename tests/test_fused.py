@@ -237,3 +237,46 @@ def test_fused_metric_swap_mid_training():
             assert np.isfinite(v.asnumpy()).all(), k
     finally:
         os.environ.pop("MXNET_FUSED_TRAIN_STEP", None)
+
+
+def test_fused_bf16_multiprecision_derived_masters():
+    """bf16 weights with fp32 masters: the fused program derives the
+    low-precision weights from the masters in-graph (no weight args on
+    the dispatch), and matches the unfused multi-precision path."""
+    import ml_dtypes
+
+    def run(fused_on):
+        os.environ["MXNET_FUSED_TRAIN_STEP"] = "1" if fused_on else "0"
+        try:
+            np.random.seed(3)
+            mx.random.seed(3)
+            X, y = _data()
+            Xb = X.astype(ml_dtypes.bfloat16)
+            it = io.NDArrayIter(Xb, y, batch_size=32, shuffle=False,
+                                label_name="softmax_label")
+            mod = mx.mod.Module(_make_symbol(), context=mx.cpu())
+            mod.bind(data_shapes=it.provide_data,
+                     label_shapes=it.provide_label)
+            mod.init_params(mx.initializer.Xavier())
+            mod.init_optimizer(
+                kvstore=None, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                                  "multi_precision": True})
+            metric = mx.metric.create("acc")
+            batches = list(it)
+            for s in range(5):
+                mod.fit_step(batches[s % len(batches)], metric)
+            args, _ = mod.get_params()
+            return ({k: np.asarray(v.asnumpy(), np.float32)
+                     for k, v in args.items()}, mod)
+        finally:
+            os.environ.pop("MXNET_FUSED_TRAIN_STEP", None)
+
+    w_fused, mod = run(True)
+    assert mod._fused_step is not None and not mod._fused_step.broken
+    assert mod._fused_step._derive_ws, \
+        "all-bf16 multi-precision training must use derived masters"
+    w_eager, _ = run(False)
+    for k in w_fused:
+        np.testing.assert_allclose(w_fused[k], w_eager[k], rtol=2e-2,
+                                   atol=1e-2, err_msg=k)

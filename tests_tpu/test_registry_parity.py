@@ -222,6 +222,12 @@ def _generic_names():
     for n, op in sorted(_distinct_ops().items()):
         if n in CASES or n in SKIP:
             continue
+        if n.startswith("_grad_of_") or n.startswith("_cached_op"):
+            # derived ops materialize lazily while earlier tests run
+            # (create_graph gradients; hybridize() CachedOp wrappers);
+            # they are internal wrappers of already-triaged base ops and
+            # user graphs, not public surface
+            continue
         req = [k for k, v in op.params.items() if v is REQUIRED]
         if op.needs_rng or op.nin < 0 or req:
             out.append((n, "unhandled"))
