@@ -280,3 +280,69 @@ def test_fused_bf16_multiprecision_derived_masters():
     for k in w_fused:
         np.testing.assert_allclose(w_fused[k], w_eager[k], rtol=2e-2,
                                    atol=1e-2, err_msg=k)
+
+
+def test_fused_prestage_matches_direct():
+    """Module.prepare pre-stages the NEXT batch's transfer; results must be
+    identical to calling fit_step without any prestage."""
+    def run(with_prepare):
+        os.environ["MXNET_FUSED_TRAIN_STEP"] = "1"
+        try:
+            np.random.seed(5)
+            mx.random.seed(5)
+            X, y = _data()
+            it = io.NDArrayIter(X, y, batch_size=32, shuffle=False,
+                                label_name="softmax_label")
+            mod = mx.mod.Module(_make_symbol(), context=mx.cpu())
+            mod.bind(data_shapes=it.provide_data,
+                     label_shapes=it.provide_label)
+            mod.init_params(mx.initializer.Xavier())
+            mod.init_optimizer(kvstore=None, optimizer="sgd",
+                               optimizer_params={"learning_rate": 0.1})
+            metric = mx.metric.create("acc")
+            batches = list(it)
+            for s in range(4):
+                b = batches[s % len(batches)]
+                mod.fit_step(b, metric)
+                if with_prepare:
+                    nb = batches[(s + 1) % len(batches)]
+                    mod.prepare(nb)  # pre-stage next batch mid-flight
+            args, _ = mod.get_params()
+            return {k: v.asnumpy() for k, v in args.items()}
+        finally:
+            os.environ.pop("MXNET_FUSED_TRAIN_STEP", None)
+
+    w_pre = run(True)
+    w_direct = run(False)
+    for k in w_pre:
+        np.testing.assert_array_equal(w_pre[k], w_direct[k], err_msg=k)
+
+
+def test_fused_lr_mult_change_invalidates_hyper_cache():
+    """Freezing a layer mid-training via lr_mult must take effect on the
+    very next fused step (the hyper-vector cache keys on multipliers)."""
+    os.environ["MXNET_FUSED_TRAIN_STEP"] = "1"
+    try:
+        np.random.seed(6)
+        mx.random.seed(6)
+        X, y = _data()
+        it = io.NDArrayIter(X, y, batch_size=32, shuffle=False,
+                            label_name="softmax_label")
+        mod = mx.mod.Module(_make_symbol(), context=mx.cpu())
+        mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+        mod.init_params(mx.initializer.Xavier())
+        mod.init_optimizer(kvstore=None, optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        metric = mx.metric.create("acc")
+        batches = list(it)
+        for s in range(3):
+            mod.fit_step(batches[s % len(batches)], metric)
+        frozen = mod.get_params()[0]["fc1_weight"].asnumpy().copy()
+        mod._optimizer.lr_mult = {"fc1_weight": 0.0}   # freeze fc1
+        for s in range(3):
+            mod.fit_step(batches[s % len(batches)], metric)
+        after = mod.get_params()[0]["fc1_weight"].asnumpy()
+        np.testing.assert_array_equal(after, frozen,
+                                      err_msg="lr_mult=0 must freeze fc1")
+    finally:
+        os.environ.pop("MXNET_FUSED_TRAIN_STEP", None)
