@@ -92,6 +92,17 @@ class KVStore:
                 raise MXNetError(f"Key {k} already initialized")
             self._store[_key(k)] = v.copyto(self._store_ctx)
 
+    def _commit(self, k, merged):
+        """Apply a reduced value to the store: updater when installed,
+        else overwrite (shared by per-key and batched push paths)."""
+        sk = _key(k)
+        if self._updater is not None:
+            self._updater(_updater_key(k), merged, self._store[sk])
+        else:
+            self._store[sk]._set_data(
+                merged.copyto(self._store_ctx)._data.astype(
+                    self._store[sk].dtype))
+
     def push(self, key, value, priority=0):
         """Push values; multi-device lists are reduced (summed) first
         (reference `kvstore_local.h:184 PushImpl` → `comm.h Reduce`)."""
@@ -103,12 +114,7 @@ class KVStore:
             merged = self._reduce(vals)
             if self._compression is not None:
                 merged = self._compress(sk, merged)
-            if self._updater is not None:
-                self._updater(_updater_key(k), merged, self._store[sk])
-            else:
-                self._store[sk]._set_data(
-                    merged.copyto(self._store_ctx)._data.astype(
-                        self._store[sk].dtype))
+            self._commit(k, merged)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Broadcast stored value to out arrays (reference `comm.h:209 Broadcast`)."""
@@ -263,6 +269,9 @@ class KVStoreTPU(KVStore):
         self._allreduce_jit = {}  # tuple(device ids) -> jitted shard_map psum
         # last mesh a key was pushed over; lets pull() reuse the same devices
         self._key_mesh = {}
+        self._concat_jit = None  # lazy shared flatten+concat program
+        self._split_jit = {}     # (device ids, shapes) -> split program
+        self.allreduce_dispatches = 0   # tests assert one per step
 
     def _mesh_for(self, devices):
         ids = tuple(d.id for d in devices)
@@ -306,20 +315,27 @@ class KVStoreTPU(KVStore):
                 acc = acc + jax.device_put(v._data, devices[0])
             return NDArray(acc, ctx=vals[0].context)
         mesh = self._mesh_for(devices)
+        shape = tuple(vals[0].shape)
+        return NDArray(
+            self._mesh_allreduce(mesh, shape,
+                                 [v._data for v in vals],
+                                 vals[0].context.jax_device.id),
+            ctx=vals[0].context)
+
+    def _mesh_allreduce(self, mesh, shape, shards, lead_id):
+        """Assemble per-device shards into one mesh array, psum with ONE
+        collective, return the lead device's replicated shard (downstream
+        single-device math sees an ordinary committed array; the pull path
+        re-broadcasts with one collective)."""
+        import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
-        shape = vals[0].shape
         global_arr = jax.make_array_from_single_device_arrays(
-            (len(vals),) + shape,
-            NamedSharding(mesh, P("dev")),
-            [v._data.reshape((1,) + shape) for v in vals])
-        summed = self._allreduce(mesh)(global_arr)   # replicated on mesh
-        # collapse to the lead device's shard so downstream single-device
-        # updater math sees an ordinary committed array (the pull path
-        # re-broadcasts with one collective)
-        lead = vals[0].context.jax_device.id
-        local = next(s.data for s in summed.addressable_shards
-                     if s.device.id == lead)
-        return NDArray(local, ctx=vals[0].context)
+            (len(shards),) + shape, NamedSharding(mesh, P("dev")),
+            [b.reshape((1,) + shape) for b in shards])
+        self.allreduce_dispatches += 1
+        summed = self._allreduce(mesh)(global_arr)
+        return next(s.data for s in summed.addressable_shards
+                    if s.device.id == lead_id)
 
     def _record_key_mesh(self, sk, vals):
         """Remember the device set a key was pushed over so pull() can use
@@ -329,10 +345,74 @@ class KVStoreTPU(KVStore):
             if len({d.id for d in devs}) == len(devs):
                 self._key_mesh[sk] = self._mesh_for(devs)
 
+    @property
+    def prefers_batched_push(self):
+        """Multi-key push/pull should arrive as one call: the whole key
+        list reduces with ONE collective (`_reduce_many`) instead of one
+        per parameter (the reference's batched NCCL push, `model.py:125`)."""
+        return True
+
+    def _reduce_many(self, values):
+        """Bucketed multi-key reduce: per device, flatten+concat every
+        key's local shard (one program per device), ONE psum over the
+        bucket, split the lead shard back.  ~ndev+2 dispatches per step
+        instead of 2 per key."""
+        import jax
+        import jax.numpy as jnp
+
+        first_devs = [v.context.jax_device for v in values[0]]
+        ids0 = tuple(d.id for d in first_devs)
+        same = all(
+            tuple(v.context.jax_device.id for v in vals) == ids0
+            and vals[0].dtype == values[0][0].dtype
+            for vals in values)
+        if not same or len(first_devs) == 1 or \
+                len(set(ids0)) != len(ids0):
+            return [self._reduce(vals) for vals in values]
+
+        shapes = [tuple(vals[0].shape) for vals in values]
+        sizes = [int(_np.prod(s)) if s else 1 for s in shapes]
+        offs = _np.cumsum([0] + sizes)
+        total = int(offs[-1])
+        mesh = self._mesh_for(first_devs)
+
+        if self._concat_jit is None:
+            # one shape-agnostic program: jit's own cache specializes per
+            # input signature
+            self._concat_jit = jax.jit(lambda *xs: jnp.concatenate(
+                [x.reshape(-1) for x in xs]))
+        cat = self._concat_jit
+        per_dev = []
+        for d in range(len(first_devs)):
+            per_dev.append(cat(*[vals[d]._data for vals in values]))
+        local = self._mesh_allreduce(mesh, (total,), per_dev,
+                                     first_devs[0].id)
+        split = self._split_jit.get((ids0, tuple(shapes)))
+        if split is None:
+            def _split(buf, shapes=shapes, offs=offs):
+                return tuple(
+                    jax.lax.dynamic_slice_in_dim(
+                        buf, int(offs[k]), sizes[k]).reshape(shapes[k])
+                    for k in range(len(shapes)))
+            split = jax.jit(_split)
+            self._split_jit[(ids0, tuple(shapes))] = split
+        pieces = split(local)
+        ctx0 = values[0][0].context
+        return [NDArray(p, ctx=ctx0) for p in pieces]
+
     def push(self, key, value, priority=0):
         keys, values = _normalize_push(key, value)
         for k, vals in zip(keys, values):
             self._record_key_mesh(_key(k), vals)
+        if len(keys) > 1 and self._compression is None and \
+                all(len(vals) > 1 for vals in values):
+            for k in keys:
+                if _key(k) not in self._store:
+                    raise MXNetError(f"Key {k} has not been initialized")
+            merged = self._reduce_many(values)
+            for k, m in zip(keys, merged):
+                self._commit(k, m)
+            return
         super().push(key, value, priority)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):

@@ -76,12 +76,23 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
     """Reference `model.py _update_params`: reduce via kvstore (optional),
     update locally per device."""
     updates = [[] for _ in range(num_device)]
+    batched = kvstore is not None and getattr(
+        kvstore, "prefers_batched_push", False)
+    if batched:
+        # one bucketed reduce for the whole key list up front (reference
+        # batched NCCL push, `model.py:125`); the per-param loop below
+        # then only accumulates the local updates
+        idxs = [i for i, g in enumerate(grad_arrays) if g[0] is not None]
+        if idxs:
+            names = [param_names[i] for i in idxs]
+            kvstore.push(names, [grad_arrays[i] for i in idxs])
+            kvstore.pull(names, [grad_arrays[i] for i in idxs])
     for i, pair in enumerate(zip(param_arrays, grad_arrays)):
         arg_list, grad_list = pair
         if grad_list[0] is None:
             continue
         index = i
-        if kvstore:
+        if kvstore and not batched:
             name = param_names[index]
             kvstore.push(name, grad_list, priority=-index)
             kvstore.pull(name, grad_list, priority=-index)

@@ -152,3 +152,42 @@ def test_errors():
     kv.init(1, nd.ones(SHAPE))
     with pytest.raises(mx.MXNetError):
         kv.init(1, nd.ones(SHAPE))  # double init
+
+
+def test_tpu_kvstore_bucketed_multikey_push():
+    """Multi-key push over a device mesh rides ONE fused all-reduce
+    (bucketed `_reduce_many`), not one collective per key — and matches
+    per-key results exactly (reference batched NCCL push, model.py:125)."""
+    import jax
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd
+
+    devs = [mx.cpu(i) for i in range(4)]
+    kv = mx.kv.create("device")
+    keys = ["a", "b", "c"]
+    shapes = [(3,), (2, 2), (5, 1)]
+    rng = np.random.RandomState(0)
+    vals = {k: [rng.randn(*s).astype("f4") for _ in devs]
+            for k, s in zip(keys, shapes)}
+    for k, s in zip(keys, shapes):
+        kv.init(k, nd.zeros(s))
+    before = kv.allreduce_dispatches
+    kv.push(keys, [[nd.array(v, ctx=d) for v, d in zip(vals[k], devs)]
+                   for k in keys])
+    assert kv.allreduce_dispatches == before + 1, \
+        "batched multi-key push must issue ONE bucketed all-reduce"
+    for k, s in zip(keys, shapes):
+        out = nd.zeros(s)
+        kv.pull(k, out=out)
+        np.testing.assert_allclose(out.asnumpy(),
+                                   np.sum(vals[k], axis=0), rtol=1e-6)
+
+    # per-key push gives identical results (semantics unchanged)
+    kv2 = mx.kv.create("device")
+    for k, s in zip(keys, shapes):
+        kv2.init(k, nd.zeros(s))
+        kv2.push(k, [nd.array(v, ctx=d) for v, d in zip(vals[k], devs)])
+        o1, o2 = nd.zeros(s), nd.zeros(s)
+        kv.pull(k, out=o1)
+        kv2.pull(k, out=o2)
+        np.testing.assert_allclose(o1.asnumpy(), o2.asnumpy(), rtol=1e-6)
