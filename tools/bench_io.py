@@ -60,7 +60,10 @@ def measure(path, batch_size, shape, threads, epochs=1):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--n", type=int, default=1024)
+    # corpus >= ~24 batches at the default batch size: a smaller corpus
+    # makes the measured window warmup/edge-dominated (epoch boundaries,
+    # pool refill) and under-reports steady-state throughput
+    ap.add_argument("--n", type=int, default=3072)
     ap.add_argument("--size", type=int, default=256)
     ap.add_argument("--batch", type=int, default=128)
     ap.add_argument("--crop", type=int, default=224)

@@ -83,16 +83,27 @@ def phase_module():
     data = nd_batch_inputs(fused, it, mx)
     fixed = [fused._exec0.arg_dict[n]._data for n in fused._fixed_names]
     lr_dev, wd_dev, rescale_dev = fused._hyper_dev
-    jit = fused._jit._jit
+    jit = fused._jit
 
-    out = jit(ws, tuple(ss), auxs, mcarry, key, t_vec, data, fixed,
-              lr_dev, wd_dev, rescale_dev)
-    float(out[3][0][0])   # value fetch = the only reliable barrier here
-    t0 = time.perf_counter()
-    for _ in range(STEPS):
-        out = jit(list(out[0]), out[1], list(out[2]), list(out[3]), out[4],
-                  out[5], data, fixed, lr_dev, wd_dev, rescale_dev)
-    float(out[3][0][0])
+    if fused._derive_ws:
+        out = jit(tuple(ss), auxs, mcarry, key, t_vec, data, fixed,
+                  lr_dev, wd_dev, rescale_dev)
+        float(out[3][0][0])   # value fetch = the only reliable barrier
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            out = jit(out[1], list(out[2]), list(out[3]), out[4], out[5],
+                      data, fixed, lr_dev, wd_dev, rescale_dev)
+        float(out[3][0][0])
+    else:
+        out = jit(ws, tuple(ss), auxs, mcarry, key, t_vec, data, fixed,
+                  lr_dev, wd_dev, rescale_dev)
+        float(out[3][0][0])
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            out = jit(list(out[0]), out[1], list(out[2]), list(out[3]),
+                      out[4], out[5], data, fixed, lr_dev, wd_dev,
+                      rescale_dev)
+        float(out[3][0][0])
     dt = time.perf_counter() - t0
     emit("module_rawcall", img_s=BATCH * STEPS / dt,
          ms_per_step=1000.0 * dt / STEPS)
