@@ -24,9 +24,15 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 A SIGALRM watchdog (BENCH_BUDGET_S, default 480 s) emits a partial result
 instead of dying silently.
 
-Env overrides: BENCH_BATCH (128), BENCH_IMAGE (224), BENCH_STEPS (20),
+Env overrides: BENCH_BATCH (128), BENCH_IMAGE (224), BENCH_STEPS (48),
 BENCH_DTYPE (bfloat16), BENCH_BUDGET_S (480), BENCH_CONTROL (1),
 BENCH_FP32 (1), BENCH_REAL_DATA (1).
+
+The fit loop runs K steps per dispatch (MXNET_FUSED_STEP_BLOCK, default
+8) as one lax.scan program; callbacks fire in bursts of K after each
+block, so the probe's warm-up and measurement window are sized to block
+boundaries (warm = K, steps rounded up to a K multiple) — the metric
+get() at each edge is a true device sync either way.
 """
 from __future__ import annotations
 
@@ -39,6 +45,9 @@ import time
 import numpy as np
 
 BASELINE_IMG_S = 363.69  # reference ResNet-50 training, V100 bs=128
+
+# fit-loop dispatch block size: probe windows align to block boundaries
+_BLOCK = max(int(os.environ.get("MXNET_FUSED_STEP_BLOCK", "8") or 1), 1)
 
 _RESULT = {
     "metric": "resnet50_train_img_per_sec",
@@ -172,8 +181,10 @@ def _run_framework(batch, image, steps, dtype):
     mx.random.seed(0)
     t0 = time.perf_counter()
     mod, ctx = _build_module(mx, batch, image, dtype)
-    warm = 2
-    it = _synthetic_iter(mx, batch, image, dtype, warm + steps + 1, ctx)
+    warm = _BLOCK
+    # last probe edge (warm+steps) must land inside a full block: feed
+    # exactly one block past it, no ragged tail
+    it = _synthetic_iter(mx, batch, image, dtype, warm + steps + _BLOCK, ctx)
     probe = _Probe(warm, steps, batch)
     init_s = time.perf_counter() - t0
 
@@ -228,7 +239,7 @@ def _run_gluon(batch, image, steps, dtype):
     data = nd.array(np.random.rand(batch, 3, image, image).astype("f4"),
                     ctx=ctx).astype(dtype)
     label = nd.array(np.random.randint(0, 1000, batch).astype("f4"), ctx=ctx)
-    warm = 2
+    warm = _BLOCK
     times = {}
 
     class Probe:
@@ -263,7 +274,7 @@ def _run_gluon(batch, image, steps, dtype):
         def train_end(self, est):
             pass
 
-    batches = [(data, label)] * (warm + steps + 1)
+    batches = [(data, label)] * (warm + steps + _BLOCK)
     est.fit(iter(batches), epochs=1, event_handlers=[Probe()])
     assert est._fused is not None and not est._fused.broken, \
         "Estimator must run the fused Gluon step"
@@ -449,9 +460,10 @@ def _run_real_data_in(d, batch, image, steps, dtype):
     _sys.path.insert(0, os.path.join(os.path.dirname(
         os.path.abspath(__file__)), "tools"))
     from bench_io import build_corpus
-    warm = 4
+    warm = _BLOCK
     steps = max(steps, 3 * _REAL_PREFETCH + 2)  # window can't be buffer-fed
-    n_img = batch * (warm + steps + 1)
+    steps = -(-steps // _BLOCK) * _BLOCK        # block-aligned window
+    n_img = batch * (warm + steps + _BLOCK)
     build_corpus(rec, n=n_img, size=image + 32)
 
     # standalone pipeline rate on the same corpus (the input-bound
@@ -489,7 +501,8 @@ def _run_real_data_in(d, batch, image, steps, dtype):
 def main():
     batch = int(os.environ.get("BENCH_BATCH", 128))
     image = int(os.environ.get("BENCH_IMAGE", 224))
-    steps = int(os.environ.get("BENCH_STEPS", 20))
+    steps = int(os.environ.get("BENCH_STEPS", 48))
+    steps = -(-steps // _BLOCK) * _BLOCK   # block-aligned probe window
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
     budget = int(os.environ.get("BENCH_BUDGET_S", 480))
     want_control = os.environ.get("BENCH_CONTROL", "1") == "1"

@@ -100,6 +100,12 @@ KNOBS = {
     "MXNET_FUSED_TRAIN_STEP": (_BOOL, True, "honored",
                                "Module.fit/Estimator.fit single-program "
                                "fused train step (fused.py)"),
+    "MXNET_FUSED_STEP_BLOCK": (int, 8, "honored",
+                               "K train steps per dispatch in Module.fit/"
+                               "Estimator.fit: ONE lax.scan program runs K "
+                               "stacked batches, amortizing host dispatch "
+                               "(batch_end callbacks then fire in bursts "
+                               "of K; set 1 to restore per-step dispatch)"),
     "MXNET_FUSED_BACKWARD": (_BOOL, True, "honored",
                              "eager loss.backward() as ONE jitted tape "
                              "replay per structure (autograd.py)"),

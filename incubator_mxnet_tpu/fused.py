@@ -235,6 +235,76 @@ def _no_rng():
 
 
 # ---------------------------------------------------------------------------
+# once-traced cores: the expensive framework trace captured as a closed
+# jaxpr, replayed cheaply by every program built over it (shared by the
+# Module and Gluon fused steps)
+# ---------------------------------------------------------------------------
+
+class _TracedCore:
+    """`core(inner, x, *extras) -> (new_inner, step_out)` traced ONCE under
+    `make_jaxpr` (this runs the whole framework graph's Python); calling
+    the instance replays the jaxpr in jaxpr-eval time, so the 1-step jit
+    and each K-step scan body re-trace for pennies instead of re-running
+    framework op dispatch."""
+
+    def __init__(self, core, example_args):
+        import jax
+        flat, in_tree = jax.tree_util.tree_flatten(tuple(example_args))
+
+        def flat_core(*leaves):
+            return core(*jax.tree_util.tree_unflatten(in_tree, leaves))
+
+        closed, out_shape = jax.make_jaxpr(
+            flat_core, return_shape=True)(*flat)
+        self._closed = closed
+        self._in_tree = in_tree
+        self._out_tree = jax.tree_util.tree_structure(out_shape)
+        self.out_shape = out_shape   # (inner, step_out) ShapeDtypeStructs
+
+    def __call__(self, *args):
+        import jax
+        from jax.extend.core import jaxpr_as_fun
+        leaves, tree = jax.tree_util.tree_flatten(tuple(args))
+        if tree != self._in_tree:
+            raise TypeError("fused-core signature changed under trace")
+        out = jaxpr_as_fun(self._closed)(*leaves)
+        return jax.tree_util.tree_unflatten(self._out_tree, out)
+
+
+def _one_step_jit(traced):
+    """1-step program over a traced core; the inner carry is donated."""
+    import jax
+
+    def step1(inner, x, *extras):
+        return traced(inner, x, *extras)
+
+    return jax.jit(step1, donate_argnums=(0,))
+
+
+def _scan_block_jit(traced):
+    """K-step program: `lax.scan` of the traced core over K stacked
+    per-step inputs.  Returns (new_inner, ys, last): `ys` stacks every
+    step's outputs (so callers can expose batch j's outputs to a batch-j
+    callback), `last` is step K-1's outputs sliced IN-PROGRAM (no extra
+    host dispatch for the common "latest outputs" read)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def stepk(inner, xs_list, *extras):
+        xs = jax.tree_util.tree_map(lambda *vs: jnp.stack(vs), *xs_list)
+
+        def body(inn, x):
+            return traced(inn, x, *extras)
+
+        new_inner, ys = lax.scan(body, inner, xs)
+        last = jax.tree_util.tree_map(lambda y: y[-1], ys)
+        return new_inner, ys, last
+
+    return jax.jit(stepk, donate_argnums=(0,))
+
+
+# ---------------------------------------------------------------------------
 # FusedOptimizer: all parameter updates in one donated program
 # ---------------------------------------------------------------------------
 
@@ -334,7 +404,8 @@ class FusedOptimizer:
 # ---------------------------------------------------------------------------
 
 class FusedTrainStep:
-    """The `Module.fit` hot loop as one donated XLA program.
+    """The `Module.fit` hot loop as one donated XLA program — or, in block
+    mode, K train steps as one `lax.scan` program per dispatch.
 
     Built by `Module.init_optimizer` when eligible (single-process kvstore,
     plain ``write`` grads, no module states).  Each call:
@@ -342,10 +413,25 @@ class FusedTrainStep:
       host:   advance optimizer counts, gather lr/wd/t scalars
       device: ONE program = forward + vjp + optimizer (traced public
               object) + BN-aux update + metric accumulation + key split
+              — times K when the fit loop hands over a block of batches
 
     Parameters, optimizer state, aux state, the metric accumulator and the
     RNG key are donated carries — steady-state training allocates nothing
-    and dispatches once per batch.
+    and dispatches once per batch (once per K batches in block mode).
+
+    Why blocks: on a host whose dispatches serialize with the device (one
+    remote chip behind a tunnel; also the common single-process case the
+    reference attacks with bulk-exec segments,
+    `src/executor/graph_executor.cc:1194-1316`), the per-step host Python
+    adds 1:1 to wall time.  `lax.scan` over K stacked batches amortizes the
+    dispatch plus all host-side bookkeeping across K steps, which is what
+    lets the public `fit` loop match a hand-pipelined raw-JAX loop.
+
+    The expensive part of building these programs is tracing the framework
+    graph (Python op dispatch over the whole Symbol).  That trace runs ONCE
+    into a closed jaxpr; the 1-step jit and every K-step scan body replay
+    the jaxpr (cheap) instead of re-running framework Python, so adding
+    block mode does not multiply trace time.
     """
 
     def __init__(self, module, updater):
@@ -391,11 +477,15 @@ class FusedTrainStep:
         from .symbol.symbol import graph_eval_fn
         self._gfn, _, _, self._n_rng = graph_eval_fn(self._symbol, True)
         self._key = None
-        self._jit = None
+        self._jit = None          # 1-step program
+        self._jit_block = {}      # K -> K-step scan program
+        self._core_closed = None  # the once-traced step jaxpr
+        self._derive_fn = None    # masters -> low-precision weights (flush)
         self.last_outputs = None
+        self._block_outs = None   # scan ys: per-batch outputs of a block
         self.broken = False
-        self._carry = None  # steady-state fast-path cache (see __call__)
-        self._derive_ws = False  # set by _build (see _master_positions)
+        self._carry = None  # steady-state fast-path cache (see _dispatch)
+        self._derive_ws = False  # set by _build_core (see _master_positions)
 
     # -- placement of persistent buffers -------------------------------------
     # Every call normalizes buffer shardings (a no-op once placed): other
@@ -495,8 +585,10 @@ class FusedTrainStep:
             pos.append(hit[0])
         return pos
 
-    # -- the traced step -----------------------------------------------------
-    def _build(self, metric_fns):
+    # -- the traced step core ------------------------------------------------
+    def _build_core(self, metric_fns):
+        """The one-step train function over raw arrays.  Returned as plain
+        Python; `_trace_core` runs it exactly once under `make_jaxpr`."""
         import jax
         import jax.numpy as jnp
 
@@ -512,11 +604,18 @@ class FusedTrainStep:
         n_rng = self._n_rng
         mp_pos = self._master_positions()
         self._derive_ws = mp_pos is not None and len(mp_pos) > 0
-        w_dtypes = [self._exec0.arg_dict[n].dtype
-                    for n in self._param_names]
+        self._mp_pos = mp_pos
+        self._w_dtypes = [self._exec0.arg_dict[n].dtype
+                          for n in self._param_names]
+        derive = self._derive_ws
+        w_dtypes = self._w_dtypes
 
-        def step_body(ws, ss, auxs, mcarry, key, t_vec, inputs, fixed,
-                      lr_vec, wd_vec, rescale):
+        def core(inner, x, fixed, rescale):
+            ws, ss, auxs, mcarry, key, t_vec = inner
+            inputs, lr_vec, wd_vec = x
+            if derive:
+                ws = [jax.tree_util.tree_leaves(s)[p].astype(dt)
+                      for s, p, dt in zip(ss, mp_pos, w_dtypes)]
             # t advances IN-GRAPH (donated carry): the host passes the
             # update counts once when (re)arming and never re-uploads the
             # vector — keeping every steady-state dispatch argument a
@@ -539,7 +638,7 @@ class FusedTrainStep:
                 outs, new_aux = gfn(tuple(args), tuple(auxs), sub)
                 return tuple(outs), tuple(new_aux)
 
-            outs, vjp, new_aux = jax.vjp(forward, ws, has_aux=True)
+            outs, vjp, new_aux = jax.vjp(forward, list(ws), has_aux=True)
             cts = tuple(
                 jnp.ones(o.shape, o.dtype)
                 if jnp.issubdtype(o.dtype, jnp.floating)
@@ -549,12 +648,16 @@ class FusedTrainStep:
                                            lr_vec, wd_vec, t_vec, rescale)
             # keep the persistent carries in their input layout (replicated
             # for DP; whatever the user sharded for TP/ZeRO)
-            new_ws = [_constrain_like(w, s)
-                      for w, s in zip(new_ws, self._call_w_shardings)]
             new_ss = tuple(_constrain_like(s, sh)
                            for s, sh in zip(new_ss, self._call_s_shardings))
             new_aux = tuple(_constrain_like(a, s)
                             for a, s in zip(new_aux, self._call_a_shardings))
+            if derive:
+                new_ws = ()   # flush re-derives from the masters on demand
+            else:
+                new_ws = tuple(
+                    _constrain_like(w, s)
+                    for w, s in zip(new_ws, self._call_w_shardings))
             labels = inputs[len(inputs) - n_label:] if n_label else ()
             new_mcarry = []
             for (fn, _), (msum, mnum) in zip(metric_fns, mcarry):
@@ -563,24 +666,27 @@ class FusedTrainStep:
                 # incrementing past 2^24 samples
                 new_mcarry.append((msum + jnp.asarray(dsum, jnp.float32),
                                    mnum + jnp.asarray(dnum, jnp.int32)))
-            return new_ws, new_ss, tuple(new_aux), tuple(new_mcarry), key, \
-                t_vec, tuple(outs)
+            new_inner = (new_ws, new_ss, tuple(new_aux), tuple(new_mcarry),
+                         key, t_vec)
+            return new_inner, tuple(outs)
 
-        if self._derive_ws:
-            def step(ss, auxs, mcarry, key, t_vec, inputs, fixed,
-                     lr_vec, wd_vec, rescale):
-                import jax as _jax
-                ws = [_jax.tree_util.tree_leaves(s)[p].astype(dt)
-                      for s, p, dt in zip(ss, mp_pos, w_dtypes)]
-                return step_body(ws, ss, auxs, mcarry, key, t_vec, inputs,
-                                 fixed, lr_vec, wd_vec, rescale)
-            self._jit = jax.jit(step, donate_argnums=(0, 1, 2, 3, 4))
-        else:
-            def step(ws, ss, auxs, mcarry, key, t_vec, inputs, fixed,
-                     lr_vec, wd_vec, rescale):
-                return step_body(ws, ss, auxs, mcarry, key, t_vec, inputs,
-                                 fixed, lr_vec, wd_vec, rescale)
-            self._jit = jax.jit(step, donate_argnums=(0, 1, 2, 3, 4, 5))
+        return core
+
+    def _trace_core(self, core, example):
+        """Run the framework trace ONCE; every program replays the jaxpr."""
+        self._core_closed = _TracedCore(core, example)
+
+    def _build1(self):
+        self._jit = _one_step_jit(self._core_closed)
+
+    def _buildk(self, k):
+        # one scan-jit serves every K (xs arity keys the jit's own cache);
+        # the per-K dict entry is the "this block size has run" record
+        jitk = self._scan_jit if getattr(self, "_scan_jit", None) is not None \
+            else _scan_block_jit(self._core_closed)
+        self._scan_jit = jitk
+        self._jit_block[k] = jitk
+        return jitk
 
     # -- per-call ------------------------------------------------------------
     def _metric_leaves(self, eval_metric):
@@ -604,13 +710,37 @@ class FusedTrainStep:
     def __call__(self, data_batch, eval_metric=None):
         """Run one fused train step.  Returns True when handled (metric
         included); False -> caller must use the unfused path."""
+        return self._dispatch([data_batch], eval_metric)
+
+    def call_block(self, batches, eval_metric=None):
+        """Run len(batches) train steps as ONE `lax.scan` dispatch.
+        Returns True when handled; False -> caller runs them one by one."""
+        return self._dispatch(list(batches), eval_metric)
+
+    def _batch_sig(self, batches):
+        sig = None
+        for b in batches:
+            s = tuple((getattr(v, "shape", None), getattr(v, "dtype", None))
+                      for v in list(b.data) + list(b.label or []))
+            if sig is None:
+                sig = s
+            elif s != sig:
+                return None   # mixed shapes cannot share one program
+        return sig
+
+    def _dispatch(self, batches, eval_metric):
         if self.broken:
             return False
         import jax
         mod = self._mod
+        k = len(batches)
 
         metric_fns = self._metric_leaves(eval_metric)
         if metric_fns is None:
+            self.flush()
+            return False
+        in_sig = self._batch_sig(batches)
+        if in_sig is None:
             self.flush()
             return False
         # steady-state fast path: when every persistent buffer is still the
@@ -628,14 +758,10 @@ class FusedTrainStep:
             # buffers are compared against what WE last physically wrote
             # (`_seen_*`): in steady state write-backs are deferred (see
             # flush()), so the dicts still hold the last-flushed arrays.
-            in_sig = tuple(
-                (getattr(v, "shape", None), getattr(v, "dtype", None))
-                for v in list(data_batch.data) + list(data_batch.label or []))
             ok = getattr(self, "_carry_sdict", None) is \
                 self._updater.states and \
                 in_sig == getattr(self, "_carry_in_sig", None) and \
-                all(exec0.arg_dict[n]._data is w
-                    for n, w in zip(self._param_names, self._seen_ws)) and \
+                self._owns_exec_buffers() and \
                 all(exec0.aux_dict[n]._data is a
                     for n, a in zip(self._aux_names, self._seen_aux))
             if not ok:
@@ -645,10 +771,11 @@ class FusedTrainStep:
         # exec-dict arrays (in steady state they were donated last step);
         # the build itself runs AFTER placement (it probes the optimizer
         # states _place_all creates)
-        need_build = self._jit is None or \
+        need_build = self._core_closed is None or \
             metric_fns_changed(self._metric_sig(), metric_fns)
         if need_build:
             self._metric_ids = [id(m) for _, m in metric_fns]
+            self._core_closed = None   # metric set is baked into the core
             carry = None
         if carry is None:
             if self._owns_exec_buffers():
@@ -656,32 +783,44 @@ class FusedTrainStep:
             else:
                 # an external writer repointed the exec buffers (its values
                 # win — Module's hooks flush beforehand on every public
-                # path); stale pending results must not clobber them
+                # path); stale pending results must not clobber them.
+                # Pending optimizer/aux write-backs are dropped WITH the
+                # externally-set weights' blessing — warn so bypassing the
+                # public API is diagnosable (Module always flushes first).
+                if not getattr(self, "_flushed", True):
+                    _log.warning(
+                        "fused step: exec buffers were repointed externally "
+                        "with results pending; dropping the pending "
+                        "optimizer-state/aux write-backs (use the public "
+                        "Module APIs, which flush first)")
                 self._flushed = True
             self._place_all()
-        if need_build:
-            self._build(metric_fns)
 
         exec0 = self._exec0
-        data = list(data_batch.data) + list(data_batch.label or [])
-        if len(data) != len(self._input_names):
+        n_inputs_ok = all(
+            len(list(b.data) + list(b.label or [])) == len(self._input_names)
+            for b in batches)
+        if not n_inputs_ok:
             self.flush()   # caller runs unfused on the public buffers
             return False
         ndev = len(self._contexts)
         if ndev > 1 and any(
-                (v.shape[0] if hasattr(v, "shape") and v.shape else 0) % ndev
-                for v in data):
+                (shape[0] if shape else 0) % ndev
+                for shape, _dt in in_sig):
             # e.g. a partial tail batch: not shardable over the mesh —
             # this batch takes the unfused path, the step stays usable
             self.flush()
             return False
         try:
-            pre = getattr(self, "_prestaged", None)
-            if pre is not None and pre[0] is data_batch:
-                inputs = pre[1]   # transfer already in flight (prepare())
-                self._prestaged = None
-            else:
-                inputs = self._stage_inputs(data)
+            xs_inputs = []
+            for b in batches:
+                data = list(b.data) + list(b.label or [])
+                pre = getattr(self, "_prestaged", None)
+                if pre is not None and pre[0] is b:
+                    xs_inputs.append(pre[1])  # transfer already in flight
+                    self._prestaged = None
+                else:
+                    xs_inputs.append(self._stage_inputs(data))
             fixed = [exec0.arg_dict[n]._data for n in self._fixed_names]
             if carry is not None:
                 ws, ss, auxs = carry  # shardings unchanged (constrained)
@@ -725,50 +864,71 @@ class FusedTrainStep:
         # when the caller re-runs it through the unfused path
         counts_before = dict(opt._index_update_count)
         num_update_before = opt.num_update
-        for i in self._indices:
-            opt._update_count(i)
         # hyper scalars live on device and are re-uploaded only when the
         # BASE values move (scheduler step, set_learning_rate, rescale
         # change) — the per-parameter vectors are base * static multipliers,
-        # so the 2x160 per-parameter host calls are off the steady path
-        sched = getattr(opt, "lr_scheduler", None)
-        base_lr = sched(opt.num_update) if sched is not None else opt.lr
-        base = (float(base_lr), float(opt.wd), float(opt.rescale_grad),
-                tuple(sorted(getattr(opt, "lr_mult", {}).items())),
-                tuple(sorted(getattr(opt, "wd_mult", {}).items())),
-                _param_dict_mults(opt, self._indices))
-        if getattr(self, "_hyper_base", None) != base:
-            lrs = [float(opt._get_lr(i)) for i in self._indices]
-            wds = [float(opt._get_wd(i)) for i in self._indices]
-            self._hyper_dev = jax.device_put(
-                [_np.asarray(lrs, _np.float32),
-                 _np.asarray(wds, _np.float32),
-                 _np.float32(opt.rescale_grad)], self._rep_sharding)
-            self._hyper_base = base
-        lr_dev, wd_dev, rescale_dev = self._hyper_dev
+        # so the 2x160 per-parameter host calls are off the steady path.
+        # Block mode evaluates the base ONCE PER STEP (counts advance
+        # between evaluations), so an lr schedule stepping mid-block still
+        # lands on the exact per-step rows.
+        rows = []
+        for _j in range(k):
+            for i in self._indices:
+                opt._update_count(i)
+            sched = getattr(opt, "lr_scheduler", None)
+            base_lr = sched(opt.num_update) if sched is not None else opt.lr
+            base = (float(base_lr), float(opt.wd), float(opt.rescale_grad),
+                    tuple(sorted(getattr(opt, "lr_mult", {}).items())),
+                    tuple(sorted(getattr(opt, "wd_mult", {}).items())),
+                    _param_dict_mults(opt, self._indices))
+            if getattr(self, "_hyper_base", None) != base:
+                lrs = [float(opt._get_lr(i)) for i in self._indices]
+                wds = [float(opt._get_wd(i)) for i in self._indices]
+                self._hyper_dev = jax.device_put(
+                    [_np.asarray(lrs, _np.float32),
+                     _np.asarray(wds, _np.float32),
+                     _np.float32(opt.rescale_grad)], self._rep_sharding)
+                self._hyper_base = base
+            rows.append((self._hyper_dev[0], self._hyper_dev[1]))
+        rescale_dev = self._hyper_dev[2]
         t_vec = getattr(self, "_t_vec", None) if carry is not None else None
         if t_vec is None:
-            # seed the in-graph counter with counts BEFORE this step (the
-            # program itself adds the +1 the host just applied)
+            # seed the in-graph counter with counts BEFORE this block (the
+            # program itself adds +1 per step)
             t_vec = jax.device_put(_np.asarray(
-                [opt._index_update_count[i] - 1 for i in self._indices],
+                [opt._index_update_count[i] - k for i in self._indices],
                 _np.float32), self._rep_sharding)
+
+        inner = (() if self._derive_ws and self._core_closed is not None
+                 else tuple(ws), ss, tuple(auxs), tuple(mcarry),
+                 self._key, t_vec)
+        xs = [(tuple(inp), lr_j, wd_j)
+              for inp, (lr_j, wd_j) in zip(xs_inputs, rows)]
 
         try:
             with _no_rng():
-                if self._derive_ws:
-                    # low-precision weights are derived from the fp32
-                    # masters inside the program: n_params fewer input
-                    # leaves and donation aliases per dispatch
-                    new_ws, new_ss, new_aux, new_mcarry, new_key, new_t, \
-                        outs = self._jit(tuple(ss), auxs, mcarry, self._key,
-                                         t_vec, inputs, fixed, lr_dev,
-                                         wd_dev, rescale_dev)
+                if self._core_closed is None:
+                    core = self._build_core(metric_fns)
+                    # derive mode decided inside _build_core: rebuild inner
+                    if self._derive_ws:
+                        inner = ((),) + inner[1:]
+                    self._trace_core(core, (inner, xs[0], fixed,
+                                            rescale_dev))
+                    self._jit = None
+                    self._jit_block = {}
+                    self._scan_jit = None
+                if k == 1:
+                    if self._jit is None:
+                        self._build1()
+                    new_inner, outs = self._jit(inner, xs[0], fixed,
+                                                rescale_dev)
+                    ys = None
                 else:
-                    new_ws, new_ss, new_aux, new_mcarry, new_key, new_t, \
-                        outs = self._jit(ws, tuple(ss), auxs, mcarry,
-                                         self._key, t_vec, inputs, fixed,
-                                         lr_dev, wd_dev, rescale_dev)
+                    jitk = self._jit_block.get(k)
+                    if jitk is None:
+                        jitk = self._buildk(k)
+                    new_inner, ys, outs = jitk(inner, tuple(xs), fixed,
+                                               rescale_dev)
         except Exception as e:
             opt._index_update_count = counts_before
             opt.num_update = num_update_before
@@ -788,12 +948,21 @@ class FusedTrainStep:
                          str(e)[:300])
             return False
 
+        new_ws, new_ss, new_aux, new_mcarry, new_key, new_t = new_inner
         for (fn, m), pend in zip(metric_fns, new_mcarry):
             m._device_totals = tuple(pend)
         self._key = new_key
         self._t_vec = new_t
         ctx0 = self._contexts[0]
         self.last_outputs = [NDArray(o, ctx=ctx0) for o in outs]
+        # per-batch outputs of the block (stacked scan ys): a batch-j
+        # callback reading get_outputs() must see batch j's outputs, not
+        # the block-final ones — the fit loop moves `block_cursor` as it
+        # fires the callback burst and `current_outputs` slices lazily
+        self._block_outs = ys
+        self._block_len = k
+        self.block_cursor = k - 1
+        self._block_cache = {}
         mod._params_dirty = True
         # arm the steady-state fast path; the ~600 NDArray write-backs are
         # DEFERRED (donation invalidated the old buffers, but nothing reads
@@ -802,9 +971,7 @@ class FusedTrainStep:
         was_cold = carry is None
         self._carry = (list(new_ws), tuple(new_ss), list(new_aux))
         self._carry_sdict = self._updater.states
-        self._carry_in_sig = tuple(
-            (getattr(v, "shape", None), getattr(v, "dtype", None))
-            for v in list(data_batch.data) + list(data_batch.label or []))
+        self._carry_in_sig = in_sig
         self._flushed = False
         if was_cold:
             # first step of a signature: write through immediately so the
@@ -835,7 +1002,7 @@ class FusedTrainStep:
         """Start the (async) device placement of a FUTURE batch while the
         current step's program is still executing — the reference
         PrefetcherIter's H2D pipelining role (`src/io/iter_prefetcher.h`),
-        driven from `Module.prepare` in the fit loop.  `__call__` adopts
+        driven from `Module.prepare` in the fit loop.  `_dispatch` adopts
         the in-flight transfer by batch identity."""
         if self.broken:
             return
@@ -847,6 +1014,29 @@ class FusedTrainStep:
         except Exception:
             self._prestaged = None
 
+    def current_outputs(self):
+        """Outputs of the batch `block_cursor` points at (per-batch view
+        into the scan ys), or the plain last outputs, or None when the
+        last step did not run fused."""
+        ys = getattr(self, "_block_outs", None)
+        if ys is not None:
+            j = min(getattr(self, "block_cursor", self._block_len - 1),
+                    self._block_len - 1)
+            if j == self._block_len - 1:
+                return self.last_outputs
+            got = self._block_cache.get(j)
+            if got is None:
+                ctx0 = self._contexts[0]
+                got = [NDArray(y[j], ctx=ctx0) for y in ys]
+                self._block_cache[j] = got
+            return got
+        return self.last_outputs
+
+    def clear_outputs(self):
+        """Invalidate output views (an unfused forward/step supersedes)."""
+        self.last_outputs = None
+        self._block_outs = None
+
     def _owns_exec_buffers(self):
         """True while the exec dicts still hold the arrays WE last wrote
         (nobody repointed them externally since the last flush)."""
@@ -856,6 +1046,21 @@ class FusedTrainStep:
         exec0 = self._exec0
         return all(exec0.arg_dict[n]._data is w
                    for n, w in zip(self._param_names, seen))
+
+    def _derived_weights(self, new_ss):
+        """Low-precision weights re-derived from the fp32 masters — only
+        flush pays this (a tiny cast program), never the hot loop."""
+        import jax
+        if self._derive_fn is None:
+            mp_pos, dts = self._mp_pos, self._w_dtypes
+
+            def derive(ss):
+                return tuple(
+                    jax.tree_util.tree_leaves(s)[p].astype(dt)
+                    for s, p, dt in zip(ss, mp_pos, dts))
+
+            self._derive_fn = jax.jit(derive)
+        return list(self._derive_fn(tuple(new_ss)))
 
     def flush(self):
         """Write the pending step results (deferred donated-carry arrays)
@@ -867,6 +1072,8 @@ class FusedTrainStep:
             return
         self._flushed = True
         new_ws, new_ss, new_aux = self._carry
+        if self._derive_ws and not new_ws:
+            new_ws = self._derived_weights(new_ss)
         groups = self._mod._exec_group
         for n, nw in zip(self._param_names, new_ws):
             for e in groups.execs:

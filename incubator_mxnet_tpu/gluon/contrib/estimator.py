@@ -228,6 +228,11 @@ class Estimator:
             fused = self._fused = GluonFusedStep.try_build(
                 self.net, self.loss, self.trainer, self.train_metrics)
         handlers = list(event_handlers or [LoggingHandler()])
+        # block mode: K batches per dispatch as ONE lax.scan program
+        # (gluon/fused_step.py call_block) — handlers still fire per batch,
+        # in bursts of K after each block.  Matches Module.fit's blocks.
+        block_k = max(int(_config.get("MXNET_FUSED_STEP_BLOCK")), 1) \
+            if fused is not None else 1
         try:
             for h in handlers:
                 h.train_begin(self)
@@ -237,24 +242,53 @@ class Estimator:
                     m.reset()
                 for h in handlers:
                     h.epoch_begin(self)
-                for self.batch_idx, (data, label) in enumerate(train_data):
-                    data, label = self._place(data, label)
-                    for h in handlers:
-                        h.batch_begin(self)
-                    if fused is not None and not fused.broken and \
-                            fused(data, label, data.shape[0]):
+                self.batch_idx = 0
+                data_iter = iter(train_data)
+                exhausted = False
+                while not exhausted:
+                    block = []
+                    want = block_k if (fused is not None and
+                                       not fused.broken) else 1
+                    while len(block) < want:
+                        try:
+                            block.append(next(data_iter))
+                        except StopIteration:
+                            exhausted = True
+                            break
+                    if not block:
+                        break
+                    block = [self._place(d, l) for d, l in block]
+                    if len(block) == want and want > 1 and \
+                            fused.call_block(block, block[0][0].shape[0]):
+                        for _dl in block:
+                            for h in handlers:
+                                h.batch_begin(self)
+                            for h in handlers:
+                                h.batch_end(self)
+                            self.batch_idx += 1
+                        continue
+                    # per-batch fallback (also how deferred-init params
+                    # materialize: the first eager forward fixes shapes,
+                    # after which the NEXT block fuses)
+                    for data, label in block:
+                        for h in handlers:
+                            h.batch_begin(self)
+                        if fused is not None and not fused.broken and \
+                                fused(data, label, data.shape[0]):
+                            for h in handlers:
+                                h.batch_end(self)
+                            self.batch_idx += 1
+                            continue
+                        with autograd.record():
+                            out = self.net(data)
+                            loss = self.loss(out, label)
+                        loss.backward()
+                        self.trainer.step(data.shape[0])
+                        for m in self.train_metrics:
+                            m.update([label], [out])
                         for h in handlers:
                             h.batch_end(self)
-                        continue
-                    with autograd.record():
-                        out = self.net(data)
-                        loss = self.loss(out, label)
-                    loss.backward()
-                    self.trainer.step(data.shape[0])
-                    for m in self.train_metrics:
-                        m.update([label], [out])
-                    for h in handlers:
-                        h.batch_end(self)
+                        self.batch_idx += 1
                 if val_data is not None:
                     self.evaluate(val_data)
                 self._epochs_done = self.epoch + 1

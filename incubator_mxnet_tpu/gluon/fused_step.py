@@ -19,6 +19,13 @@ PUBLIC optimizer applies via `fused._apply_traced`, BatchNorm aux states
 and the metric accumulate in-graph, and every persistent buffer is a
 donated carry.
 
+Block mode (`call_block`, driven by Estimator.fit +
+MXNET_FUSED_STEP_BLOCK): K batches run as ONE `lax.scan` program per
+dispatch, amortizing host dispatch and write-back Python across K steps —
+the Gluon analogue of `fused.FusedTrainStep`'s scan blocks.  The
+framework trace runs once into a closed jaxpr shared by the 1-step and
+every K-step program.
+
 Eligibility (checked at build, with transparent fallback to the eager
 loop): single-context trainer, no ZeRO/TP sharding, no RNG-consuming ops
 (dropout nets fall back), metrics with `device_update`.
@@ -32,7 +39,8 @@ import numpy as _np
 from ..ndarray.ndarray import NDArray
 from .. import autograd as _autograd
 from ..fused import (_apply_traced, _no_rng, _param_dict_mults, _state_data,
-                     _state_write_back, _raise_if_unrecoverable)
+                     _state_write_back, _raise_if_unrecoverable,
+                     _TracedCore, _one_step_jit, _scan_block_jit)
 
 __all__ = ["GluonFusedStep"]
 
@@ -58,7 +66,8 @@ class _SwapParams:
 
 
 class GluonFusedStep:
-    """One donated program for Estimator's train step."""
+    """One donated program for Estimator's train step (K per dispatch in
+    block mode)."""
 
     @classmethod
     def try_build(cls, net, loss_fn, trainer, metrics):
@@ -101,6 +110,8 @@ class GluonFusedStep:
         self._opt = trainer._optimizer
         self._updater = trainer._updaters[0]
         self._jit = None
+        self._jit_block = {}
+        self._core_closed = None
         self.broken = False
         self._carry = None
         self._t_vec = None
@@ -108,7 +119,9 @@ class GluonFusedStep:
         self.last_outputs = None
 
     # -- build ---------------------------------------------------------------
-    def _build(self):
+    def _build_core(self):
+        """The one-step train function over raw arrays; traced exactly once
+        under `make_jaxpr` (the trace runs the whole net's Python)."""
         import jax
         import jax.numpy as jnp
 
@@ -117,8 +130,9 @@ class GluonFusedStep:
         metrics = self._metrics
         opt, indices, ctx = self._opt, self._indices, self._ctx
 
-        def step(ws, auxs, ss, mcarry, t_vec, data, label,
-                 lr_vec, wd_vec, rescale):
+        def core(inner, x, rescale):
+            ws, auxs, ss, mcarry, t_vec = inner
+            data, label, lr_vec, wd_vec = x
             t_vec = t_vec + jnp.float32(1.0)
 
             def forward(pws):
@@ -145,10 +159,26 @@ class GluonFusedStep:
                 new_mcarry.append((msum + jnp.asarray(dsum, jnp.float32),
                                    mnum + jnp.asarray(dnum, jnp.int32)))
             mean_loss = loss_sum / losses.size
-            return (new_ws, tuple(new_aux), new_ss, tuple(new_mcarry),
-                    t_vec, mean_loss, out)
+            new_inner = (tuple(new_ws), tuple(new_aux), tuple(new_ss),
+                         tuple(new_mcarry), t_vec)
+            return new_inner, (mean_loss, out)
 
-        self._jit = jax.jit(step, donate_argnums=(0, 1, 2, 3, 4))
+        return core
+
+    def _trace_core(self, core, example):
+        """Run the net's framework trace ONCE (fused._TracedCore); every
+        program — 1-step jit, each K-step scan — replays the jaxpr."""
+        self._core_closed = _TracedCore(core, example)
+
+    def _build1(self):
+        self._jit = _one_step_jit(self._core_closed)
+
+    def _buildk(self, k):
+        jitk = self._scan_jit if getattr(self, "_scan_jit", None) is not None \
+            else _scan_block_jit(self._core_closed)
+        self._scan_jit = jitk
+        self._jit_block[k] = jitk
+        return jitk
 
     # -- per step ------------------------------------------------------------
     def _ensure_states(self):
@@ -162,9 +192,17 @@ class GluonFusedStep:
     def __call__(self, data, label, batch_size):
         """Run one fused Gluon step; returns True when handled (params,
         optimizer state, aux and metrics all updated)."""
+        return self._dispatch([(data, label)], batch_size)
+
+    def call_block(self, pairs, batch_size):
+        """Run len(pairs) steps as ONE `lax.scan` dispatch."""
+        return self._dispatch(list(pairs), batch_size)
+
+    def _dispatch(self, pairs, batch_size):
         if self.broken:
             return False
         import jax
+        k = len(pairs)
 
         trainer = self._trainer
         if not trainer._kv_initialized:
@@ -178,6 +216,8 @@ class GluonFusedStep:
             self._opt = trainer._optimizer
             self._updater = trainer._updaters[0]
             self._jit = None
+            self._jit_block = {}
+            self._core_closed = None
             self._carry = None
             self._t_vec = None
         opt = self._opt
@@ -189,18 +229,23 @@ class GluonFusedStep:
             # materializes them; retry fusing from the next batch
             return False
 
-        if self._jit is None:
-            self._build()
-
-        data_nd = data if isinstance(data, NDArray) else None
-        label_nd = label if isinstance(label, NDArray) else None
-        if data_nd is None or label_nd is None:
-            return False
+        # eligibility BEFORE any transfer: a rejected block must not cost
+        # K device_puts (the eager fallback would re-upload the batches)
+        sig0 = None
+        for data, label in pairs:
+            if not isinstance(data, NDArray) or not isinstance(label, NDArray):
+                return False
+            s = (tuple(data.shape), str(data.dtype),
+                 tuple(label.shape), str(label.dtype))
+            if sig0 is None:
+                sig0 = s
+            elif s != sig0:
+                return False   # ragged block cannot share one program
+        in_sig = sig0
         dev = self._ctx.jax_device
-        dval = jax.device_put(data_nd._data, dev)
-        lval = jax.device_put(label_nd._data, dev)
+        staged = [(jax.device_put(d._data, dev), jax.device_put(l._data, dev))
+                  for d, l in pairs]
 
-        in_sig = (dval.shape, str(dval.dtype), lval.shape, str(lval.dtype))
         carry = self._carry if self._carry is not None and \
             getattr(self, "_carry_sdict", None) is self._updater.states and \
             getattr(self, "_carry_sig", None) == in_sig and \
@@ -229,36 +274,61 @@ class GluonFusedStep:
 
         counts_before = dict(opt._index_update_count)
         num_update_before = opt.num_update
-        for i in self._indices:
-            opt._update_count(i)
         # recompute the per-parameter vectors only when the BASE values
         # move (same scheme as fused.FusedTrainStep: multipliers are
-        # static, so the 2xN per-step host calls stay off the hot path)
-        sched = getattr(opt, "lr_scheduler", None)
-        base_lr = sched(opt.num_update) if sched is not None else opt.lr
-        base = (float(base_lr), float(opt.wd), float(opt.rescale_grad),
-                tuple(sorted(getattr(opt, "lr_mult", {}).items())),
-                tuple(sorted(getattr(opt, "wd_mult", {}).items())),
-                _param_dict_mults(opt, self._indices))
-        if getattr(self, "_hyper_base", None) != base:
-            lrs = [float(opt._get_lr(i)) for i in self._indices]
-            wds = [float(opt._get_wd(i)) for i in self._indices]
-            self._hyper_dev = jax.device_put(
-                [_np.asarray(lrs, _np.float32), _np.asarray(wds, _np.float32),
-                 _np.float32(opt.rescale_grad)], dev)
-            self._hyper_base = base
-        lr_dev, wd_dev, rescale_dev = self._hyper_dev
+        # static, so the 2xN per-step host calls stay off the hot path).
+        # Block mode evaluates the base once PER STEP so an lr schedule
+        # stepping mid-block lands exact per-step rows.
+        rows = []
+        for _j in range(k):
+            for i in self._indices:
+                opt._update_count(i)
+            sched = getattr(opt, "lr_scheduler", None)
+            base_lr = sched(opt.num_update) if sched is not None else opt.lr
+            base = (float(base_lr), float(opt.wd), float(opt.rescale_grad),
+                    tuple(sorted(getattr(opt, "lr_mult", {}).items())),
+                    tuple(sorted(getattr(opt, "wd_mult", {}).items())),
+                    _param_dict_mults(opt, self._indices))
+            if getattr(self, "_hyper_base", None) != base:
+                lrs = [float(opt._get_lr(i)) for i in self._indices]
+                wds = [float(opt._get_wd(i)) for i in self._indices]
+                self._hyper_dev = jax.device_put(
+                    [_np.asarray(lrs, _np.float32),
+                     _np.asarray(wds, _np.float32),
+                     _np.float32(opt.rescale_grad)], dev)
+                self._hyper_base = base
+            rows.append((self._hyper_dev[0], self._hyper_dev[1]))
+        rescale_dev = self._hyper_dev[2]
         t_vec = self._t_vec if carry is not None else None
         if t_vec is None:
             t_vec = jax.device_put(_np.asarray(
-                [opt._index_update_count[i] - 1 for i in self._indices],
+                [opt._index_update_count[i] - k for i in self._indices],
                 _np.float32), dev)
+
+        inner = (tuple(ws), tuple(auxs), ss, tuple(mcarry), t_vec)
+        xs = [(dval, lval, lr_j, wd_j)
+              for (dval, lval), (lr_j, wd_j) in zip(staged, rows)]
 
         try:
             with _no_rng():
-                new_ws, new_aux, new_ss, new_mcarry, new_t, mean_loss, out = \
-                    self._jit(list(ws), tuple(auxs), ss, mcarry, t_vec,
-                              dval, lval, lr_dev, wd_dev, rescale_dev)
+                if self._core_closed is None:
+                    core = self._build_core()
+                    self._trace_core(core, (inner, xs[0], rescale_dev))
+                    self._jit = None
+                    self._jit_block = {}
+                    self._scan_jit = None
+                if k == 1:
+                    if self._jit is None:
+                        self._build1()
+                    new_inner, (mean_loss, out) = self._jit(
+                        inner, xs[0], rescale_dev)
+                else:
+                    jitk = self._jit_block.get(k) or self._buildk(k)
+                    # ys (all K steps' losses/outputs) are available from
+                    # the scan; handlers only read the latest, so expose
+                    # the in-program last slice
+                    new_inner, _ys, (mean_loss, out) = jitk(
+                        inner, tuple(xs), rescale_dev)
         except Exception as e:
             opt._index_update_count = counts_before
             opt.num_update = num_update_before
@@ -270,6 +340,7 @@ class GluonFusedStep:
                          "uses the eager loop", str(e)[:300])
             return False
 
+        new_ws, new_aux, new_ss, new_mcarry, new_t = new_inner
         # write back (params/aux/optimizer state are shared with the eager
         # path so the two stay interchangeable)
         for p, nw in zip(self._train_params, new_ws):

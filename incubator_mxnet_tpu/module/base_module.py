@@ -39,6 +39,22 @@ class BaseModule:
         self.update()
         self.update_metric(eval_metric, data_batch.label)
 
+    def _fit_block_k(self):
+        """How many batches `fit` may hand to `fit_block` per dispatch.
+        1 = classic per-batch stepping; Module returns K>1 when the fused
+        K-step scan program is available (MXNET_FUSED_STEP_BLOCK)."""
+        return 1
+
+    def fit_block(self, data_batches, eval_metric):
+        """Run a block of train steps in one dispatch when the subclass
+        can (Module: `lax.scan` over K stacked batches).  Returns True when
+        handled; False -> `fit` falls back to per-batch `fit_step`."""
+        return False
+
+    def _fit_block_cursor(self, j):
+        """Hook: `fit` is about to fire batch j's callbacks for the last
+        processed block (subclasses point per-batch output views at j)."""
+
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
               epoch=0, sparse_row_id_fn=None):
@@ -157,30 +173,50 @@ class BaseModule:
             next_data_batch = next(data_iter)
             while not end_of_batch:
                 data_batch = next_data_batch
+                # block mode: collect K batches and let the subclass run
+                # them as ONE dispatch (Module: lax.scan over K stacked
+                # batches — host bookkeeping amortizes across the block).
+                # Callbacks still fire once per batch, in bursts of K.
+                block = [data_batch]
+                block_k = 1 if monitor is not None else self._fit_block_k()
+                while len(block) < block_k and not end_of_batch:
+                    try:
+                        block.append(next(data_iter))
+                    except StopIteration:
+                        end_of_batch = True
                 if monitor is not None:
                     # monitoring needs per-pass intermediate values: use the
                     # unfused forward/backward so the hooks can observe them
                     monitor.tic()
                     self.forward_backward(data_batch)
                     self.update()
+                elif len(block) == block_k and block_k > 1 and \
+                        self.fit_block(block, eval_metric):
+                    pass   # the whole block ran as one scan program
                 else:
-                    self.fit_step(data_batch, eval_metric)
-                try:
-                    next_data_batch = next(data_iter)
-                    self.prepare(next_data_batch,
-                                 sparse_row_id_fn=sparse_row_id_fn)
-                except StopIteration:
-                    end_of_batch = True
+                    # classic per-batch stepping (also the tail of an epoch
+                    # whose batch count is not a block multiple)
+                    for b in block:
+                        self.fit_step(b, eval_metric)
+                if not end_of_batch:
+                    try:
+                        next_data_batch = next(data_iter)
+                        self.prepare(next_data_batch,
+                                     sparse_row_id_fn=sparse_row_id_fn)
+                    except StopIteration:
+                        end_of_batch = True
                 if monitor is not None:
                     self.update_metric(eval_metric, data_batch.label)
                     monitor.toc_print()
-                if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                                     eval_metric=eval_metric,
-                                                     locals=locals())
-                    for callback in _as_list(batch_end_callback):
-                        callback(batch_end_params)
-                nbatch += 1
+                for _bi, _b in enumerate(block):
+                    self._fit_block_cursor(_bi)
+                    if batch_end_callback is not None:
+                        batch_end_params = BatchEndParam(
+                            epoch=epoch, nbatch=nbatch,
+                            eval_metric=eval_metric, locals=locals())
+                        for callback in _as_list(batch_end_callback):
+                            callback(batch_end_params)
+                    nbatch += 1
 
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)

@@ -367,6 +367,33 @@ class Module(BaseModule):
         self.update()
         self.update_metric(eval_metric, data_batch.label)
 
+    def _fit_block_k(self):
+        """K batches per `fit` dispatch: when the fused step is live, one
+        `lax.scan` program runs K steps per dispatch (the reference's
+        bulk-exec-segment idea, `graph_executor.cc:1194-1316`, taken to
+        its XLA-native conclusion)."""
+        fs = self._fused_step
+        if fs is None or fs.broken:
+            return 1
+        from .. import config as _config
+        return max(int(_config.get("MXNET_FUSED_STEP_BLOCK")), 1)
+
+    def fit_block(self, data_batches, eval_metric):
+        """Run a block of batches as ONE fused scan dispatch.  On False the
+        fit loop runs the block per-batch (fused 1-step or unfused); the
+        pre-dispatch eligibility checks are cheap, so blocks keep being
+        attempted — a later block may fuse (e.g. after deferred state
+        materializes)."""
+        fs = self._fused_step
+        return fs is not None and fs.call_block(data_batches, eval_metric)
+
+    def _fit_block_cursor(self, j):
+        """Point get_outputs() at batch j of the last block while the fit
+        loop fires that batch's callbacks."""
+        fs = self._fused_step
+        if fs is not None:
+            fs.block_cursor = j
+
     # -- forward/backward ------------------------------------------------------
     def prepare(self, data_batch, sparse_row_id_fn=None):
         """Pre-stage the upcoming batch's device transfer while the
@@ -388,7 +415,7 @@ class Module(BaseModule):
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
         if self._fused_step is not None:
-            self._fused_step.last_outputs = None
+            self._fused_step.clear_outputs()
             self._fused_step.flush()
         self._exec_group.forward(data_batch, is_train)
 
@@ -454,10 +481,13 @@ class Module(BaseModule):
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
-        if self._fused_step is not None and \
-                self._fused_step.last_outputs is not None:
-            # last step ran fused: outputs are the global-batch arrays
-            return self._fused_step.last_outputs
+        if self._fused_step is not None:
+            outs = self._fused_step.current_outputs()
+            if outs is not None:
+                # last step ran fused: outputs are the global-batch arrays
+                # (in block mode, the view follows the callback cursor so a
+                # batch-j callback reads batch j's outputs)
+                return outs
         return self._exec_group.get_outputs(merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):

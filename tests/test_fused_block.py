@@ -1,0 +1,209 @@
+"""K-step block mode of the fused train step (fused.py call_block via
+Module.fit): one `lax.scan` dispatch per K batches must train identically
+to per-step dispatch — the TPU-native form of the reference's bulk-exec
+segments (`src/executor/graph_executor.cc:1194-1316`)."""
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+
+
+def _net():
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    h = mx.sym.BatchNorm(h, name="bn1")  # aux-state carry crosses the scan
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+def _batches(n, bs=8, dim=6, seed=3):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        out.append((rng.randn(bs, dim).astype("f4"),
+                    rng.randint(0, 4, bs).astype("f4")))
+    return out
+
+
+class _ListIter(mx.io.DataIter):
+    def __init__(self, batches, bs):
+        super().__init__(batch_size=bs)
+        self._b = batches
+        self._i = 0
+
+    @property
+    def provide_data(self):
+        return [mx.io.DataDesc("data", self._b[0][0].shape, dtype=np.float32)]
+
+    @property
+    def provide_label(self):
+        return [mx.io.DataDesc("softmax_label", self._b[0][1].shape,
+                               dtype=np.float32)]
+
+    def reset(self):
+        self._i = 0
+
+    def next(self):
+        if self._i >= len(self._b):
+            raise StopIteration
+        d, l = self._b[self._i]
+        self._i += 1
+        return mx.io.DataBatch(
+            data=[mx.nd.array(d)], label=[mx.nd.array(l)], pad=0,
+            provide_data=self.provide_data,
+            provide_label=self.provide_label)
+
+
+def _fit(block_k, n_batches, ctx=None, sched=None, epochs=1):
+    mx.random.seed(7)
+    os.environ["MXNET_FUSED_STEP_BLOCK"] = str(block_k)
+    try:
+        batches = _batches(n_batches)
+        it = _ListIter(batches, bs=8)
+        mod = mx.mod.Module(_net(), context=ctx or mx.cpu())
+        opt_params = {"learning_rate": 0.1, "momentum": 0.9}
+        if sched is not None:
+            opt_params["lr_scheduler"] = sched
+        cb_batches = []
+        mod.fit(it, num_epoch=epochs, optimizer="sgd",
+                optimizer_params=opt_params, eval_metric="acc",
+                initializer=mx.initializer.Xavier(),
+                batch_end_callback=lambda p: cb_batches.append(p.nbatch),
+                kvstore=None)
+        assert mod._fused_step is not None and not mod._fused_step.broken
+        args, auxs = mod.get_params()
+        metric_val = None
+        return ({k: v.asnumpy() for k, v in args.items()},
+                {k: v.asnumpy() for k, v in auxs.items()},
+                cb_batches, mod)
+    finally:
+        os.environ.pop("MXNET_FUSED_STEP_BLOCK", None)
+
+
+def test_block_matches_per_step():
+    """K=4 scan blocks over 9 batches (2 blocks + tail) == per-step."""
+    a1, x1, cb1, _ = _fit(1, 9)
+    a4, x4, cb4, mod = _fit(4, 9)
+    assert cb1 == list(range(9)) and cb4 == list(range(9))
+    for k in a1:
+        np.testing.assert_allclose(a4[k], a1[k], rtol=2e-5, atol=2e-6,
+                                   err_msg=k)
+    for k in x1:
+        np.testing.assert_allclose(x4[k], x1[k], rtol=2e-5, atol=2e-6,
+                                   err_msg=k)
+    # the block program actually ran (K=4 program exists, carry armed)
+    assert 4 in mod._fused_step._jit_block
+    assert mod._fused_step._carry is not None
+
+
+def test_block_with_lr_schedule_mid_block():
+    """An lr schedule stepping INSIDE a block must land per-step rows."""
+    def mk():
+        return mx.lr_scheduler.FactorScheduler(step=3, factor=0.5)
+    a1, x1, _, _ = _fit(1, 8, sched=mk())
+    a4, x4, _, _ = _fit(4, 8, sched=mk())
+    for k in a1:
+        np.testing.assert_allclose(a4[k], a1[k], rtol=2e-5, atol=2e-6,
+                                   err_msg=k)
+
+
+def test_block_multi_device():
+    """Block mode over the 8-device dp mesh: scan + collective gradients."""
+    ctx = [mx.cpu(i) for i in range(4)]
+    a1, x1, _, _ = _fit(1, 4, ctx=ctx)
+    a4, x4, _, mod = _fit(4, 4, ctx=ctx)
+    for k in a1:
+        np.testing.assert_allclose(a4[k], a1[k], rtol=2e-5, atol=2e-6,
+                                   err_msg=k)
+    assert 4 in mod._fused_step._jit_block
+
+
+def test_block_multi_epoch_and_outputs():
+    """Carry survives epoch boundaries (get_params flush between epochs);
+    last_outputs stays readable after later dispatches."""
+    a4, x4, cb, mod = _fit(4, 8, epochs=2)
+    assert len(cb) == 16
+    outs = mod.get_outputs()
+    np.testing.assert_equal(np.isfinite(outs[0].asnumpy()).all(), True)
+    for v in a4.values():
+        assert np.isfinite(v).all()
+
+
+def test_gluon_estimator_block_matches_per_step():
+    """Estimator.fit block mode (gluon fused scan) == per-step fit."""
+    from incubator_mxnet_tpu import gluon
+
+    def run(block_k):
+        os.environ["MXNET_FUSED_STEP_BLOCK"] = str(block_k)
+        try:
+            mx.random.seed(11)
+            net = gluon.nn.HybridSequential()
+            net.add(gluon.nn.Dense(16, activation="relu"),
+                    gluon.nn.BatchNorm(), gluon.nn.Dense(4))
+            net.initialize(mx.initializer.Xavier())
+            trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                    {"learning_rate": 0.1, "momentum": 0.9})
+            est = gluon.contrib.estimator.Estimator(
+                net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                train_metrics=[mx.metric.Accuracy()], trainer=trainer)
+            batches = [(mx.nd.array(d), mx.nd.array(l))
+                       for d, l in _batches(9, bs=8, dim=6, seed=5)]
+            ends = []
+
+            class Rec(gluon.contrib.estimator.EventHandler):
+                def batch_end(self, e):
+                    ends.append(e.batch_idx)
+
+            est.fit(iter(batches), epochs=1, event_handlers=[Rec()])
+            assert est._fused is not None and not est._fused.broken
+            # gluon name scopes increment per instantiation: compare by
+            # position, not by (run-dependent) parameter name
+            params = [v.data().asnumpy()
+                      for v in net.collect_params().values()]
+            return params, ends, est
+        finally:
+            os.environ.pop("MXNET_FUSED_STEP_BLOCK", None)
+
+    p1, e1, _ = run(1)
+    p4, e4, est = run(4)
+    assert e1 == list(range(9)) and e4 == list(range(9))
+    for i, (a, b) in enumerate(zip(p4, p1)):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6,
+                                   err_msg=f"param {i}")
+    assert 4 in est._fused._jit_block
+
+
+def test_block_get_outputs_per_batch():
+    """A batch-j callback reading get_outputs() must see batch j's outputs
+    (the scan ys expose every step, cursor-driven), not the block-final
+    ones."""
+    os.environ["MXNET_FUSED_STEP_BLOCK"] = "4"
+    try:
+        mx.random.seed(7)
+        batches = _batches(8)
+        it = _ListIter(batches, bs=8)
+        mod = mx.mod.Module(_net(), context=mx.cpu())
+        seen = []
+
+        def cb(p):
+            seen.append((p.nbatch, mod.get_outputs()[0].asnumpy().copy()))
+
+        mod.fit(it, num_epoch=1, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.0},  # frozen weights
+                eval_metric="acc", initializer=mx.initializer.Xavier(),
+                batch_end_callback=cb, kvstore=None)
+        assert len(seen) == 8
+        # lr=0 freezes weights except BN stats; batches differ, so outputs
+        # must differ across the block — and must match a direct forward
+        # of the same batch (weights frozen -> reproducible)
+        outs = {n: o for n, o in seen}
+        assert not np.allclose(outs[0], outs[3]), \
+            "per-batch outputs must differ within a block"
+        for j in (1, 2):
+            assert not np.allclose(outs[j], outs[3]), \
+                f"batch {j} callback saw block-final outputs"
+    finally:
+        os.environ.pop("MXNET_FUSED_STEP_BLOCK", None)
