@@ -271,6 +271,65 @@ class _TracedCore:
         return jax.tree_util.tree_unflatten(self._out_tree, out)
 
 
+def advance_hyper_rows(opt, indices, k, owner, placement):
+    """Advance the optimizer's update counts k steps and collect the k
+    per-step (lr_vec, wd_vec) device rows plus the rescale scalar.
+
+    The per-parameter vectors are base * static multipliers, so they are
+    re-uploaded only when the BASE values move (scheduler step,
+    set_learning_rate, rescale change) — cached on `owner._hyper_base` /
+    `owner._hyper_dev`.  The base is evaluated once PER STEP (counts
+    advance between evaluations), so an lr schedule stepping mid-block
+    still lands exact per-step rows.  Shared by the Module and Gluon
+    fused steps."""
+    import jax
+    rows = []
+    for _ in range(k):
+        for i in indices:
+            opt._update_count(i)
+        sched = getattr(opt, "lr_scheduler", None)
+        base_lr = sched(opt.num_update) if sched is not None else opt.lr
+        base = (float(base_lr), float(opt.wd), float(opt.rescale_grad),
+                tuple(sorted(getattr(opt, "lr_mult", {}).items())),
+                tuple(sorted(getattr(opt, "wd_mult", {}).items())),
+                _param_dict_mults(opt, indices))
+        if getattr(owner, "_hyper_base", None) != base:
+            lrs = [float(opt._get_lr(i)) for i in indices]
+            wds = [float(opt._get_wd(i)) for i in indices]
+            owner._hyper_dev = jax.device_put(
+                [_np.asarray(lrs, _np.float32),
+                 _np.asarray(wds, _np.float32),
+                 _np.float32(opt.rescale_grad)], placement)
+            owner._hyper_base = base
+        rows.append((owner._hyper_dev[0], owner._hyper_dev[1]))
+    return rows, owner._hyper_dev[2]
+
+
+def create_states_on_device(opt, indices, weights_raw, ctx):
+    """Create optimizer state for every (index, raw device array) pair in
+    ONE compiled program — the public optimizer's create_state traced over
+    NDArray shells, so fp32 masters are in-program casts and momenta are
+    in-program zeros.  Returns a list of NDArray-state pytrees, or None
+    when the optimizer's create_state cannot trace (caller falls back to
+    its eager/host path).  On a remote device the per-parameter eager path
+    costs a round trip per op; this costs one dispatch total."""
+    import jax
+    try:
+        def create(ws_in):
+            return tuple(
+                _state_data(opt.create_state_multi_precision(
+                    i, NDArray(w, ctx=ctx)))
+                for i, w in zip(indices, ws_in))
+
+        with _no_rng():
+            vals = jax.jit(create)(list(weights_raw))
+    except Exception as e:
+        _log.warning("on-device optimizer-state creation unavailable (%s); "
+                     "using the eager path", str(e)[:200])
+        return None
+    return [_state_wrap(v, ctx) for v in vals]
+
+
 def _one_step_jit(traced):
     """1-step program over a traced core; the inner carry is donated."""
     import jax
@@ -504,28 +563,12 @@ class FusedTrainStep:
 
     def _place_all(self):
         import jax
-        from . import engine as _engine
         exec0 = self._exec0
         upd = self._updater
         need = [(i, n) for i, n in zip(self._indices, self._param_names)
                 if i not in upd.states]
         if need:
-            # optimizer-state creation without per-parameter dispatches:
-            # fetch every needed weight in ONE batched host read, run the
-            # optimizer's create_state on host-staged shells under a bulk
-            # scope (zeros/astype/copy stay host-side), and let the
-            # placement pass below upload everything in one transfer
-            host_ws = jax.device_get(
-                [exec0.arg_dict[n]._data for _, n in need])
-            with _engine.bulk(1 << 16):
-                for (i, n), hw in zip(need, host_ws):
-                    tgt = exec0.arg_dict[n]
-                    shell = NDArray(_np.asarray(hw), ctx=tgt.context)
-                    _engine.stage(shell)
-                    upd.states[i] = self._opt.create_state_multi_precision(
-                        i, shell)
-                    upd.states_synced[i] = True
-                    _engine.unstage(shell)  # scratch; never uploaded
+            self._create_states(need)
         todo = []
         for n in self._param_names + self._fixed_names:
             self._collect_misplaced(exec0.arg_dict[n], todo)
@@ -539,6 +582,46 @@ class FusedTrainStep:
                                    self._rep_sharding)
             for a, v in zip(todo, moved):
                 a._set_data(v)
+
+    def _create_states(self, need):
+        """All missing optimizer states in ONE compiled program from the
+        device-resident weights (masters are casts, the rest zeros): no
+        per-parameter dispatches, no weight download, no state upload —
+        on a remote device the old fetch-create-upload path cost seconds
+        of round trips.  Falls back to the host-staged path when the
+        optimizer's create_state cannot trace."""
+        exec0 = self._exec0
+        upd = self._updater
+        ctx = self._contexts[0]
+        indices = [i for i, _ in need]
+        ws = [exec0.arg_dict[n]._data for _, n in need]
+        states = create_states_on_device(self._opt, indices, ws, ctx)
+        if states is None:
+            self._create_states_host(need)
+            return
+        for (i, _), s in zip(need, states):
+            upd.states[i] = s
+            upd.states_synced[i] = True
+
+    def _create_states_host(self, need):
+        """Host-staged fallback: ONE batched weight read, create_state on
+        staged shells under a bulk scope, one batched upload (done by the
+        placement pass that follows)."""
+        import jax
+        from . import engine as _engine
+        exec0 = self._exec0
+        upd = self._updater
+        host_ws = jax.device_get(
+            [exec0.arg_dict[n]._data for _, n in need])
+        with _engine.bulk(1 << 16):
+            for (i, n), hw in zip(need, host_ws):
+                tgt = exec0.arg_dict[n]
+                shell = NDArray(_np.asarray(hw), ctx=tgt.context)
+                _engine.stage(shell)
+                upd.states[i] = self._opt.create_state_multi_precision(
+                    i, shell)
+                upd.states_synced[i] = True
+                _engine.unstage(shell)  # scratch; never uploaded
 
     # -- derived low-precision weights ---------------------------------------
     def _master_positions(self):
@@ -554,6 +637,7 @@ class FusedTrainStep:
         exec0 = self._exec0
         upd = self._updater
         pos = []
+        probed = {}   # state-structure key -> master leaf index (or None)
         for i, n in zip(self._indices, self._param_names):
             w = exec0.arg_dict[n]
             if _np.dtype(w.dtype) == _np.float32:
@@ -568,21 +652,34 @@ class FusedTrainStep:
                 continue
             if not cands:
                 return None
-            # ambiguous (e.g. adam: mean/var/master all fp32 of the same
-            # shape): probe the optimizer's state structure with a tiny
-            # nonzero weight and find the leaf equal to its fp32 copy
-            from .ndarray.ndarray import array as _arr
-            tw = _arr(_np.linspace(0.1, 0.9, 4, dtype=_np.float32),
-                      ctx=w.context, dtype=w.dtype)
-            ps = self._opt.create_state_multi_precision(i, tw)
-            pl = jax.tree_util.tree_leaves(_state_data(ps))
-            target = _np.asarray(tw._data, _np.float32)
-            hit = [j for j in cands
-                   if j < len(pl) and
-                   _np.array_equal(_np.asarray(pl[j], _np.float32), target)]
-            if len(hit) != 1:
+            # ambiguous (e.g. adam/sgd-momentum: momentum and master are
+            # both fp32 of the weight's shape): probe the optimizer's
+            # state STRUCTURE with a tiny nonzero weight and find the leaf
+            # equal to its fp32 copy.  The structure is a property of the
+            # optimizer, not of the individual parameter, so one probe per
+            # distinct (dtype, leaf-structure) serves all 100+ params —
+            # and it runs on the HOST backend (w.context may sit behind a
+            # network tunnel where per-param probing costs a round trip
+            # each).
+            key = (str(_np.dtype(w.dtype)), tuple(cands),
+                   tuple(str(getattr(lf, "dtype", "")) for lf in leaves))
+            if key not in probed:
+                from .ndarray.ndarray import array as _arr
+                from .context import cpu as _cpu
+                tw = _arr(_np.linspace(0.1, 0.9, 4, dtype=_np.float32),
+                          ctx=_cpu(), dtype=w.dtype)
+                ps = self._opt.create_state_multi_precision(i, tw)
+                pl = jax.tree_util.tree_leaves(_state_data(ps))
+                host = jax.device_get([tw._data] + [
+                    pl[j] for j in cands if j < len(pl)])
+                target = _np.asarray(host[0], _np.float32)
+                hit = [j for j, hv in zip(
+                    [c for c in cands if c < len(pl)], host[1:])
+                    if _np.array_equal(_np.asarray(hv, _np.float32), target)]
+                probed[key] = hit[0] if len(hit) == 1 else None
+            if probed[key] is None:
                 return None
-            pos.append(hit[0])
+            pos.append(probed[key])
         return pos
 
     # -- the traced step core ------------------------------------------------
@@ -639,6 +736,11 @@ class FusedTrainStep:
                 return tuple(outs), tuple(new_aux)
 
             outs, vjp, new_aux = jax.vjp(forward, list(ws), has_aux=True)
+            # scan carries must keep invariant dtypes (see gluon core): pin
+            # aux updates to the stored aux dtype
+            new_aux = tuple(
+                na.astype(a.dtype) if na.dtype != a.dtype else na
+                for na, a in zip(new_aux, auxs))
             cts = tuple(
                 jnp.ones(o.shape, o.dtype)
                 if jnp.issubdtype(o.dtype, jnp.floating)
@@ -864,33 +966,8 @@ class FusedTrainStep:
         # when the caller re-runs it through the unfused path
         counts_before = dict(opt._index_update_count)
         num_update_before = opt.num_update
-        # hyper scalars live on device and are re-uploaded only when the
-        # BASE values move (scheduler step, set_learning_rate, rescale
-        # change) — the per-parameter vectors are base * static multipliers,
-        # so the 2x160 per-parameter host calls are off the steady path.
-        # Block mode evaluates the base ONCE PER STEP (counts advance
-        # between evaluations), so an lr schedule stepping mid-block still
-        # lands on the exact per-step rows.
-        rows = []
-        for _j in range(k):
-            for i in self._indices:
-                opt._update_count(i)
-            sched = getattr(opt, "lr_scheduler", None)
-            base_lr = sched(opt.num_update) if sched is not None else opt.lr
-            base = (float(base_lr), float(opt.wd), float(opt.rescale_grad),
-                    tuple(sorted(getattr(opt, "lr_mult", {}).items())),
-                    tuple(sorted(getattr(opt, "wd_mult", {}).items())),
-                    _param_dict_mults(opt, self._indices))
-            if getattr(self, "_hyper_base", None) != base:
-                lrs = [float(opt._get_lr(i)) for i in self._indices]
-                wds = [float(opt._get_wd(i)) for i in self._indices]
-                self._hyper_dev = jax.device_put(
-                    [_np.asarray(lrs, _np.float32),
-                     _np.asarray(wds, _np.float32),
-                     _np.float32(opt.rescale_grad)], self._rep_sharding)
-                self._hyper_base = base
-            rows.append((self._hyper_dev[0], self._hyper_dev[1]))
-        rescale_dev = self._hyper_dev[2]
+        rows, rescale_dev = advance_hyper_rows(opt, self._indices, k, self,
+                                               self._rep_sharding)
         t_vec = getattr(self, "_t_vec", None) if carry is not None else None
         if t_vec is None:
             # seed the in-graph counter with counts BEFORE this block (the

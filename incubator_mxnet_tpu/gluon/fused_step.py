@@ -38,7 +38,7 @@ import numpy as _np
 
 from ..ndarray.ndarray import NDArray
 from .. import autograd as _autograd
-from ..fused import (_apply_traced, _no_rng, _param_dict_mults, _state_data,
+from ..fused import (_apply_traced, _no_rng, _state_data,
                      _state_write_back, _raise_if_unrecoverable,
                      _TracedCore, _one_step_jit, _scan_block_jit)
 
@@ -150,6 +150,13 @@ class GluonFusedStep:
 
             loss_sum, vjp, (out, losses, new_aux) = \
                 jax.vjp(forward, list(ws), has_aux=True)
+            # scan carries must keep invariant dtypes: a bf16-cast net's
+            # BN aux update may compute fp32 running stats — land them
+            # back in the stored aux dtype (the 1-step jit tolerated the
+            # widening; lax.scan correctly refuses)
+            new_aux = tuple(
+                na.astype(a.dtype) if na.dtype != a.dtype else na
+                for na, a in zip(new_aux, auxs))
             (grads,) = vjp(jnp.ones((), loss_sum.dtype))
             new_ws, new_ss = _apply_traced(opt, indices, ws, grads, ss, ctx,
                                            lr_vec, wd_vec, t_vec, rescale)
@@ -183,11 +190,26 @@ class GluonFusedStep:
     # -- per step ------------------------------------------------------------
     def _ensure_states(self):
         upd = self._updater
-        for i, p in zip(self._indices, self._train_params):
-            if i not in upd.states:
-                upd.states[i] = \
-                    self._opt.create_state_multi_precision(i, p.data())
+        need = [(i, p) for i, p in zip(self._indices, self._train_params)
+                if i not in upd.states]
+        if not need:
+            return
+        # ONE compiled program creates every state (fused.py helper); the
+        # per-parameter eager path costs a round trip per op on a remote
+        # device and dominated Estimator's time-to-first-batch
+        from ..fused import create_states_on_device
+        states = create_states_on_device(
+            self._opt, [i for i, _ in need],
+            [p.data()._data for _, p in need], self._ctx)
+        if states is not None:
+            for (i, _), s in zip(need, states):
+                upd.states[i] = s
                 upd.states_synced[i] = True
+            return
+        for i, p in need:
+            upd.states[i] = \
+                self._opt.create_state_multi_precision(i, p.data())
+            upd.states_synced[i] = True
 
     def __call__(self, data, label, batch_size):
         """Run one fused Gluon step; returns True when handled (params,
@@ -274,31 +296,9 @@ class GluonFusedStep:
 
         counts_before = dict(opt._index_update_count)
         num_update_before = opt.num_update
-        # recompute the per-parameter vectors only when the BASE values
-        # move (same scheme as fused.FusedTrainStep: multipliers are
-        # static, so the 2xN per-step host calls stay off the hot path).
-        # Block mode evaluates the base once PER STEP so an lr schedule
-        # stepping mid-block lands exact per-step rows.
-        rows = []
-        for _j in range(k):
-            for i in self._indices:
-                opt._update_count(i)
-            sched = getattr(opt, "lr_scheduler", None)
-            base_lr = sched(opt.num_update) if sched is not None else opt.lr
-            base = (float(base_lr), float(opt.wd), float(opt.rescale_grad),
-                    tuple(sorted(getattr(opt, "lr_mult", {}).items())),
-                    tuple(sorted(getattr(opt, "wd_mult", {}).items())),
-                    _param_dict_mults(opt, self._indices))
-            if getattr(self, "_hyper_base", None) != base:
-                lrs = [float(opt._get_lr(i)) for i in self._indices]
-                wds = [float(opt._get_wd(i)) for i in self._indices]
-                self._hyper_dev = jax.device_put(
-                    [_np.asarray(lrs, _np.float32),
-                     _np.asarray(wds, _np.float32),
-                     _np.float32(opt.rescale_grad)], dev)
-                self._hyper_base = base
-            rows.append((self._hyper_dev[0], self._hyper_dev[1]))
-        rescale_dev = self._hyper_dev[2]
+        from ..fused import advance_hyper_rows
+        rows, rescale_dev = advance_hyper_rows(opt, self._indices, k, self,
+                                               dev)
         t_vec = self._t_vec if carry is not None else None
         if t_vec is None:
             t_vec = jax.device_put(_np.asarray(

@@ -184,20 +184,32 @@ class BaseModule:
                         block.append(next(data_iter))
                     except StopIteration:
                         end_of_batch = True
+                burst = ()
                 if monitor is not None:
                     # monitoring needs per-pass intermediate values: use the
                     # unfused forward/backward so the hooks can observe them
                     monitor.tic()
                     self.forward_backward(data_batch)
                     self.update()
+                    burst = block   # single batch; callback fires below
                 elif len(block) == block_k and block_k > 1 and \
                         self.fit_block(block, eval_metric):
-                    pass   # the whole block ran as one scan program
+                    burst = block   # one scan dispatch; callbacks burst
                 else:
-                    # classic per-batch stepping (also the tail of an epoch
-                    # whose batch count is not a block multiple)
+                    # classic per-batch stepping with classic callback
+                    # timing (the tail of an epoch, or a block the fused
+                    # path rejected — e.g. a host-side metric, where a
+                    # deferred burst would hand batch-j callbacks block-
+                    # final metric/output state for no fusion benefit)
                     for b in block:
                         self.fit_step(b, eval_metric)
+                        if batch_end_callback is not None:
+                            batch_end_params = BatchEndParam(
+                                epoch=epoch, nbatch=nbatch,
+                                eval_metric=eval_metric, locals=locals())
+                            for callback in _as_list(batch_end_callback):
+                                callback(batch_end_params)
+                        nbatch += 1
                 if not end_of_batch:
                     try:
                         next_data_batch = next(data_iter)
@@ -208,7 +220,7 @@ class BaseModule:
                 if monitor is not None:
                     self.update_metric(eval_metric, data_batch.label)
                     monitor.toc_print()
-                for _bi, _b in enumerate(block):
+                for _bi, _b in enumerate(burst):
                     self._fit_block_cursor(_bi)
                     if batch_end_callback is not None:
                         batch_end_params = BatchEndParam(

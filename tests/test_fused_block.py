@@ -207,3 +207,39 @@ def test_block_get_outputs_per_batch():
                 f"batch {j} callback saw block-final outputs"
     finally:
         os.environ.pop("MXNET_FUSED_STEP_BLOCK", None)
+
+
+def test_fallback_block_keeps_per_batch_callbacks():
+    """A block the fused path rejects (host-side metric) must run with
+    CLASSIC per-batch callback timing: the batch-j callback sees the
+    metric updated through batch j only."""
+
+    class HostOnlyAcc(mx.metric.EvalMetric):
+        def __init__(self):
+            super().__init__("hostacc")
+
+        def update(self, labels, preds):
+            self.sum_metric += float(
+                (preds[0].asnumpy().argmax(1) ==
+                 labels[0].asnumpy()).sum())
+            self.num_inst += labels[0].shape[0]
+
+    os.environ["MXNET_FUSED_STEP_BLOCK"] = "4"
+    try:
+        mx.random.seed(7)
+        it = _ListIter(_batches(8), bs=8)
+        mod = mx.mod.Module(_net(), context=mx.cpu())
+        seen = []
+
+        def cb(p):
+            seen.append((p.nbatch, p.eval_metric.num_inst))
+
+        mod.fit(it, num_epoch=1, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1},
+                eval_metric=HostOnlyAcc(),
+                initializer=mx.initializer.Xavier(),
+                batch_end_callback=cb, kvstore=None)
+        # metric must have been updated batch-by-batch at each callback
+        assert seen == [(j, (j + 1) * 8) for j in range(8)], seen
+    finally:
+        os.environ.pop("MXNET_FUSED_STEP_BLOCK", None)
