@@ -162,13 +162,17 @@ class _Probe:
             self.final_acc = acc
 
 
-def _build_module(mx, batch, image, dtype):
+def _build_module(mx, batch, image, dtype, norm=None):
     from incubator_mxnet_tpu import sym
     from incubator_mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
 
     net = resnet50_v1(classes=1000)
     data = sym.Variable("data")
-    out = net(data)  # gluon block composed symbolically
+    # device-augment pipelines ship uint8 NHWC; `norm` is the in-graph
+    # normalize/cast/NCHW head (iterator.normalize_symbol) XLA fuses into
+    # the first convolution
+    x = norm(data) if norm is not None else data
+    out = net(x)  # gluon block composed symbolically
     out = sym.SoftmaxOutput(out, name="softmax")
     ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
     return mx.mod.Module(out, context=ctx,
@@ -450,7 +454,8 @@ def _real_data_iter(rec, batch, image):
         rand_crop=True, rand_mirror=True, shuffle=True,
         mean_r=123.68, mean_g=116.78, mean_b=103.94,
         std_r=58.4, std_g=57.1, std_b=57.4,
-        preprocess_threads=4, prefetch_buffer=_REAL_PREFETCH, label_width=1)
+        preprocess_threads=4, prefetch_buffer=_REAL_PREFETCH, label_width=1,
+        device_augment=True)
 
 
 def _run_real_data_in(d, batch, image, steps, dtype):
@@ -483,7 +488,9 @@ def _run_real_data_in(d, batch, image, steps, dtype):
     pipe_img_s = n / (time.perf_counter() - t0)
 
     mx.random.seed(0)
-    mod, ctx = _build_module(mx, batch, image, dtype)
+    mod, ctx = _build_module(
+        mx, batch, image, dtype,
+        norm=lambda d: it.normalize_symbol(d, dtype=dtype))
     probe = _Probe(warm, steps, batch)
     it.reset()
     mod.fit(it, num_epoch=1,
@@ -590,8 +597,8 @@ def main():
             jax.block_until_ready(jax.device_put(buf))
             h2d = buf.nbytes / (time.perf_counter() - t0) / 1e6
             _RESULT["h2d_MBps"] = round(h2d, 1)
-            # headline dtype: a bf16 model halves the per-batch transfer
-            # (the fused step casts host-side before the device_put)
+            # device-augment pipeline: batches cross as uint8 NHWC (the
+            # normalize/cast finish is in-graph), a quarter of fp32 bytes
             real, pipe = _run_real_data(batch, image, steps, dtype)
             _RESULT["real_data_img_s"] = round(real, 2)
             _RESULT["io_pipeline_img_s"] = round(pipe, 2)
@@ -602,8 +609,8 @@ def main():
                 # can't train faster than the pipeline decodes unless the
                 # window was fed from the prefetch buffer — flag it
                 _RESULT["real_data_buffer_fed"] = True
-            itemsize = 2 if dtype == "bfloat16" else 4
-            xfer_img_s = h2d * 1e6 / (3 * image * image * itemsize)
+            # device-augment lane ships uint8 (1 byte/element)
+            xfer_img_s = h2d * 1e6 / (3 * image * image)
             if real < 0.8 * pipe and real < 1.5 * xfer_img_s:
                 _RESULT["real_data_transfer_bound"] = True
         except Exception as e:

@@ -375,7 +375,7 @@ class ImageRecordIterImpl(DataIter):
                  preprocess_threads=None, prefetch_buffer=4,
                  round_batch=True, data_name="data",
                  label_name="softmax_label", seed=0, fast_decode=True,
-                 **kwargs):
+                 device_augment=False, **kwargs):
         super().__init__(batch_size)
         if preprocess_threads is None:
             from . import config as _config
@@ -410,6 +410,12 @@ class ImageRecordIterImpl(DataIter):
         self._fast_decode = bool(fast_decode)
         self._fd_tries = 0
         self._fd_wins = 0
+        # device_augment: the host stops at crop+mirror and ships uint8
+        # NHWC (4x fewer bytes than the fp32 finish, and no float/layout
+        # passes on a busy CPU); normalize/cast/NCHW become graph ops —
+        # compose the model with `self.normalize_symbol(data)` (the
+        # ImageNormalize op), which XLA fuses into the first conv.
+        self._device_augment = bool(device_augment)
 
         import mmap
         self._file = open(path_imgrec, "rb")
@@ -429,6 +435,10 @@ class ImageRecordIterImpl(DataIter):
 
     @property
     def provide_data(self):
+        if self._device_augment:
+            c, h, w = self.data_shape
+            return [DataDesc(self._data_name, (self.batch_size, h, w, c),
+                             dtype=np.uint8)]
         return [DataDesc(self._data_name,
                          (self.batch_size,) + self.data_shape)]
 
@@ -437,6 +447,17 @@ class ImageRecordIterImpl(DataIter):
         shape = (self.batch_size,) if self.label_width == 1 else \
             (self.batch_size, self.label_width)
         return [DataDesc(self._label_name, shape)]
+
+    def normalize_symbol(self, data, dtype="float32"):
+        """The graph-side half of device_augment mode: wrap the model's
+        input variable so normalize/cast/NCHW run IN the compiled program
+        with this iterator's mean/std."""
+        from . import symbol as _sym
+        mean = tuple(float(v) for v in self._mean)
+        std = tuple(float(1.0 / v) for v in self._stdinv)
+        return _sym.ImageNormalize(
+            data, mean=mean, std=std, input_layout="NHWC",
+            output_layout="NCHW", dtype=dtype)
 
     def reset(self):
         if self._pool is not None:
@@ -545,22 +566,48 @@ class ImageRecordIterImpl(DataIter):
         # fresh buffer each batch: handed to jax ZERO-COPY below (cpu) or
         # consumed by an async transfer (accelerator) — never recycled, so
         # no defensive copy is needed anywhere on the path
-        data = np.empty((bs, c, h, w), dtype="float32")
-        if nat is not None:
-            # decoded frames are BGR; the kernel reverses channels on the
-            # fly into RGB planes (no cvtColor pass)
+        u8 = self._device_augment
+        native_ok = nat is not None and \
+            (not u8 or hasattr(nat, "mxtpu_crop_batch_u8"))
+        if native_ok:
+            # shared ctypes marshalling for both native finishes
             dims = np.ascontiguousarray(dims)
             ptrs = (ctypes.c_void_p * bs)(
                 *(img.ctypes.data for img in imgs))
             i64p = ctypes.POINTER(ctypes.c_int64)
+            mirrors_p = np.ascontiguousarray(mirrors).ctypes.data_as(
+                ctypes.POINTER(ctypes.c_int))
+        if u8:
+            # host stops at crop+mirror: uint8 NHWC out (the normalize/
+            # cast/layout finish runs in the training program, see
+            # normalize_symbol) — no float pass, quarter the bytes
+            data = np.empty((bs, h, w, c), dtype=np.uint8)
+            if native_ok:
+                nat.mxtpu_crop_batch_u8(
+                    ptrs, dims[0].ctypes.data_as(i64p),
+                    dims[1].ctypes.data_as(i64p), c,
+                    dims[2].ctypes.data_as(i64p),
+                    dims[3].ctypes.data_as(i64p), h, w, mirrors_p,
+                    data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                    bs, 1)
+            else:
+                for i, img in enumerate(imgs):
+                    ih, iw, y0, x0 = dims[:, i]
+                    crop = img[y0:y0 + h, x0:x0 + w, ::-1]  # BGR -> RGB
+                    if mirrors[i]:
+                        crop = crop[:, ::-1]
+                    data[i] = crop
+            return self._emit(data, label, pad)
+        data = np.empty((bs, c, h, w), dtype="float32")
+        if native_ok:
+            # decoded frames are BGR; the kernel reverses channels on the
+            # fly into RGB planes (no cvtColor pass)
             f32p = ctypes.POINTER(ctypes.c_float)
             nat.mxtpu_augment_batch(
                 ptrs, dims[0].ctypes.data_as(i64p),
                 dims[1].ctypes.data_as(i64p), c,
                 dims[2].ctypes.data_as(i64p),
-                dims[3].ctypes.data_as(i64p), h, w,
-                np.ascontiguousarray(mirrors).ctypes.data_as(
-                    ctypes.POINTER(ctypes.c_int)),
+                dims[3].ctypes.data_as(i64p), h, w, mirrors_p,
                 self._mean.ctypes.data_as(f32p),
                 self._stdinv.ctypes.data_as(f32p),
                 data.ctypes.data_as(f32p), bs, 1)
@@ -572,6 +619,9 @@ class ImageRecordIterImpl(DataIter):
                     crop = crop[:, ::-1]
                 data[i] = ((crop.astype("float32") - self._mean)
                            * self._stdinv).transpose(2, 0, 1)
+        return self._emit(data, label, pad)
+
+    def _emit(self, data, label, pad):
         label_out = label[:, 0] if self.label_width == 1 else label
 
         from .context import current_context

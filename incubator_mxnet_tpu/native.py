@@ -40,6 +40,16 @@ def _configure(lib):
         i64, i64, ctypes.POINTER(ctypes.c_int),
         ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
         ctypes.POINTER(ctypes.c_float), i64, ctypes.c_int]
+    if hasattr(lib, "mxtpu_crop_batch_u8"):
+        # absent in prebuilt libraries older than device-augment mode;
+        # image.py guards with hasattr and falls back to numpy for THIS
+        # kernel only — the rest of the library must stay usable
+        lib.mxtpu_crop_batch_u8.restype = None
+        lib.mxtpu_crop_batch_u8.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(i64),
+            ctypes.POINTER(i64), i64, ctypes.POINTER(i64),
+            ctypes.POINTER(i64), i64, i64, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_uint8), i64, ctypes.c_int]
     return lib
 
 

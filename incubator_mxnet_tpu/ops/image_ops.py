@@ -1,0 +1,65 @@
+"""On-device input preprocessing ops.
+
+The reference normalizes and lays out images on the HOST inside its C++
+iterator (`src/io/iter_normalize.h`, `iter_image_recordio_2.cc` — mean
+subtract, std divide, HWC->CHW), then ships fp32 NCHW over PCIe.  On TPU
+the right split is the opposite: ship the decoded uint8 HWC bytes (4x
+fewer than fp32) and make normalize/cast/layout GRAPH ops — XLA fuses
+them into the first convolution, so they cost nothing, and the batch
+rides the interconnect at a quarter of the bandwidth.
+
+`ImageNormalize` is the graph-side half of `ImageRecordIter
+(device_augment=True)`; the iterator's `normalize_symbol(data)` method
+composes the two with its own mean/std.
+"""
+from __future__ import annotations
+
+import ast
+
+import jax.numpy as jnp
+
+from .registry import register
+from ..base import MXNetError
+
+
+def _floats(v, n):
+    if isinstance(v, str):
+        v = ast.literal_eval(v)
+    if isinstance(v, (int, float)):
+        return (float(v),) * n
+    out = tuple(float(x) for x in v)
+    if len(out) == 1:
+        return out * n
+    return out
+
+
+@register("ImageNormalize", nin=1,
+          params={"mean": 0.0, "std": 1.0, "input_layout": "NHWC",
+                  "output_layout": "NCHW", "dtype": "float32"})
+def _image_normalize(params, x):
+    """(x - mean) / std with a layout move, as ONE graph node.
+
+    Input: a batch in `input_layout` (typically uint8 NHWC straight from
+    the data pipeline).  Output: `dtype` in `output_layout`.  mean/std are
+    per-channel tuples (or scalars).  Reference semantics match the
+    iterator-side normalization of `src/io/iter_normalize.h:mean_r/g/b`
+    + `std_r/g/b`, relocated into the compiled program.
+    """
+    ilay = str(params.get("input_layout", "NHWC")).upper()
+    olay = str(params.get("output_layout", "NCHW")).upper()
+    if ilay not in ("NHWC", "NCHW") or olay not in ("NHWC", "NCHW"):
+        raise MXNetError("ImageNormalize: layouts must be NHWC or NCHW")
+    c = x.shape[-1] if ilay == "NHWC" else x.shape[1]
+    mean = jnp.asarray(_floats(params.get("mean", 0.0), c), jnp.float32)
+    stdinv = 1.0 / jnp.asarray(_floats(params.get("std", 1.0), c),
+                               jnp.float32)
+    if ilay == "NHWC":
+        shape = (1, 1, 1, c)
+    else:
+        shape = (1, c, 1, 1)
+    out = (x.astype(jnp.float32) - mean.reshape(shape)) \
+        * stdinv.reshape(shape)
+    if ilay != olay:
+        out = out.transpose((0, 3, 1, 2) if olay == "NCHW"
+                            else (0, 2, 3, 1))
+    return out.astype(jnp.dtype(str(params.get("dtype", "float32"))))

@@ -121,4 +121,61 @@ void mxtpu_augment_batch(const uint8_t** srcs, const int64_t* hs,
   }
 }
 
+// Device-augment mode: crop + optional mirror + BGR->RGB into uint8 HWC.
+// No float math, no layout change — normalize/cast/NCHW happen IN the
+// training program on the accelerator (ops ImageNormalize), so the host
+// only moves a quarter of the bytes the fp32 finish wrote.
+void mxtpu_crop_u8_hwc(const uint8_t* src, int64_t w, int64_t c,
+                       int64_t crop_y, int64_t crop_x, int64_t out_h,
+                       int64_t out_w, int mirror, uint8_t* dst,
+                       int channel_reverse) {
+  for (int64_t y = 0; y < out_h; ++y) {
+    const uint8_t* row = src + ((crop_y + y) * w + crop_x) * c;
+    uint8_t* drow = dst + y * out_w * c;
+    if (c == 3) {
+      if (!mirror && !channel_reverse) {
+        std::memcpy(drow, row, static_cast<size_t>(out_w) * 3);
+        continue;
+      }
+      const uint8_t* px = mirror ? row + (out_w - 1) * 3 : row;
+      const int64_t step = mirror ? -3 : 3;
+      if (channel_reverse) {
+        for (int64_t x = 0; x < out_w; ++x, px += step) {
+          drow[x * 3 + 0] = px[2];
+          drow[x * 3 + 1] = px[1];
+          drow[x * 3 + 2] = px[0];
+        }
+      } else {
+        for (int64_t x = 0; x < out_w; ++x, px += step) {
+          drow[x * 3 + 0] = px[0];
+          drow[x * 3 + 1] = px[1];
+          drow[x * 3 + 2] = px[2];
+        }
+      }
+      continue;
+    }
+    for (int64_t x = 0; x < out_w; ++x) {
+      const uint8_t* px = row + (mirror ? (out_w - 1 - x) : x) * c;
+      for (int64_t ch = 0; ch < c; ++ch) {
+        int64_t oc = channel_reverse ? (c - 1 - ch) : ch;
+        drow[x * c + oc] = px[ch];
+      }
+    }
+  }
+}
+
+void mxtpu_crop_batch_u8(const uint8_t** srcs, const int64_t* hs,
+                         const int64_t* ws, int64_t c,
+                         const int64_t* crop_ys, const int64_t* crop_xs,
+                         int64_t out_h, int64_t out_w, const int* mirrors,
+                         uint8_t* dst, int64_t n, int channel_reverse) {
+  (void)hs;
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    mxtpu_crop_u8_hwc(srcs[i], ws[i], c, crop_ys[i], crop_xs[i], out_h,
+                      out_w, mirrors[i], dst + i * out_h * out_w * c,
+                      channel_reverse);
+  }
+}
+
 }  // extern "C"

@@ -143,3 +143,47 @@ def test_ndarray_iter_preserves_dtype():
     assert batch.label[0].dtype == np.int32
     assert batch.data[0].dtype == np.float32
     assert it.provide_label[0].dtype == np.int32
+
+
+def test_device_augment_mode_parity(tmp_path):
+    """device_augment=True (uint8 NHWC out + in-graph ImageNormalize) must
+    reproduce the classic host-normalized fp32 NCHW batches exactly: same
+    seed -> same crops/mirrors, and the graph-side normalize matches the
+    host kernel."""
+    from incubator_mxnet_tpu.io import ImageRecordIter
+    import incubator_mxnet_tpu as mx
+    path = str(tmp_path / "imgs.rec")
+    writer = recordio.MXRecordIO(path, "w")
+    rng = np.random.RandomState(1)
+    for i in range(8):
+        img = (rng.rand(28, 30, 3) * 255).astype("uint8")
+        writer.write(recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, img_fmt=".png"))
+    writer.close()
+    kw = dict(path_imgrec=path, data_shape=(3, 24, 24), batch_size=4,
+              rand_crop=True, rand_mirror=True, seed=5,
+              mean_r=123.68, mean_g=116.78, mean_b=103.94,
+              std_r=58.4, std_g=57.1, std_b=57.4, preprocess_threads=1)
+    classic = ImageRecordIter(**kw)
+    dev = ImageRecordIter(device_augment=True, **kw)
+    got_any = False
+    for bc, bd in zip(classic, dev):
+        assert bd.data[0].dtype == np.uint8
+        assert bd.data[0].shape == (4, 24, 24, 3)
+        norm = mx.nd.ImageNormalize(
+            bd.data[0], mean=(123.68, 116.78, 103.94),
+            std=(58.4, 57.1, 57.4), input_layout="NHWC",
+            output_layout="NCHW")
+        np.testing.assert_allclose(norm.asnumpy(), bc.data[0].asnumpy(),
+                                   rtol=1e-5, atol=1e-4)
+        np.testing.assert_allclose(bd.label[0].asnumpy(),
+                                   bc.label[0].asnumpy())
+        got_any = True
+    assert got_any
+    # normalize_symbol composes the same thing symbolically
+    data = mx.sym.Variable("data")
+    out = dev.normalize_symbol(data)
+    ex = out.bind(mx.cpu(), {"data": mx.nd.array(
+        np.zeros((4, 24, 24, 3), np.uint8))})
+    y = ex.forward()[0]
+    assert y.shape == (4, 3, 24, 24)

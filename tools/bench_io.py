@@ -37,14 +37,16 @@ def build_corpus(path, n=1024, size=256, quality=90):
     rec.close()
 
 
-def measure(path, batch_size, shape, threads, epochs=1):
+def measure(path, batch_size, shape, threads, epochs=1,
+            device_augment=False):
     from incubator_mxnet_tpu import io as mxio
     it = mxio.ImageRecordIter(
         path_imgrec=path, data_shape=shape, batch_size=batch_size,
         rand_crop=True, rand_mirror=True,
         mean_r=123.68, mean_g=116.78, mean_b=103.94,
         std_r=58.4, std_g=57.1, std_b=57.4,
-        preprocess_threads=threads, prefetch_buffer=8)
+        preprocess_threads=threads, prefetch_buffer=8,
+        device_augment=device_augment)
     for i, batch in enumerate(it):      # warmup: jax init + jit caches
         if i >= 2:
             break
@@ -78,6 +80,13 @@ def main():
         for t in args.threads:
             results[f"threads_{t}"] = round(
                 measure(rec, args.batch, (3, args.crop, args.crop), t), 1)
+        # device-augment lane: host stops at decode + uint8 crop (the
+        # fp32 normalize/transpose finish moves into the training
+        # program) — the training-relevant host rate on TPU
+        for t in args.threads:
+            results[f"device_augment_threads_{t}"] = round(
+                measure(rec, args.batch, (3, args.crop, args.crop), t,
+                        device_augment=True), 1)
         best = max(results.values())
         # the per-core ceiling: raw JPEG decode alone (no unpack/augment/
         # batch/queue).  pipeline/ceiling says how much headroom the
