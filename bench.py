@@ -287,6 +287,168 @@ def _run_gluon(batch, image, steps, dtype):
 
 
 # ---------------------------------------------------------------------------
+# PTB LSTM lane (BASELINE config #4: example/rnn/bucketing/lstm_bucketing.py
+# — 2x200 LSTM, embed 200, vocab 10k, batch 32, bptt 35).  The framework
+# path is the bucketing example's symbol: cell unroll emits ONE _foreach
+# (lax.scan); a hand-written raw-JAX LSTM control runs the same math.
+# ---------------------------------------------------------------------------
+
+_LSTM_CFG = dict(vocab=10000, embed=200, hidden=200, layers=2,
+                 batch=32, seq=35)
+
+
+def _lstm_symbol(mx, cfg):
+    from incubator_mxnet_tpu import rnn
+    stack = rnn.SequentialRNNCell()
+    for i in range(cfg["layers"]):
+        stack.add(rnn.LSTMCell(cfg["hidden"], prefix=f"lstm_l{i}_"))
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    embed = mx.sym.Embedding(data, input_dim=cfg["vocab"],
+                             output_dim=cfg["embed"], name="embed")
+    stack.reset()
+    outputs, _ = stack.unroll(cfg["seq"], inputs=embed, merge_outputs=True)
+    pred = mx.sym.Reshape(outputs, shape=(-1, cfg["hidden"]))
+    pred = mx.sym.FullyConnected(pred, num_hidden=cfg["vocab"], name="pred")
+    lab = mx.sym.Reshape(label, shape=(-1,))
+    net = mx.sym.SoftmaxOutput(pred, lab, name="softmax")
+    n_scan = sum(1 for n in net._topo()
+                 if not n.is_variable and n.op.name == "_foreach")
+    assert n_scan == 1, "bucketed LSTM must compile to ONE scan"
+    return net
+
+
+def _run_lstm_framework(steps):
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import io, nd
+
+    cfg = _LSTM_CFG
+    mx.random.seed(0)
+    ctx = mx.tpu() if mx.context.num_tpus() else mx.cpu()
+    net = _lstm_symbol(mx, cfg)
+    batch, seq = cfg["batch"], cfg["seq"]
+    rng = np.random.RandomState(0)
+    data = nd.array(rng.randint(0, cfg["vocab"], (batch, seq))
+                    .astype("f4"), ctx=ctx)
+    label = nd.array(rng.randint(0, cfg["vocab"], (batch, seq))
+                     .astype("f4"), ctx=ctx)
+    warm = _BLOCK
+    n_batches = warm + steps + _BLOCK
+    batch_obj = io.DataBatch(
+        data=[data], label=[label], pad=0,
+        provide_data=[io.DataDesc("data", (batch, seq), dtype=np.float32)],
+        provide_label=[io.DataDesc("softmax_label", (batch, seq),
+                                   dtype=np.float32)])
+
+    class It(io.DataIter):
+        def __init__(self):
+            super().__init__(batch_size=batch)
+            self._i = 0
+
+        provide_data = property(lambda s: batch_obj.provide_data)
+        provide_label = property(lambda s: batch_obj.provide_label)
+
+        def reset(self):
+            self._i = 0
+
+        def next(self):
+            if self._i >= n_batches:
+                raise StopIteration
+            self._i += 1
+            return batch_obj
+
+    mod = mx.mod.Module(net, context=ctx)
+    probe = _Probe(warm, steps, batch)
+    mod.fit(It(), num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                              "rescale_grad": 1.0 / batch},
+            eval_metric=mx.metric.Perplexity(0),
+            initializer=mx.initializer.Xavier(factor_type="in",
+                                              magnitude=2.34),
+            batch_end_callback=probe, kvstore=None)
+    assert probe.img_s is not None, "lstm probe missed its window"
+    fused = mod._fused_step
+    assert fused is not None and not fused.broken, \
+        "lstm lane must run the fused train step"
+    return probe.compile_s, probe.img_s * seq   # tokens/s
+
+
+def _pure_jax_lstm(steps):
+    """Raw-JAX 2-layer LSTM LM matching _LSTM_CFG: embed -> scan -> FC ->
+    CE, SGD momentum, donated step — the hand-written control."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    cfg = _LSTM_CFG
+    V, E, H, L = cfg["vocab"], cfg["embed"], cfg["hidden"], cfg["layers"]
+    B, T = cfg["batch"], cfg["seq"]
+    rng = np.random.RandomState(0)
+
+    def mk(shape, scale=0.1):
+        return rng.uniform(-scale, scale, shape).astype("f4")
+
+    w = {"emb": mk((V, E)), "fc_w": mk((V, H)), "fc_b": np.zeros(V, "f4")}
+    for i in range(L):
+        cin = E if i == 0 else H
+        w[f"wx{i}"] = mk((4 * H, cin))
+        w[f"wh{i}"] = mk((4 * H, H))
+        w[f"b{i}"] = np.zeros(4 * H, "f4")
+
+    def lstm_layer(p, i, xs):
+        def step(carry, x):
+            h, c = carry
+            g = x @ p[f"wx{i}"].T + h @ p[f"wh{i}"].T + p[f"b{i}"]
+            ii, f, gg, o = jnp.split(g, 4, axis=-1)
+            c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(ii) * jnp.tanh(gg)
+            h = jax.nn.sigmoid(o) * jnp.tanh(c)
+            return (h, c), h
+
+        h0 = jnp.zeros((xs.shape[1], H), xs.dtype)
+        (_, _), ys = lax.scan(step, (h0, h0), xs)
+        return ys
+
+    def loss_fn(p, tok, lab):
+        xs = p["emb"][tok].transpose(1, 0, 2)   # (T, B, E)
+        for i in range(L):
+            xs = lstm_layer(p, i, xs)
+        logits = xs.reshape(-1, H) @ p["fc_w"].T + p["fc_b"]
+        logp = jax.nn.log_softmax(logits)
+        ll = jnp.take_along_axis(
+            logp, lab.transpose(1, 0).reshape(-1)[:, None], -1)
+        return -jnp.mean(ll)
+
+    def train_step(p, m, tok, lab, lr):
+        loss, grads = jax.value_and_grad(loss_fn)(p, tok, lab)
+        new_p, new_m = {}, {}
+        for k in p:
+            mom = 0.9 * m[k] - lr * grads[k]
+            new_m[k] = mom
+            new_p[k] = p[k] + mom
+        return new_p, new_m, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+    p = {k: jnp.asarray(v) for k, v in w.items()}
+    m = {k: jnp.zeros_like(v) for v, k in zip(w.values(), w)}
+    tok = jnp.asarray(rng.randint(0, V, (B, T)), jnp.int32)
+    lab = jnp.asarray(rng.randint(0, V, (B, T)), jnp.int32)
+    lr = jnp.float32(0.1)
+    t0 = time.perf_counter()
+    p, m, loss = step(p, m, tok, lab, lr)
+    float(loss)
+    compile_s = time.perf_counter() - t0
+    p, m, loss = step(p, m, tok, lab, lr)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        p, m, loss = step(p, m, tok, lab, lr)
+    final = float(loss)
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final)
+    return compile_s, B * T * steps / dt
+
+
+# ---------------------------------------------------------------------------
 # Control path: hand-written raw-JAX ResNet-50 train step (no framework)
 # ---------------------------------------------------------------------------
 
@@ -585,6 +747,20 @@ def main():
                 _RESULT["ratio_vs_pure_jax"] = round(img32 / c32, 3)
         except Exception as e:
             _RESULT["fp32_error"] = repr(e)[:200]
+
+    # -- PTB LSTM lane (BASELINE config #4): tokens/s + raw-JAX control -----
+    if os.environ.get("BENCH_LSTM", "1") == "1" and left() > 150:
+        _RESULT["phase"] = "lstm"
+        try:
+            l_compile, tok_s = _run_lstm_framework(steps)
+            _RESULT["lstm_tokens_s"] = round(tok_s, 1)
+            _RESULT["lstm_compile_s"] = round(l_compile, 2)
+            if want_control and left() > 60:
+                _, c_tok_s = _pure_jax_lstm(steps)
+                _RESULT["lstm_pure_jax_tokens_s"] = round(c_tok_s, 1)
+                _RESULT["lstm_ratio_vs_pure_jax"] = round(tok_s / c_tok_s, 3)
+        except Exception as e:
+            _RESULT["lstm_error"] = repr(e)[:200]
 
     # -- real-data lane: the full input pipeline feeds the chip -------------
     if os.environ.get("BENCH_REAL_DATA", "1") == "1" and left() > 180:

@@ -79,8 +79,22 @@ class RecurrentCell(Block):
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None, valid_length=None):
-        """Unroll over time (reference `rnn_cell.py unroll`)."""
+        """Unroll over time (reference `rnn_cell.py unroll`).
+
+        Symbolic sequences with merged outputs emit ONE `_foreach` node
+        (`lax.scan` in the compiled program) instead of T copies of the
+        cell body — the TPU-first form of the reference's
+        `control_flow.cc` foreach path; cells that cannot scan (aux-state
+        layers in the body) fall back to the classic static unroll."""
         self.reset()
+        from ...symbol.symbol import Symbol as _SymT
+        if merge_outputs and valid_length is None and \
+                isinstance(inputs, _SymT) and begin_state is not None:
+            try:
+                return self._unroll_foreach(length, inputs, begin_state,
+                                            layout)
+            except Exception:
+                self.reset()   # e.g. BatchNorm in the body: static unroll
         inputs, axis, F, length = _format_sequence(length, inputs, layout,
                                                    False)
         if begin_state is None:
@@ -98,6 +112,25 @@ class RecurrentCell(Block):
             outputs = F.stack(*outputs, axis=layout.find("T"),
                               num_args=len(outputs))
         return outputs, states
+
+    def _unroll_foreach(self, length, inputs, begin_state, layout):
+        """One-scan unroll: cell body traced once into a `_foreach`.
+        The sequence is sliced to `length` first (bind errors when the
+        data is shorter, like the static path's split would)."""
+        from ... import symbol as sym_mod
+        axis = layout.find("T")
+        seq = inputs if axis == 0 else \
+            sym_mod.swapaxes(inputs, dim1=0, dim2=axis)
+        seq = sym_mod.slice_axis(seq, axis=0, begin=0, end=int(length))
+
+        def body(x, states):
+            out, new_states = self(x, states)
+            return out, new_states
+
+        outs, states = sym_mod.contrib.foreach(body, seq, begin_state)
+        if axis != 0:
+            outs = sym_mod.swapaxes(outs, dim1=0, dim2=axis)
+        return outs, states
 
     def forward(self, inputs, states):
         self._counter += 1

@@ -65,9 +65,31 @@ class BaseRNNCell:
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
-        """Reference `BaseRNNCell.unroll`: returns (outputs, states)."""
+        """Reference `BaseRNNCell.unroll`: returns (outputs, states).
+
+        A merged-output unroll over a symbolic sequence emits ONE
+        `_foreach` node (`lax.scan` in the compiled program) instead of T
+        copies of the cell body — so a bucketed LSTM graph's size is
+        independent of sequence length.  Cells whose body cannot scan
+        fall back to the classic static unroll."""
         self.reset()
         axis = layout.find("T")
+        if isinstance(inputs, sym.Symbol) and merge_outputs:
+            if begin_state is None:
+                begin_state = self.begin_state()
+            try:
+                seq = inputs if axis == 0 else \
+                    sym.swapaxes(inputs, dim1=0, dim2=axis)
+                # honor `length`: scan exactly the requested steps (bind
+                # errors when the sequence is shorter, like split would)
+                seq = sym.slice_axis(seq, axis=0, begin=0, end=int(length))
+                outs, states = sym.contrib.foreach(
+                    lambda x, st: self(x, st), seq, begin_state)
+                if axis != 0:
+                    outs = sym.swapaxes(outs, dim1=0, dim2=axis)
+                return outs, states
+            except Exception:
+                self.reset()   # e.g. aux-state layers: static unroll
         if isinstance(inputs, sym.Symbol):
             inputs = sym.split(inputs, num_outputs=length, axis=axis,
                                squeeze_axis=1)

@@ -761,6 +761,68 @@ def _infer_graph_with_hint(symbol, shapes, partial, batch_hint):
     return result, out_shapes, None
 
 
+def _solve_subgraph_shapes(node, env):
+    """Shape inference THROUGH control-flow subgraphs: run the subgraph's
+    own inference with the shapes known at the node's inputs (data slices
+    lose their scan axis), then write solved closure/state variable shapes
+    back to the outer graph — the reference does the equivalent via each
+    control-flow op's InferShape recursing into its CachedOp subgraph
+    (`src/operator/control_flow.cc` ForeachShape/WhileLoopShape)."""
+    import jax
+    from ..ops import control_flow as _cf
+    p = node.attrs
+    op_name = node.op.name
+    ins = node.inputs
+
+    def in_shape(idx):
+        src, oi = ins[idx]
+        e = env[id(src)]
+        return None if e is None else tuple(e[oi].shape)
+
+    if op_name == "_foreach":
+        nd_, ns = int(p["num_data"]), int(p["num_states"])
+
+        def slot_index(tag):
+            k, i = tag[0], int(tag[1:])
+            return i if k == "d" else nd_ + i if k == "s" else nd_ + ns + i
+        graphs = [(p["subgraph"], p["arg_map"])]
+    elif op_name == "_while_loop":
+        nv = int(p["num_vars"])
+
+        def slot_index(tag):
+            k, i = tag[0], int(tag[1:])
+            return i if k == "v" else nv + i
+        graphs = [(p["cond_subgraph"], p["cond_arg_map"]),
+                  (p["func_subgraph"], p["func_arg_map"])]
+    else:  # _cond
+
+        def slot_index(tag):
+            return 1 + int(tag[1:])
+        graphs = [(p["then_subgraph"], p["then_arg_map"]),
+                  (p["else_subgraph"], p["else_arg_map"])]
+
+    for gjson, amap in graphs:
+        sub = _cf._subgraph(_cf._json_str(gjson))
+        known = {}
+        for name, tag in amap:
+            shp = in_shape(slot_index(tag))
+            if shp is not None:
+                known[name] = shp[1:] if (op_name == "_foreach" and
+                                          tag[0] == "d") else shp
+        try:
+            solved, _, _ = _infer_graph(sub, known, True)
+        except MXNetError:
+            continue
+        for name, tag in amap:
+            if name in solved and solved[name] and \
+                    all(dim > 0 for dim in solved[name]):
+                src, _ = ins[slot_index(tag)]
+                if src.is_variable and env[id(src)] is None:
+                    env[id(src)] = (jax.ShapeDtypeStruct(
+                        tuple(solved[name]), _np.float32),)
+    return all(env[id(src)] is not None for src, _ in ins)
+
+
 def _solve_param_shapes(node, env):
     """Infer unbound parameter-variable shapes from op attrs + known data shape
     (the reference does this through each op's InferShape; we encode the rules
@@ -768,6 +830,9 @@ def _solve_param_shapes(node, env):
     import jax
     op_name = node.op.name
     ins = node.inputs
+
+    if op_name in ("_foreach", "_while_loop", "_cond"):
+        return _solve_subgraph_shapes(node, env)
 
     def dshape():
         e = env[id(ins[0][0])]
