@@ -98,6 +98,22 @@ class KVStoreDist(KVStoreTPU):
             else:
                 self._store[_key(k)] = v.copyto(self._store_ctx)
 
+    def _wire_dtype(self, merged_dtype):
+        """Wire dtype for compressed-gradient collectives.  Quantized
+        terms are {-t, 0, +t}; partial sums are k*t with |k| <= workers.
+        bf16 (8 significand bits) keeps every k*t EXACT only when t's
+        significand is a single bit (power of two) AND k <= 256 — e.g.
+        t=0.3 already rounds 5t below ten workers.  Outside that envelope
+        the half-width wire would silently diverge from the reference
+        server path's exact accumulation, so it keeps the merged dtype."""
+        import math
+        import jax.numpy as jnp
+        thr = float(self._compression.get("threshold", 0.5))
+        frac = math.frexp(abs(thr))[0] if thr else 0.5
+        if self._num_workers <= 256 and frac == 0.5:
+            return jnp.bfloat16
+        return merged_dtype
+
     def _collective_push(self, sk, vals):
         """Sync push over XLA collectives: local chip reduce, then ONE
         global all-reduce; optimizer (if shipped) applies identically on
@@ -108,15 +124,14 @@ class KVStoreDist(KVStoreTPU):
             # error-feedback quantization BEFORE the collective: summing
             # quantized terms matches the server-side accumulate semantics.
             # The collective then rides the interconnect at HALF width —
-            # quantized grads are in {-t, 0, +t}, which bf16 represents
-            # with one rounding of t identically on every worker — the
-            # collective-mode reading of the reference's wire compression
+            # quantized grads are in {-t, 0, +t} — the collective-mode
+            # reading of the reference's wire compression
             # (`gradient_compression.h:52-134` saves PS bytes; this saves
             # ICI/DCN bytes).
-            import jax.numpy as jnp
             merged = self._compress(sk, merged)
+            wire = self._wire_dtype(merged._data.dtype)
             summed = self._collective.allreduce(
-                merged._data.astype(jnp.bfloat16)).astype(merged._data.dtype)
+                merged._data.astype(wire)).astype(merged._data.dtype)
         else:
             # allreduce returns a fresh worker-local array; wrap without
             # another device copy
@@ -159,7 +174,8 @@ class KVStoreDist(KVStoreTPU):
                 # quantize + halve the wire width (see _collective_push)
                 m = self._compress(sk, m)
                 dtypes.append(m._data.dtype)
-                merged.append(m._data.astype(jnp.bfloat16))
+                merged.append(m._data.astype(
+                    self._wire_dtype(m._data.dtype)))
             else:
                 dtypes.append(None)
                 merged.append(m._data)

@@ -114,23 +114,11 @@ class RecurrentCell(Block):
         return outputs, states
 
     def _unroll_foreach(self, length, inputs, begin_state, layout):
-        """One-scan unroll: cell body traced once into a `_foreach`.
-        The sequence is sliced to `length` first (bind errors when the
-        data is shorter, like the static path's split would)."""
-        from ... import symbol as sym_mod
-        axis = layout.find("T")
-        seq = inputs if axis == 0 else \
-            sym_mod.swapaxes(inputs, dim1=0, dim2=axis)
-        seq = sym_mod.slice_axis(seq, axis=0, begin=0, end=int(length))
-
-        def body(x, states):
-            out, new_states = self(x, states)
-            return out, new_states
-
-        outs, states = sym_mod.contrib.foreach(body, seq, begin_state)
-        if axis != 0:
-            outs = sym_mod.swapaxes(outs, dim1=0, dim2=axis)
-        return outs, states
+        """One-scan unroll: cell body traced once into a `_foreach`
+        (shared lowering: symbol/contrib.py foreach_unroll)."""
+        from ...symbol.contrib import foreach_unroll
+        return foreach_unroll(lambda x, st: self(x, st), inputs,
+                              begin_state, layout, length)
 
     def forward(self, inputs, states):
         self._counter += 1

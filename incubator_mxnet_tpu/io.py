@@ -21,7 +21,8 @@ from .context import cpu
 from .ndarray.ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter"]
+           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
+           "LibSVMIter"]
 
 
 class DataDesc:
@@ -444,3 +445,125 @@ def ImageRecordIter(**kwargs):
 
 def ImageRecordIter_v1(**kwargs):
     return ImageRecordIter(**kwargs)
+
+
+class LibSVMIter(DataIter):
+    """Reference `src/io/iter_libsvm.cc:200`: batches from libsvm-format
+    text (`label idx:val idx:val ...`).  Data batches are CSR
+    (`ndarray.sparse.CSRNDArray`, the host-resident shell — SURVEY §7(d));
+    labels are dense unless a separate `label_libsvm` file with a
+    multi-dimensional `label_shape` is given, in which case they are CSR
+    too, matching the reference's storage types."""
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 label_shape=(1,), batch_size=1, round_batch=True,
+                 **kwargs):
+        super().__init__(batch_size)
+        self._data_shape = tuple(data_shape)
+        self._label_shape = tuple(label_shape) \
+            if not isinstance(label_shape, int) else (int(label_shape),)
+        self._round_batch = round_batch
+        vals, idxs, ptr, labels = self._parse(data_libsvm,
+                                              self._data_shape[0])
+        self._vals, self._idxs, self._ptr = vals, idxs, ptr
+        if label_libsvm is not None:
+            lv, li, lp, _ = self._parse(label_libsvm, self._label_shape[0])
+            self._lvals, self._lidxs, self._lptr = lv, li, lp
+            self._labels = None
+        else:
+            # inline labels: every leading non-feature field, laid out to
+            # label_shape width (reference LibSVMIter label_width)
+            k = 1 if self._label_shape == (1,) else self._label_shape[0]
+            lab = _np.zeros((len(labels), k), dtype="float32")
+            for i, row in enumerate(labels):
+                if row:
+                    lab[i, :min(len(row), k)] = row[:k]
+            self._labels = lab[:, 0] if k == 1 else lab
+            self._lvals = None
+        self._n = len(ptr) - 1
+        self._cur = 0
+
+    @staticmethod
+    def _parse(path, width):
+        vals, idxs, ptr, labels = [], [], [0], []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                parts = line.split()
+                # leading fields without ':' are labels (possibly several)
+                i = 0
+                lab = []
+                while i < len(parts) and ":" not in parts[i]:
+                    lab.append(float(parts[i]))
+                    i += 1
+                labels.append(lab)
+                for tok in parts[i:]:
+                    k, v = tok.split(":")
+                    if int(k) >= width:
+                        raise MXNetError(
+                            f"LibSVMIter: feature index {k} >= data_shape "
+                            f"width {width}")
+                    idxs.append(int(k))
+                    vals.append(float(v))
+                ptr.append(len(vals))
+        return (_np.asarray(vals, "float32"), _np.asarray(idxs, _np.int64),
+                _np.asarray(ptr, _np.int64), labels)
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size, self._data_shape[0]))]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self._label_shape == (1,) else \
+            (self.batch_size,) + self._label_shape
+        return [DataDesc("softmax_label", shape)]
+
+    def reset(self):
+        self._cur = 0
+
+    @staticmethod
+    def _csr_rows(vals, idxs, ptr, ranges, width):
+        """CSR batch over concatenated [lo, hi) row ranges — pure pointer
+        splicing, never densified (libsvm feature widths are often huge)."""
+        from .ndarray.sparse import CSRNDArray
+        v_parts, i_parts, new_ptr = [], [], [0]
+        n = 0
+        for lo, hi in ranges:
+            seg = ptr[lo:hi + 1]
+            v_parts.append(vals[seg[0]:seg[-1]])
+            i_parts.append(idxs[seg[0]:seg[-1]])
+            base = new_ptr[-1] - seg[0]
+            new_ptr.extend((seg[1:] + base).tolist())
+            n += hi - lo
+        return CSRNDArray(
+            _np.concatenate(v_parts) if v_parts else vals[:0],
+            _np.concatenate(i_parts) if i_parts else idxs[:0],
+            _np.asarray(new_ptr, _np.int64), (n, width))
+
+    def next(self):
+        if self._cur >= self._n:
+            raise StopIteration
+        lo = self._cur
+        hi = min(lo + self.batch_size, self._n)
+        pad = self.batch_size - (hi - lo)
+        if pad and not self._round_batch:
+            raise StopIteration
+        self._cur = hi
+        # reference round_batch: the tail wraps rows from the epoch start
+        ranges = [(lo, hi)] + ([(0, pad)] if pad else [])
+        data = self._csr_rows(self._vals, self._idxs, self._ptr, ranges,
+                              self._data_shape[0])
+        if self._labels is not None:
+            lab = self._labels[lo:hi]
+            if pad:
+                lab = _np.concatenate([lab, self._labels[:pad]])
+            label = array(lab)
+        else:
+            label = self._csr_rows(self._lvals, self._lidxs, self._lptr,
+                                   ranges, self._label_shape[0])
+        return DataBatch(data=[data], label=[label], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

@@ -26,4 +26,5 @@ from . import detection     # noqa: F401
 from . import spatial       # noqa: F401
 from . import image_ops     # noqa: F401
 from . import control_flow  # noqa: F401
+from . import contrib_tail  # noqa: F401
 from . import quantization  # noqa: F401

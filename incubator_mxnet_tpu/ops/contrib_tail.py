@@ -1,0 +1,378 @@
+"""Contrib/tensor op tail (reference `src/operator/contrib/` +
+`src/operator/tensor/`): fft/ifft, count_sketch, khatri_rao, histogram,
+ravel/unravel, square_sum, cast_storage, sparse_retain, SyncBatchNorm,
+DeformableConvolution, DeformablePSROIPooling.
+
+All are single jax-traceable compute functions: XLA generates the TPU
+kernels, `jax.vjp` the gradients (the reference hand-writes CUDA forward
++ backward for each)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, REQUIRED
+from ..base import MXNetError
+
+
+# ---------------------------------------------------------------------------
+# FFT family (reference `contrib/fft-inl.h`, `ifft-inl.h`)
+# ---------------------------------------------------------------------------
+
+@register("_contrib_fft", aliases=("fft",), params={"compute_size": 128})
+def _fft(params, x):
+    """reference contrib/fft.cc: 1D FFT over the last axis of a real
+    input; output's last dim is 2*d with interleaved (re, im) pairs (the
+    cufft complex layout).  `compute_size` is a CUDA sub-batching knob —
+    XLA tiles as it sees fit, so it is accepted and ignored."""
+    c = jnp.fft.fft(x.astype(jnp.float32))
+    out = jnp.stack([jnp.real(c), jnp.imag(c)], axis=-1)
+    return out.reshape(*x.shape[:-1], 2 * x.shape[-1]).astype(x.dtype)
+
+
+@register("_contrib_ifft", aliases=("ifft",), params={"compute_size": 128})
+def _ifft(params, x):
+    """reference contrib/ifft.cc: UNNORMALIZED inverse FFT (cufft
+    CUFFT_INVERSE semantics — the reference never divides by N) of an
+    interleaved-complex input (..., 2d); output (..., d) keeps the real
+    part."""
+    d = x.shape[-1] // 2
+    pairs = x.reshape(*x.shape[:-1], d, 2).astype(jnp.float32)
+    c = jax.lax.complex(pairs[..., 0], pairs[..., 1])
+    return (jnp.real(jnp.fft.ifft(c)) * d).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# count_sketch / khatri_rao (reference `contrib/count_sketch-inl.h`,
+# `contrib/krprod.cc`)
+# ---------------------------------------------------------------------------
+
+@register("_contrib_count_sketch", nin=3,
+          params={"out_dim": REQUIRED, "processing_batch_size": 32})
+def _count_sketch(params, data, h, s):
+    """reference contrib/count_sketch.cc: out[:, h[i]] += s[i] * x[:, i]
+    (the Count Sketch projection of compact bilinear pooling).  One XLA
+    scatter-add instead of the reference's atomic-add CUDA kernel."""
+    out_dim = int(params["out_dim"])
+    idx = h.reshape(-1).astype(jnp.int32)
+    sign = s.reshape(-1).astype(data.dtype)
+    vals = data * sign[None, :]
+    out = jnp.zeros((data.shape[0], out_dim), data.dtype)
+    return out.at[:, idx].add(vals)
+
+
+@register("khatri_rao", nin=-1, variadic_param="num_args",
+          params={"num_args": REQUIRED})
+def _khatri_rao(params, *mats):
+    """reference contrib/krprod.cc: column-wise Khatri-Rao product —
+    inputs (M_i, N) -> (prod M_i, N), column k = kron of the k-th
+    columns."""
+    if not mats:
+        raise MXNetError("khatri_rao needs at least one matrix")
+    out = mats[0]
+    for m in mats[1:]:
+        n = out.shape[-1]
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, n)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# histogram / ravel / unravel / square_sum (reference
+# `tensor/histogram.cc`, `tensor/ravel.cc`, `tensor/square_sum-inl.h`)
+# ---------------------------------------------------------------------------
+
+@register("_histogram", nin=-1, variadic_param="num_args", nout=2,
+          aliases=("histogram",),
+          params={"num_args": 1, "bin_cnt": None, "range": None})
+def _histogram(params, *arrays):
+    """reference tensor/histogram.cc: counts + bin edges.  Either uniform
+    bins (`bin_cnt` + `range`) over the data, or explicit `bins` as a
+    second input."""
+    data = arrays[0].reshape(-1)
+    bin_cnt = params.get("bin_cnt")
+    if bin_cnt is not None:
+        lo, hi = params["range"]
+        counts, edges = jnp.histogram(
+            data.astype(jnp.float32), bins=int(bin_cnt),
+            range=(float(lo), float(hi)))
+    else:
+        if len(arrays) < 2:
+            raise MXNetError("_histogram: provide bins input or bin_cnt")
+        counts, edges = jnp.histogram(data.astype(jnp.float32),
+                                      bins=arrays[1].astype(jnp.float32))
+    return counts, edges.astype(arrays[-1].dtype if len(arrays) > 1
+                                else jnp.float32)
+
+
+@register("_ravel_multi_index", aliases=("ravel_multi_index",),
+          params={"shape": REQUIRED})
+def _ravel_multi_index(params, idx):
+    """reference tensor/ravel.cc: (ndim, n) index columns -> (n,) flat."""
+    shape = tuple(int(s) for s in params["shape"])
+    flat = jnp.zeros(idx.shape[1:], jnp.int64 if idx.dtype == jnp.int64
+                     else jnp.int32)
+    for d, s in enumerate(shape):
+        flat = flat * s + idx[d].astype(flat.dtype)
+    return flat.astype(idx.dtype)
+
+
+@register("_unravel_index", aliases=("unravel_index",),
+          params={"shape": REQUIRED})
+def _unravel_index(params, flat):
+    """reference tensor/ravel.cc: (n,) flat -> (ndim, n) index columns."""
+    shape = tuple(int(s) for s in params["shape"])
+    rows = []
+    rem = flat.astype(jnp.int32)
+    for s in reversed(shape):
+        rows.append(rem % s)
+        rem = rem // s
+    return jnp.stack(rows[::-1], axis=0).astype(flat.dtype)
+
+
+@register("_square_sum", params={"axis": None, "keepdims": False,
+                                 "exclude": False})
+def _square_sum(params, x):
+    """reference tensor/square_sum-inl.h: sum(x*x) over `axis` — the
+    row-sparse fast path there is a storage optimization; on TPU the
+    dense multiply-reduce is one fused XLA loop either way."""
+    axis = params["axis"]
+    if axis is not None and not isinstance(axis, (tuple, list)):
+        axis = (int(axis),)
+    if axis is not None and params.get("exclude"):
+        axis = tuple(i for i in range(x.ndim) if i not in
+                     tuple(a % x.ndim for a in axis))
+    return jnp.sum(jnp.square(x), axis=None if axis is None
+                   else tuple(axis), keepdims=bool(params["keepdims"]))
+
+
+@register("cast_storage", params={"stype": REQUIRED})
+def _cast_storage(params, x):
+    """reference tensor/cast_storage.cc.  XLA arrays are dense; the
+    graph-level op is the identity for every target stype (sparse
+    STORAGE lives host-side in ndarray/sparse.py, whose tostype() handles
+    the imperative conversions)."""
+    if params["stype"] not in ("default", "row_sparse", "csr"):
+        raise MXNetError(f"cast_storage: unknown stype {params['stype']}")
+    return x
+
+
+@register("sparse_retain", nin=2)
+def _sparse_retain(params, data, indices):
+    """reference tensor/sparse_retain.cc: keep the rows listed in
+    `indices`, zero the rest (dense semantics of the row_sparse op)."""
+    idx = indices.reshape(-1).astype(jnp.int32)
+    out = jnp.zeros_like(data)
+    return out.at[idx].set(data[idx])
+
+
+# ---------------------------------------------------------------------------
+# SyncBatchNorm (reference `contrib/sync_batch_norm-inl.h`)
+# ---------------------------------------------------------------------------
+
+def _bn_nout(params):
+    return 3 if params.get("output_mean_var") else 1
+
+
+@register("_contrib_SyncBatchNorm", nin=3, naux=2, nout=_bn_nout,
+          mode_dependent=True, aliases=("SyncBatchNorm",),
+          params={"eps": 1e-3, "momentum": 0.9, "fix_gamma": True,
+                  "use_global_stats": False, "output_mean_var": False,
+                  "ndev": 1, "key": ""},
+          input_names=["data", "gamma", "beta", "moving_mean", "moving_var"])
+def _sync_batch_norm(params, x, gamma, beta, moving_mean, moving_var):
+    """reference contrib/sync_batch_norm-inl.h: BatchNorm whose batch
+    statistics span all devices.  The reference synchronizes through a
+    host-side key-matched all-reduce across `ndev` workers; here the op
+    IS plain BatchNorm math — under SPMD (pjit over a dp-sharded batch)
+    the mean/var reductions run over the full logical batch, XLA inserts
+    the cross-device all-reduce, and `key`/`ndev` are accepted for API
+    compatibility."""
+    from .nn import _batch_norm
+    sub = {k: params[k] for k in ("eps", "momentum", "fix_gamma",
+                                  "use_global_stats", "output_mean_var")}
+    sub["axis"] = 1
+    sub["_train"] = params.get("_train", False)
+    return _batch_norm(sub, x, gamma, beta, moving_mean, moving_var)
+
+
+# ---------------------------------------------------------------------------
+# Deformable ops (reference `contrib/deformable_convolution-inl.h`,
+# `contrib/deformable_psroi_pooling-inl.h` — the Deformable ConvNets /
+# R-FCN pair).  Both are bilinear-gather + contract formulations: XLA
+# lowers the gathers and the MXU does the contraction, replacing the
+# reference's hand-written deformable_im2col CUDA kernels.
+# ---------------------------------------------------------------------------
+
+def _pair(v, default):
+    if not v:
+        return (default, default)
+    if isinstance(v, int):
+        return (int(v), int(v))
+    return tuple(int(x) for x in v)
+
+
+def _bilinear_gather(img, py, px):
+    """img (C, H, W); py/px (...) float sample positions.  Zero outside
+    [0, H)x[0, W) (the reference's dmcn_im2col_bilinear semantics).
+    Returns (C, ...)."""
+    H, W = img.shape[-2:]
+    y0 = jnp.floor(py)
+    x0 = jnp.floor(px)
+    out = 0.0
+    for dy in (0, 1):
+        for dx in (0, 1):
+            yi = y0 + dy
+            xi = x0 + dx
+            w = ((1 - jnp.abs(py - yi)) * (1 - jnp.abs(px - xi)))
+            valid = (yi >= 0) & (yi <= H - 1) & (xi >= 0) & (xi <= W - 1)
+            yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+            xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+            out = out + img[:, yc, xc] * (w * valid)[None]
+    return out
+
+
+@register("_contrib_DeformableConvolution", nin=-1,
+          aliases=("DeformableConvolution",),
+          params={"kernel": REQUIRED, "stride": (), "dilate": (), "pad": (),
+                  "num_filter": REQUIRED, "num_group": 1,
+                  "num_deformable_group": 1, "workspace": 1024,
+                  "no_bias": False, "layout": None},
+          input_names=lambda p: ["data", "offset", "weight"] +
+          ([] if p.get("no_bias") else ["bias"]))
+def _deformable_convolution(params, data, offset, weight, *rest):
+    """reference contrib/deformable_convolution.cc (Deformable ConvNets
+    v1): each kernel tap samples at base + dilation + learned offset via
+    bilinear interpolation, then a grouped contraction applies the
+    weights."""
+    kh, kw = _pair(params["kernel"], 1)
+    sh, sw = _pair(params["stride"], 1)
+    dh, dw = _pair(params["dilate"], 1)
+    ph, pw = _pair(params["pad"], 0)
+    F = int(params["num_filter"])
+    G = int(params["num_group"])
+    DG = int(params["num_deformable_group"])
+    N, C, H, W = data.shape
+    Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    K = kh * kw
+
+    # offset channel layout (deformable_im2col): per deformable group a
+    # block of 2*K channels, (y_k, x_k) interleaved
+    off = offset.reshape(N, DG, K, 2, Ho, Wo)
+    kyx = jnp.stack(jnp.meshgrid(jnp.arange(kh) * dh, jnp.arange(kw) * dw,
+                                 indexing="ij"), -1).reshape(K, 2)
+    base_y = (jnp.arange(Ho) * sh - ph).astype(off.dtype)
+    base_x = (jnp.arange(Wo) * sw - pw).astype(off.dtype)
+    py = off[:, :, :, 0] + base_y[None, None, None, :, None] + \
+        kyx[:, 0].astype(off.dtype)[None, None, :, None, None]
+    px = off[:, :, :, 1] + base_x[None, None, None, None, :] + \
+        kyx[:, 1].astype(off.dtype)[None, None, :, None, None]
+
+    Cg = C // DG
+    data_g = data.reshape(N, DG, Cg, H, W)
+    # (N, DG, Cg, K, Ho, Wo)
+    cols = jax.vmap(jax.vmap(_bilinear_gather))(data_g, py, px)
+    cols = cols.reshape(N, C, K, Ho, Wo)
+
+    w_g = weight.reshape(G, F // G, C // G, K)
+    cols_g = cols.reshape(N, G, C // G, K, Ho, Wo)
+    out = jnp.einsum("ngckhw,gfck->ngfhw", cols_g, w_g,
+                     preferred_element_type=jnp.float32)
+    out = out.reshape(N, F, Ho, Wo).astype(data.dtype)
+    if rest and not params.get("no_bias"):
+        out = out + rest[0][None, :, None, None]
+    return out
+
+
+@register("_contrib_DeformablePSROIPooling", nin=-1, nout=2,
+          aliases=("DeformablePSROIPooling",),
+          params={"spatial_scale": REQUIRED, "output_dim": REQUIRED,
+                  "group_size": REQUIRED, "pooled_size": REQUIRED,
+                  "part_size": 0, "sample_per_part": 1, "trans_std": 0.0,
+                  "no_trans": False},
+          input_names=lambda p: ["data", "rois"] +
+          ([] if p.get("no_trans") else ["trans"]))
+def _deformable_psroi_pooling(params, data, rois, *rest):
+    """reference contrib/deformable_psroi_pooling.cc (R-FCN deformable
+    head): position-sensitive ROI pooling whose bins shift by learned,
+    roi-normalized offsets.  Outputs (output, top_count) like the
+    reference (top_count = valid samples per bin)."""
+    scale = float(params["spatial_scale"])
+    od = int(params["output_dim"])
+    gs = int(params["group_size"])
+    ps = int(params["pooled_size"])
+    part = int(params["part_size"]) or ps
+    spp = int(params["sample_per_part"])
+    tstd = float(params["trans_std"])
+    no_trans = bool(params["no_trans"]) or not rest
+    trans = None if no_trans else rest[0]
+    N, C, H, W = data.shape
+
+    # channel map c(ctop, ph, pw) = (ctop*gs + gh)*gs + gw
+    phs = jnp.arange(ps)
+    gh = jnp.clip(jnp.floor(phs * gs / ps), 0, gs - 1).astype(jnp.int32)
+    gw = gh
+    c_idx = (jnp.arange(od)[:, None, None] * gs + gh[None, :, None]) * gs \
+        + gw[None, None, :]                       # (od, ps, ps)
+    part_h = jnp.clip(jnp.floor(phs * part / ps), 0, part - 1).astype(
+        jnp.int32)
+
+    if trans is not None:
+        num_classes = trans.shape[1] // 2
+        cls_of = (jnp.arange(od) // max(od // num_classes, 1)).astype(
+            jnp.int32)
+
+    def per_roi(roi, tr):
+        b = roi[0].astype(jnp.int32)
+        start_w = jnp.round(roi[1]) * scale - 0.5
+        start_h = jnp.round(roi[2]) * scale - 0.5
+        end_w = (jnp.round(roi[3]) + 1.0) * scale - 0.5
+        end_h = (jnp.round(roi[4]) + 1.0) * scale - 0.5
+        roi_w = jnp.maximum(end_w - start_w, 0.1)
+        roi_h = jnp.maximum(end_h - start_h, 0.1)
+        bin_h = roi_h / ps
+        bin_w = roi_w / ps
+        sub_h = bin_h / spp
+        sub_w = bin_w / spp
+        if trans is not None:
+            # trans (2*num_classes, part, part): channel 2c = x, 2c+1 = y
+            tx = tr[cls_of * 2][:, part_h][:, :, part_h] * tstd   # (od,ps,ps)
+            ty = tr[cls_of * 2 + 1][:, part_h][:, :, part_h] * tstd
+        else:
+            tx = ty = jnp.zeros((od, ps, ps), data.dtype)
+        hstart = start_h + phs.astype(data.dtype)[None, :, None] * bin_h \
+            + ty * roi_h                                        # (od,ps,ps)
+        wstart = start_w + phs.astype(data.dtype)[None, None, :] * bin_w \
+            + tx * roi_w
+        iy = (jnp.arange(spp) + 0.5) * sub_h                     # (spp,)
+        ix = (jnp.arange(spp) + 0.5) * sub_w
+        hh = hstart[..., None, None] + iy[:, None]               # od,ps,ps,spp,1
+        ww = wstart[..., None, None] + ix[None, :]
+        hh, ww = jnp.broadcast_arrays(hh, ww)                    # od,ps,ps,spp,spp
+        valid = (hh > -0.5) & (hh < H - 0.5) & (ww > -0.5) & (ww < W - 0.5)
+        hc = jnp.clip(hh, 0, H - 1)
+        wc = jnp.clip(ww, 0, W - 1)
+        img = data[b]                                            # (C,H,W)
+        # bilinear-gather per (od,ps,ps,spp,spp) from the mapped channel
+        cc = jnp.broadcast_to(c_idx[..., None, None], hh.shape)
+        y0 = jnp.floor(hc)
+        x0 = jnp.floor(wc)
+        acc = 0.0
+        for dy in (0, 1):
+            for dx in (0, 1):
+                yi = jnp.clip(y0 + dy, 0, H - 1).astype(jnp.int32)
+                xi = jnp.clip(x0 + dx, 0, W - 1).astype(jnp.int32)
+                wgt = (1 - jnp.abs(hc - (y0 + dy))) * \
+                    (1 - jnp.abs(wc - (x0 + dx)))
+                acc = acc + img[cc, yi, xi] * wgt
+        acc = jnp.where(valid, acc, 0.0)
+        count = valid.sum((-1, -2)).astype(data.dtype)
+        total = acc.sum((-1, -2))
+        out = jnp.where(count > 0, total / jnp.maximum(count, 1), 0.0)
+        return out.astype(data.dtype), count
+
+    if trans is not None:
+        outs, counts = jax.vmap(per_roi)(rois, trans)
+    else:
+        outs, counts = jax.vmap(lambda r: per_roi(r, None))(rois)
+    return outs, counts

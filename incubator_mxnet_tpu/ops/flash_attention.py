@@ -222,8 +222,8 @@ def _stream_tpu(q3, k3, v3, q_off, k_off, causal, block_q, block_k,
 
 
 def _vmem_budget_bytes():
-    import os
-    return int(float(os.environ.get("MXNET_FLASH_VMEM_MB", 10)) * 2 ** 20)
+    from .. import config as _config
+    return int(float(_config.get("MXNET_FLASH_VMEM_MB")) * 2 ** 20)
 
 
 def _partial_tpu(q3, k3, v3, q_off, k_off, causal, block_q, block_k,

@@ -52,6 +52,12 @@ def host_rng():
     import numpy as np
     seq = getattr(_state, "host_seq", None)
     if seq is None:
-        seq = _state.host_seq = [0, 0]
+        # never-seeded: draw the base from OS entropy (the reference's
+        # mt19937 resource seeds non-deterministically by default too) —
+        # a fixed (0, 0) base would make every unseeded process produce
+        # byte-identical "random" initializations.  Note: np.random.seed()
+        # does NOT influence this stream; use mx.random.seed() (README).
+        seq = _state.host_seq = [
+            int(np.random.SeedSequence().entropy % (2 ** 63)), 0]
     seq[1] += 1
     return np.random.default_rng(np.random.SeedSequence(tuple(seq)))

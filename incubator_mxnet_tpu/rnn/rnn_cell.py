@@ -78,16 +78,9 @@ class BaseRNNCell:
             if begin_state is None:
                 begin_state = self.begin_state()
             try:
-                seq = inputs if axis == 0 else \
-                    sym.swapaxes(inputs, dim1=0, dim2=axis)
-                # honor `length`: scan exactly the requested steps (bind
-                # errors when the sequence is shorter, like split would)
-                seq = sym.slice_axis(seq, axis=0, begin=0, end=int(length))
-                outs, states = sym.contrib.foreach(
-                    lambda x, st: self(x, st), seq, begin_state)
-                if axis != 0:
-                    outs = sym.swapaxes(outs, dim1=0, dim2=axis)
-                return outs, states
+                from ..symbol.contrib import foreach_unroll
+                return foreach_unroll(lambda x, st: self(x, st), inputs,
+                                      begin_state, layout, length)
             except Exception:
                 self.reset()   # e.g. aux-state layers: static unroll
         if isinstance(inputs, sym.Symbol):

@@ -204,3 +204,19 @@ def cond(pred, then_func, else_func, name="cond"):
                       "name": uname})
     outs_r, _ = _regroup([res[i] for i in range(len(t_list))], t_fmt)
     return outs_r
+
+
+def foreach_unroll(step, inputs, begin_state, layout, length):
+    """One-scan unroll shared by the RNN cell packages (gluon + legacy):
+    swap the sequence T-major, slice to `length` (bind errors when the
+    data is shorter, like a static split would), run `step(x, states)`
+    under foreach, swap back."""
+    from .. import symbol as sym_mod
+    axis = layout.find("T")
+    seq = inputs if axis == 0 else \
+        sym_mod.swapaxes(inputs, dim1=0, dim2=axis)
+    seq = sym_mod.slice_axis(seq, axis=0, begin=0, end=int(length))
+    outs, states = foreach(step, seq, begin_state)
+    if axis != 0:
+        outs = sym_mod.swapaxes(outs, dim1=0, dim2=axis)
+    return outs, states

@@ -1,0 +1,270 @@
+"""Contrib/tensor op tail (ops/contrib_tail.py): fft/ifft, count_sketch,
+khatri_rao, histogram, ravel/unravel, square_sum, cast_storage,
+sparse_retain, SyncBatchNorm, DeformableConvolution,
+DeformablePSROIPooling — each checked against an independent numpy
+rendering of the reference semantics."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+
+def test_fft_ifft_roundtrip():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 8).astype("f4")
+    y = nd.contrib.fft(nd.array(x)).asnumpy()
+    assert y.shape == (4, 16)
+    ref = np.fft.fft(x)
+    np.testing.assert_allclose(y[:, 0::2], ref.real, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(y[:, 1::2], ref.imag, rtol=1e-4, atol=1e-4)
+    # reference ifft is UNNORMALIZED: ifft(fft(x)) == N * x
+    back = nd.contrib.ifft(nd.array(y)).asnumpy()
+    np.testing.assert_allclose(back, 8 * x, rtol=1e-4, atol=1e-3)
+
+
+def test_count_sketch():
+    rng = np.random.RandomState(1)
+    n, d, out_dim = 3, 10, 5
+    x = rng.randn(n, d).astype("f4")
+    h = rng.randint(0, out_dim, d).astype("f4")
+    s = rng.choice([-1.0, 1.0], d).astype("f4")
+    y = nd.contrib.count_sketch(nd.array(x), nd.array(h), nd.array(s),
+                                out_dim=out_dim).asnumpy()
+    ref = np.zeros((n, out_dim), "f4")
+    for i in range(d):
+        ref[:, int(h[i])] += s[i] * x[:, i]
+    np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_khatri_rao():
+    A = np.array([[1., -1.], [2., -3.]], "f4")
+    B = np.array([[1., 4.], [2., 5.], [3., 6.]], "f4")
+    y = nd.khatri_rao(nd.array(A), nd.array(B)).asnumpy()
+    # the reference docstring's worked example
+    ref = np.array([[1, -4], [2, -5], [3, -6],
+                    [2, -12], [4, -15], [6, -18]], "f4")
+    np.testing.assert_allclose(y, ref)
+
+
+def test_histogram():
+    rng = np.random.RandomState(2)
+    x = rng.uniform(0, 10, 50).astype("f4")
+    cnt, edges = nd.histogram(nd.array(x), bin_cnt=5, range=(0, 10))
+    ref_cnt, ref_edges = np.histogram(x, bins=5, range=(0, 10))
+    np.testing.assert_allclose(cnt.asnumpy(), ref_cnt)
+    np.testing.assert_allclose(edges.asnumpy(), ref_edges, rtol=1e-6)
+    bins = np.array([0.0, 2.5, 5.0, 10.0], "f4")
+    cnt2, edges2 = nd.histogram(nd.array(x), nd.array(bins))
+    ref2, _ = np.histogram(x, bins=bins)
+    np.testing.assert_allclose(cnt2.asnumpy(), ref2)
+
+
+def test_ravel_unravel():
+    shape = (3, 4, 5)
+    rng = np.random.RandomState(3)
+    flat = rng.randint(0, 60, 7).astype("f4")
+    multi = nd.unravel_index(nd.array(flat), shape=shape).asnumpy()
+    ref = np.stack(np.unravel_index(flat.astype("i8"), shape), 0)
+    np.testing.assert_allclose(multi, ref)
+    back = nd.ravel_multi_index(nd.array(multi), shape=shape).asnumpy()
+    np.testing.assert_allclose(back, flat)
+
+
+def test_square_sum_and_sparse_retain_and_cast_storage():
+    rng = np.random.RandomState(4)
+    x = rng.randn(4, 5).astype("f4")
+    from incubator_mxnet_tpu.ndarray.ndarray import invoke
+    from incubator_mxnet_tpu.ops import registry
+    y = invoke(registry.get("_square_sum"), [nd.array(x)],
+               {"axis": 1, "keepdims": True}).asnumpy()
+    np.testing.assert_allclose(y, (x * x).sum(1, keepdims=True), rtol=1e-5)
+    idx = np.array([0, 2], "f4")
+    r = nd.sparse_retain(nd.array(x), nd.array(idx)).asnumpy()
+    ref = np.zeros_like(x)
+    ref[[0, 2]] = x[[0, 2]]
+    np.testing.assert_allclose(r, ref)
+    c = nd.cast_storage(nd.array(x), stype="default").asnumpy()
+    np.testing.assert_allclose(c, x)
+
+
+def test_sync_batch_norm_matches_batch_norm():
+    rng = np.random.RandomState(5)
+    x = rng.randn(4, 3, 2, 2).astype("f4")
+    gamma = np.ones(3, "f4")
+    beta = np.zeros(3, "f4")
+    mean = np.zeros(3, "f4")
+    var = np.ones(3, "f4")
+    a = nd.contrib.SyncBatchNorm(
+        nd.array(x), nd.array(gamma), nd.array(beta), nd.array(mean),
+        nd.array(var), key="bn0").asnumpy()
+    b = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                     nd.array(mean), nd.array(var)).asnumpy()
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+def _np_bilinear(img, y, x):
+    """numpy bilinear sample with zero outside bounds; img (C,H,W)."""
+    C, H, W = img.shape
+    y0, x0 = int(np.floor(y)), int(np.floor(x))
+    out = np.zeros(C, img.dtype)
+    for dy in (0, 1):
+        for dx in (0, 1):
+            yi, xi = y0 + dy, x0 + dx
+            if 0 <= yi <= H - 1 and 0 <= xi <= W - 1:
+                w = (1 - abs(y - yi)) * (1 - abs(x - xi))
+                out += img[:, yi, xi] * w
+    return out
+
+
+def test_deformable_convolution_zero_offset_equals_conv():
+    """With zero offsets the op IS a standard convolution."""
+    rng = np.random.RandomState(6)
+    N, C, H, W, F, k = 2, 4, 6, 6, 3, 3
+    x = rng.randn(N, C, H, W).astype("f4")
+    w = rng.randn(F, C, k, k).astype("f4")
+    b = rng.randn(F).astype("f4")
+    Ho = Wo = H - k + 1
+    off = np.zeros((N, 2 * k * k, Ho, Wo), "f4")
+    y = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), nd.array(b),
+        kernel=(k, k), num_filter=F).asnumpy()
+    ref = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                         kernel=(k, k), num_filter=F).asnumpy()
+    np.testing.assert_allclose(y, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_deformable_convolution_offsets():
+    """Nonzero offsets: compare against a direct numpy sampling loop."""
+    rng = np.random.RandomState(7)
+    N, C, H, W, F, k = 1, 2, 5, 5, 2, 3
+    x = rng.randn(N, C, H, W).astype("f4")
+    w = rng.randn(F, C, k, k).astype("f4")
+    Ho = Wo = H - k + 1
+    off = (rng.rand(N, 2 * k * k, Ho, Wo).astype("f4") - 0.5) * 2
+    y = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), kernel=(k, k),
+        num_filter=F, no_bias=True).asnumpy()
+    ref = np.zeros((N, F, Ho, Wo), "f4")
+    for n in range(N):
+        for ho in range(Ho):
+            for wo in range(Wo):
+                acc = np.zeros((C, k * k), "f4")
+                for ki in range(k):
+                    for kj in range(k):
+                        kk = ki * k + kj
+                        py = ho + ki + off[n, 2 * kk, ho, wo]
+                        px = wo + kj + off[n, 2 * kk + 1, ho, wo]
+                        acc[:, kk] = _np_bilinear(x[n], py, px)
+                for f in range(F):
+                    ref[n, f, ho, wo] = (acc * w[f].reshape(C, k * k)).sum()
+    np.testing.assert_allclose(y, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_deformable_psroi_pooling_no_trans():
+    """no_trans + group_size=1 + sample_per_part=1: check one bin against
+    a direct numpy sample."""
+    rng = np.random.RandomState(8)
+    od, ps = 2, 2
+    C = od * 1 * 1   # output_dim * group_size^2
+    x = rng.randn(1, C, 8, 8).astype("f4")
+    rois = np.array([[0, 0, 0, 7, 7]], "f4")
+    out, cnt = nd.contrib.DeformablePSROIPooling(
+        nd.array(x), nd.array(rois), spatial_scale=1.0, output_dim=od,
+        group_size=1, pooled_size=ps, no_trans=True, sample_per_part=1)
+    out = out.asnumpy()
+    cnt = cnt.asnumpy()
+    assert out.shape == (1, od, ps, ps)
+    assert (cnt > 0).all()
+    # bin (0,0): roi [start=-0.5, end=7.5), bin_h=4, one sample at center
+    start = -0.5
+    bin_sz = 8.0 / ps
+    for ctop in range(od):
+        for ph in range(ps):
+            for pw in range(ps):
+                sy = start + ph * bin_sz + 0.5 * bin_sz
+                sx = start + pw * bin_sz + 0.5 * bin_sz
+                want = _np_bilinear(x[0, ctop:ctop + 1], sy, sx)[0]
+                np.testing.assert_allclose(out[0, ctop, ph, pw], want,
+                                           rtol=1e-4, atol=1e-4,
+                                           err_msg=f"{ctop},{ph},{pw}")
+
+
+def test_deformable_ops_in_symbol_and_grad():
+    """Symbolic composition + gradient flow through the deformable conv."""
+    data = mx.sym.Variable("data")
+    off = mx.sym.Variable("off")
+    out = mx.sym.contrib.DeformableConvolution(
+        data, off, kernel=(3, 3), num_filter=2, no_bias=True,
+        name="dconv")
+    loss = mx.sym.sum(out)
+    rng = np.random.RandomState(9)
+    args = {"data": mx.nd.array(rng.randn(1, 2, 5, 5).astype("f4")),
+            "off": mx.nd.array(np.zeros((1, 18, 3, 3), "f4")),
+            "dconv_weight": mx.nd.array(rng.randn(2, 2, 3, 3).astype("f4"))}
+    ex = loss.bind(mx.cpu(), args,
+                   args_grad={k: mx.nd.zeros(v.shape)
+                              for k, v in args.items()})
+    ex.forward(is_train=True)
+    ex.backward([mx.nd.ones(())])
+    for k in args:
+        assert np.isfinite(ex.grad_dict[k].asnumpy()).all(), k
+    assert float(np.abs(ex.grad_dict["off"].asnumpy()).sum()) >= 0
+
+
+def test_libsvm_iter(tmp_path):
+    """LibSVMIter (reference src/io/iter_libsvm.cc:200): CSR data batches,
+    dense labels, round_batch wrap."""
+    p = tmp_path / "train.libsvm"
+    p.write_text(
+        "1 0:1.5 3:2.0\n"
+        "0 1:1.0\n"
+        "2 2:3.0 4:4.0\n"
+        "1 0:0.5\n"
+        "0 3:1.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(p), data_shape=(5,),
+                          batch_size=2)
+    batches = list(it)
+    assert len(batches) == 3
+    b0 = batches[0]
+    assert b0.data[0].stype == "csr" if hasattr(b0.data[0], "stype") else True
+    np.testing.assert_allclose(
+        b0.data[0].asnumpy(),
+        [[1.5, 0, 0, 2.0, 0], [0, 1.0, 0, 0, 0]])
+    np.testing.assert_allclose(b0.label[0].asnumpy(), [1, 0])
+    # round_batch tail: 5 rows, batch 2 -> last batch pad=1, wraps row 0
+    b2 = batches[2]
+    assert b2.pad == 1
+    np.testing.assert_allclose(
+        b2.data[0].asnumpy(),
+        [[0, 0, 0, 1.0, 0], [1.5, 0, 0, 2.0, 0]])
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_libsvm_iter_csr_labels_and_multilabel(tmp_path):
+    """CSR labels from a separate label file pad on wrapped tails like the
+    data; inline multi-labels fill label_shape."""
+    d = tmp_path / "d.libsvm"
+    d.write_text("0 0:1.0\n0 1:2.0\n0 2:3.0\n")
+    lab = tmp_path / "l.libsvm"
+    lab.write_text("0 0:1\n0 1:1\n0 0:1\n")
+    it = mx.io.LibSVMIter(data_libsvm=str(d), data_shape=(4,),
+                          label_libsvm=str(lab), label_shape=(2,),
+                          batch_size=2)
+    batches = list(it)
+    assert len(batches) == 2
+    b1 = batches[1]
+    assert b1.pad == 1
+    # data and label row counts agree on the wrapped batch
+    assert b1.data[0].shape[0] == 2
+    assert b1.label[0].shape[0] == 2
+    np.testing.assert_allclose(b1.label[0].asnumpy(),
+                               [[1, 0], [1, 0]])
+    # inline multi-label fills label_shape
+    m = tmp_path / "m.libsvm"
+    m.write_text("1 2 0:1.0\n3 4 1:1.0\n")
+    it2 = mx.io.LibSVMIter(data_libsvm=str(m), data_shape=(4,),
+                           label_shape=(2,), batch_size=2)
+    b = next(iter(it2))
+    np.testing.assert_allclose(b.label[0].asnumpy(), [[1, 2], [3, 4]])

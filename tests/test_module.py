@@ -146,3 +146,36 @@ def test_bucketing_module():
         mod.forward_backward(batch)
         mod.update()
     assert set(mod._buckets) == {4, 8, 12}
+
+
+def test_python_loss_module():
+    """PythonLossModule (reference module/python_module.py): forward keeps
+    scores, backward calls grad_func; chains after a symbol Module via
+    SequentialModule-style manual wiring."""
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+
+    def grad_func(scores, labels):
+        # d/ds of 0.5*(s - onehot)^2 = s - onehot
+        s = scores.asnumpy()
+        lab = labels.asnumpy().astype(int)
+        one = np.zeros_like(s)
+        one[np.arange(len(lab)), lab] = 1.0
+        return s - one
+
+    m = mx.mod.PythonLossModule(grad_func=grad_func)
+    m.bind(data_shapes=[mx.io.DataDesc("data", (4, 3))],
+           label_shapes=[mx.io.DataDesc("softmax_label", (4,))])
+    m.init_params()
+    m.init_optimizer()
+    assert m.output_shapes == [("pyloss_output", (4, 3))]
+    rng = np.random.RandomState(0)
+    scores = mx.nd.array(rng.rand(4, 3).astype("f4"))
+    labels = mx.nd.array(np.array([0, 1, 2, 1], "f4"))
+    batch = mx.io.DataBatch(data=[scores], label=[labels])
+    m.forward(batch)
+    np.testing.assert_allclose(m.get_outputs()[0].asnumpy(),
+                               scores.asnumpy())
+    m.backward()
+    g = m.get_input_grads()[0].asnumpy()
+    np.testing.assert_allclose(g, grad_func(scores, labels), rtol=1e-6)
