@@ -109,6 +109,10 @@ KNOBS = {
     "MXNET_FUSED_BACKWARD": (_BOOL, True, "honored",
                              "eager loss.backward() as ONE jitted tape "
                              "replay per structure (autograd.py)"),
+    "MXNET_KVSTORE_BIGARRAY_BOUND": (int, 1000000, "honored",
+                                     "arrays with more elements flat-split "
+                                     "into one range per server "
+                                     "(dist kvstore key-range sharding)"),
     "MXNET_KVSTORE_COLLECTIVE": (_BOOL, True, "honored",
                                  "dist_sync gradients ride XLA collectives "
                                  "instead of the socket server"),

@@ -34,7 +34,21 @@ class KVStoreDist(KVStoreTPU):
              "rank": int(env_rank) if env_rank is not None else None})
         self._rank = reply["rank"]
         self._num_workers = reply["num_workers"]
-        self._push_count = {}    # key -> completed sync pushes by this worker
+        # key-range sharding over N servers (reference kvstore_dist.h:44 +
+        # docs/faq/distributed_training.md:50-53): whole small keys land
+        # on one server by stable hash; arrays over
+        # MXNET_KVSTORE_BIGARRAY_BOUND flat-split into one contiguous
+        # range per server, each stored under the TRUE key (every server
+        # only ever holds its own slice, exactly ps-lite's value ranges)
+        self._num_servers = int(reply.get("num_servers", 1))
+        self._chans = [self._chan]
+        if self._num_servers > 1:
+            srv = _check(self._chan.request({"cmd": "server_list"}))
+            self._chans += [Channel(h, p) for h, p in srv["servers"]]
+        from .. import config as _config
+        self._bigarray_bound = int(_config.get(
+            "MXNET_KVSTORE_BIGARRAY_BOUND"))
+        self._push_count = {}    # (srv, key) -> completed sync pushes
         self._update_on_kvstore = False
         # collective data plane: gradients all-reduce over the global device
         # mesh (ICI/DCN via XLA collectives — the reference's NCCL/ps-lite
@@ -71,16 +85,34 @@ class KVStoreDist(KVStoreTPU):
         return self._num_workers
 
     # -- data plane ----------------------------------------------------------
+    def _shards(self, sk, size):
+        """Route a key's flat value by ELEMENT COUNT: [(server_idx,
+        slice)] — one slice on one hashed server for small keys, one
+        contiguous range per server above the bigarray bound."""
+        n = len(self._chans)
+        if n == 1 or size <= self._bigarray_bound:
+            if str(sk).isdigit():
+                srv = int(sk) % n
+            else:
+                import zlib
+                srv = zlib.crc32(str(sk).encode()) % n
+            return [(srv, slice(0, size))]
+        bounds = [size * i // n for i in range(n + 1)]
+        return [(i, slice(bounds[i], bounds[i + 1])) for i in range(n)]
+
     def init(self, key, value):
-        """Rank 0 ships initial weights to the server; everyone barriers so
-        no worker pulls before the key exists (reference `kvstore_dist.h`
-        InitImpl pushes only on worker 0, then Barrier)."""
+        """Rank 0 ships initial weights to the owning server(s); everyone
+        barriers so no worker pulls before the key exists (reference
+        `kvstore_dist.h` InitImpl pushes only on worker 0, then Barrier)."""
         keys, values = _normalize(key, value)
         if self._rank == 0:
-            reply = self._chan.request(
-                {"cmd": "init", "keys": [_key(k) for k in keys],
-                 "values": [v.asnumpy() for v in values]})
-            _check(reply)
+            for k, v in zip(keys, values):
+                sk = _key(k)
+                flat = v.asnumpy().reshape(-1)
+                for srv, sl in self._shards(sk, flat.size):
+                    _check(self._chans[srv].request(
+                        {"cmd": "init", "keys": [sk],
+                         "values": [flat[sl]]}))
         self._barrier()
         # keep a local copy so pull() can place results on local devices
         for k, v in zip(keys, values):
@@ -192,27 +224,31 @@ class KVStoreDist(KVStoreTPU):
                 self._store[sk] = s_nd
 
     def _socket_push(self, keys, values):
+        from .compression import pack_2bit
         for k, vals in zip(keys, values):
             sk = _key(k)
             if sk not in self._store:
                 raise MXNetError(f"Key {k} has not been initialized")
             merged = self._reduce(vals)      # one collective over local chips
             if self._compression is not None:
-                # quantize device-side (error feedback stays on device),
-                # then pack 4 codes/byte for the wire — 16x fewer bytes
-                # than fp32 (reference gradient_compression.h packing)
-                from .compression import pack_2bit
+                # quantize device-side (error feedback stays on device);
+                # each shard packs 4 codes/byte for its wire — 16x fewer
+                # bytes than fp32 (reference gradient_compression.h)
                 merged = self._compress(sk, merged)
-                wire_value = pack_2bit(merged.asnumpy(),
-                                       self._compression["threshold"])
-            else:
-                wire_value = merged.asnumpy()
-            reply = self._chan.request(
-                {"cmd": "push", "key": sk, "value": wire_value,
-                 "sync": self._sync, "rank": self._rank})
-            _check(reply)
-            if self._sync:
-                self._push_count[sk] = self._push_count.get(sk, 0) + 1
+            flat = merged.asnumpy().reshape(-1)
+            for srv, sl in self._shards(sk, flat.size):
+                part = flat[sl]
+                if self._compression is not None:
+                    wire_value = pack_2bit(part,
+                                           self._compression["threshold"])
+                else:
+                    wire_value = part
+                _check(self._chans[srv].request(
+                    {"cmd": "push", "key": sk, "value": wire_value,
+                     "sync": self._sync, "rank": self._rank}))
+                if self._sync:
+                    ck = (srv, sk)
+                    self._push_count[ck] = self._push_count.get(ck, 0) + 1
             self._record_key_mesh(sk, vals)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
@@ -225,18 +261,32 @@ class KVStoreDist(KVStoreTPU):
             for k, tgt_list in zip(keys, outs):
                 super().pull(k, out=tgt_list)
             return
+        import numpy as _np
         for k, tgt_list in zip(keys, outs):
             sk = _key(k)
-            reply = self._chan.request(
-                {"cmd": "pull", "key": sk,
-                 "min_version": self._push_count.get(sk, 0)})
-            _check(reply)
             src = self._store.get(sk)
-            if src is None or src.shape != reply["value"].shape:
+            if src is None:
+                # without the local shape the shard routing cannot be
+                # reconstructed — and init() populates the local copy on
+                # EVERY worker, so this is a protocol violation, not a
+                # recoverable state
+                raise MXNetError(
+                    f"pull({k}): key was never initialized on this worker")
+            shape = src.shape
+            size = int(_np.prod(shape)) if shape else 1
+            parts = []
+            for srv, sl in self._shards(sk, size):
+                reply = _check(self._chans[srv].request(
+                    {"cmd": "pull", "key": sk,
+                     "min_version": self._push_count.get((srv, sk), 0)}))
+                parts.append(_np.asarray(reply["value"]).reshape(-1))
+            value = _np.concatenate(parts) if len(parts) > 1 else parts[0]
+            value = value.reshape(shape)
+            if src.shape != value.shape:
                 from ..ndarray.ndarray import array
-                self._store[sk] = array(reply["value"], ctx=self._store_ctx)
+                self._store[sk] = array(value, ctx=self._store_ctx)
             else:
-                src._set_data(src._data * 0 + reply["value"].astype(src.dtype))
+                src._set_data(src._data * 0 + value.astype(src.dtype))
             # local fan-out reuses the single-collective broadcast engine
             super().pull(k, out=tgt_list)
 
@@ -258,20 +308,25 @@ class KVStoreDist(KVStoreTPU):
             self._barrier()
             return
         if self._rank == 0:
-            reply = self._chan.request(
-                {"cmd": "set_optimizer",
-                 "optimizer": pickle.dumps(optimizer)})
-            _check(reply)
+            blob = pickle.dumps(optimizer)
+            for chan in self._chans:
+                _check(chan.request({"cmd": "set_optimizer",
+                                     "optimizer": blob}))
         self._barrier()
 
     def _barrier(self):
         _check(self._chan.request({"cmd": "barrier"}))
 
     def close(self):
-        try:
-            self._chan.request({"cmd": "stop"})
-        finally:
-            self._chan.close()
+        for chan in getattr(self, "_chans", [self._chan]):
+            try:
+                chan.request({"cmd": "stop"})
+            except Exception:
+                pass
+            try:
+                chan.close()
+            except Exception:
+                pass
 
     def __del__(self):
         try:

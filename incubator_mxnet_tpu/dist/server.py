@@ -1,11 +1,14 @@
 """Parameter-server process.
 
 The role of `src/kvstore/kvstore_dist_server.h:155-559` (KVStoreDistServer):
-holds the authoritative copy of every key, merges sync pushes from all
-workers, runs the optimizer server-side when one has been shipped over
-(`DataHandleDefault`, the `MXNET_KVSTORE_BIGARRAY_BOUND` sharding of the
-reference is unnecessary — one server suffices for control-plane traffic
-because gradient all-reduce rides the TPU ICI mesh, not this socket path).
+holds the authoritative copy of its key (ranges), merges sync pushes from
+all workers, runs the optimizer server-side when one has been shipped over
+(`DataHandleDefault`).  Multiple servers range-shard the key space like the
+reference (`kvstore_dist.h:44` + `MXNET_KVSTORE_BIGARRAY_BOUND`): the root
+server doubles as the scheduler (secondary servers register their address
+here, workers fetch the list), each key slice travels under its TRUE key —
+a server only ever owns its own range.  In collective mode the servers
+carry control traffic only; gradients ride the TPU ICI mesh.
 
 Sync semantics (`dist_sync`): each key carries a version counter equal to
 the number of completed aggregation rounds.  A push contributes to the
@@ -30,8 +33,9 @@ from .transport import recv_msg, send_msg
 
 
 class _State:
-    def __init__(self, num_workers):
+    def __init__(self, num_workers, num_servers=1):
         self.num_workers = num_workers
+        self.num_servers = num_servers
         self.cond = threading.Condition()
         self.store = {}          # key -> np.ndarray
         self.version = {}        # key -> completed rounds
@@ -45,15 +49,19 @@ class _State:
         self.barrier_gen = 0
         self.next_rank = 0
         self.stopped = 0
+        self.servers = {}        # server_id (>=1) -> (host, port); root = 0
 
 
 class ParameterServer:
     """Threaded TCP parameter server; one handler thread per worker."""
 
-    def __init__(self, host="127.0.0.1", port=0, num_workers=None):
+    def __init__(self, host="127.0.0.1", port=0, num_workers=None,
+                 num_servers=None):
         self.num_workers = int(num_workers if num_workers is not None
                                else os.environ.get("DMLC_NUM_WORKER", 1))
-        self._state = _State(self.num_workers)
+        self.num_servers = int(num_servers if num_servers is not None
+                               else os.environ.get("DMLC_NUM_SERVER", 1))
+        self._state = _State(self.num_workers, self.num_servers)
         state = self._state
         outer = self
 
@@ -95,7 +103,8 @@ class ParameterServer:
         return self
 
     def serve_forever(self):
-        self.start()
+        if self._thread is None:
+            self.start()
         st = self._state
         with st.cond:
             st.cond.wait_for(lambda: st.stopped >= st.num_workers)
@@ -115,7 +124,30 @@ class ParameterServer:
                 if rank is None:
                     rank = st.next_rank
                 st.next_rank = max(st.next_rank, rank + 1)
-            return {"rank": rank, "num_workers": st.num_workers}
+            return {"rank": rank, "num_workers": st.num_workers,
+                    "num_servers": st.num_servers}
+
+        if cmd == "register_server":
+            # a secondary server announces its address; the root doubles
+            # as the reference's scheduler (ps-lite van) for this exchange
+            with st.cond:
+                st.servers[int(msg["server_id"])] = (msg["host"],
+                                                     int(msg["port"]))
+                st.cond.notify_all()
+            return {"ok": True}
+
+        if cmd == "server_list":
+            with st.cond:
+                ok = st.cond.wait_for(
+                    lambda: len(st.servers) >= st.num_servers - 1,
+                    timeout=300)
+                if not ok:
+                    return {"error": "timed out waiting for "
+                                     f"{st.num_servers - 1} secondary "
+                                     "servers to register"}
+                return {"servers": [list(st.servers[i])
+                                    for i in range(1, st.num_servers)],
+                        "num_servers": st.num_servers}
 
         if cmd == "init":
             with st.cond:
@@ -226,15 +258,40 @@ class ParameterServer:
         st.store[k] = weight.asnumpy()
 
 
+def register_with_root(root_host, root_port, server_id, host, port):
+    """Announce a secondary server's address to the root/scheduler."""
+    from .transport import Channel
+    chan = Channel(root_host, root_port)
+    try:
+        reply = chan.request({"cmd": "register_server",
+                              "server_id": int(server_id),
+                              "host": host, "port": int(port)})
+        if "error" in reply:
+            raise RuntimeError(reply["error"])
+    finally:
+        chan.close()
+
+
 def main():
     import jax
     try:
         jax.config.update("jax_platforms", "cpu")  # servers never touch chips
     except Exception:
         pass
-    port = int(os.environ.get("DMLC_PS_ROOT_PORT", 9091))
-    server = ParameterServer(
-        host=os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"), port=port)
+    server_id = int(os.environ.get("DMLC_SERVER_ID", 0))
+    root_host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+    root_port = int(os.environ.get("DMLC_PS_ROOT_PORT", 9091))
+    if server_id == 0:
+        server = ParameterServer(host=root_host, port=root_port)
+    else:
+        # secondary key-range server: bind any port, tell the root
+        server = ParameterServer(
+            host=os.environ.get("DMLC_SERVER_HOST", "127.0.0.1"),
+            port=int(os.environ.get("DMLC_SERVER_PORT", 0)))
+        server.start()
+        register_with_root(root_host, root_port, server_id,
+                           os.environ.get("DMLC_SERVER_HOST", "127.0.0.1"),
+                           server.port)
     server.serve_forever()
 
 

@@ -70,6 +70,42 @@ class Executor:
                 lambda args, aux, key: fn(args, aux, key))
         return self._fwd_jit[is_train]
 
+    def _forward_res_jit(self):
+        """Training forward that ALSO returns the vjp residuals, so
+        `backward()` replays only the linearized backward pass — the
+        reference reuses forward activations the same way
+        (`graph_executor.cc:63,76` Forward stashes, Backward consumes).
+        `jax.vjp`'s function is a `Partial` pytree whose leaves are the
+        residual arrays: a jit can return it, and `_vjp_apply_jit`
+        consumes it in a second program with no forward recompute."""
+        if getattr(self, "_fwd_res", None) is None:
+            fn = self._graph_fn(True)
+            wrt_idx = [i for i, n in enumerate(self._symbol.list_arguments())
+                       if self._grad_req.get(n, "null") != "null"]
+
+            def run(args, aux, key):
+                args = list(args)
+
+                def f(wrt_vals):
+                    for i, v in zip(wrt_idx, wrt_vals):
+                        args[i] = v
+                    outs, new_aux = fn(tuple(args), aux, key)
+                    return outs, new_aux
+
+                outs, vjp, new_aux = jax.vjp(
+                    f, tuple(args[i] for i in wrt_idx), has_aux=True)
+                return outs, new_aux, vjp
+
+            self._fwd_res = jax.jit(run)
+            self._bwd_wrt_idx = wrt_idx
+
+            def apply(vjp, cts):
+                (grads,) = vjp(cts)
+                return grads
+
+            self._vjp_apply_jit = jax.jit(apply)
+        return self._fwd_res
+
     def _backward_jit(self):
         if self._bwd_jit is None:
             fn = self._graph_fn(True)
@@ -133,10 +169,20 @@ class Executor:
         key = _random.next_key() if self._n_rng else jax.random.PRNGKey(0)
         self._last_key = key
         self._last_is_train = is_train
-        fwd = self._forward_jit(bool(is_train))
         args = self._gather_args(self.arg_arrays)
         aux = self._gather_args(self.aux_arrays)
-        outs, new_aux = fwd(args, aux, key)
+        self._exec_count = getattr(self, "_exec_count", 0) + 1
+        trains = bool(is_train) and any(
+            r != "null" for r in self._grad_req.values())
+        if trains:
+            # stash the vjp residuals: backward() replays ONLY the
+            # linearized backward pass (no second forward)
+            fwd = self._forward_res_jit()
+            outs, new_aux, self._stashed_vjp = fwd(args, aux, key)
+        else:
+            self._stashed_vjp = None
+            fwd = self._forward_jit(bool(is_train))
+            outs, new_aux = fwd(args, aux, key)
         if is_train:
             for a, v in zip(self.aux_arrays, new_aux):
                 a._data = v
@@ -147,12 +193,12 @@ class Executor:
         return self.outputs
 
     def backward(self, out_grads=None, is_train=True):
-        """Run backward (reference `graph_executor.cc:76 Backward`): executes
-        the combined forward+vjp XLA program with the stashed rng key."""
-        run = self._backward_jit()
-        args = self._gather_args(self.arg_arrays)
-        aux = self._gather_args(self.aux_arrays)
-        key = self._last_key if self._last_key is not None else jax.random.PRNGKey(0)
+        """Run backward (reference `graph_executor.cc:76 Backward`).  When
+        `forward(is_train=True)` stashed vjp residuals, ONLY the
+        linearized backward program runs (the reference reuses forward
+        activations identically); without a prior training forward it
+        falls back to the combined forward+vjp program with the stashed
+        rng key."""
         n_out = len(self._symbol._entries)
         if out_grads is None:
             ogs = tuple([None] * n_out)
@@ -161,15 +207,29 @@ class Executor:
         else:
             ogs = tuple(g._data if isinstance(g, NDArray) else g
                         for g in out_grads)
-        # jit requires concrete cotangents: materialize ones for None entries
-        outs_shapes = None
-        if any(g is None for g in ogs):
-            # run cheap eval_shape once per signature to get output shapes
-            fwd = self._forward_jit(True)
-            outs, _ = jax.eval_shape(fwd, args, aux, key)
-            ogs = tuple(jnp.ones(o.shape, o.dtype) if g is None else g
-                        for g, o in zip(ogs, outs))
-        outs, grads, new_aux = run(args, aux, key, ogs)
+        stashed = getattr(self, "_stashed_vjp", None)
+        if stashed is not None:
+            # cotangent defaults come from the LIVE outputs (no eval_shape
+            # re-trace needed)
+            ogs = tuple(
+                jnp.ones(o._data.shape, o._data.dtype) if g is None else g
+                for g, o in zip(ogs, self.outputs))
+            self._exec_count = getattr(self, "_exec_count", 0) + 1
+            grads = self._vjp_apply_jit(stashed, ogs)
+        else:
+            run = self._backward_jit()
+            args = self._gather_args(self.arg_arrays)
+            aux = self._gather_args(self.aux_arrays)
+            key = self._last_key if self._last_key is not None \
+                else jax.random.PRNGKey(0)
+            if any(g is None for g in ogs):
+                # cheap eval_shape once per signature for output shapes
+                fwd = self._forward_jit(True)
+                outs, _ = jax.eval_shape(fwd, args, aux, key)
+                ogs = tuple(jnp.ones(o.shape, o.dtype) if g is None else g
+                            for g, o in zip(ogs, outs))
+            self._exec_count = getattr(self, "_exec_count", 0) + 1
+            outs, grads, new_aux = run(args, aux, key, ogs)
         arg_names = self._symbol.list_arguments()
         for i, g in zip(self._bwd_wrt_idx, grads):
             tgt = self.grad_arrays[i]
@@ -189,6 +249,10 @@ class Executor:
         from . import random as _random
         key = _random.next_key() if self._n_rng else jax.random.PRNGKey(0)
         self._last_key = key
+        # a residual stash from an earlier forward(is_train=True) is now
+        # stale; a later bare backward() must fall back to the combined
+        # program, not linearize at the OLD inputs
+        self._stashed_vjp = None
         run = self._backward_jit()
         args = self._gather_args(self.arg_arrays)
         aux = self._gather_args(self.aux_arrays)

@@ -360,3 +360,93 @@ def test_dist_collective_compression_halves_payload(tmp_path):
     server.shutdown()
     for r, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {r} failed:\n{out}"
+
+
+SHARDED_WORKER = r"""
+import os, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+kv = mx.kv.create("dist_sync")
+rank, nw = kv.rank, kv.num_workers
+assert kv._num_servers == 2, kv._num_servers
+assert len(kv._chans) == 2
+
+# small key: lands whole on ONE hashed server
+kv.init("tiny", nd.zeros((3,)))
+kv.push("tiny", nd.ones((3,)) * (rank + 1))
+out = nd.zeros((3,))
+kv.pull("tiny", out=out)
+tot = sum(r + 1 for r in range(nw))
+np.testing.assert_allclose(out.asnumpy(), tot)
+
+# big key: over MXNET_KVSTORE_BIGARRAY_BOUND -> flat-split, one
+# contiguous range per server, reassembled on pull
+big = np.arange(40, dtype="f4").reshape(5, 8)
+kv.init("big", nd.array(big * 0))
+kv.push("big", nd.array(big * (rank + 1)))
+bout = nd.zeros((5, 8))
+kv.pull("big", out=bout)
+np.testing.assert_allclose(bout.asnumpy(), big * tot)
+
+# server-side optimizer applies per range: weight = w0 - lr*mean over rounds
+kv.init("w", nd.ones((30,)))
+kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0 / nw))
+for step in range(2):
+    kv.push("w", nd.ones((30,)) * (rank + 1))
+    w = nd.zeros((30,))
+    kv.pull("w", out=w)
+    gm = tot / nw
+    np.testing.assert_allclose(w.asnumpy(), 1.0 - 0.1 * gm * (step + 1),
+                               rtol=1e-5)
+
+kv._barrier()
+kv.close()
+print("worker %d OK" % rank)
+"""
+
+
+def test_dist_sync_sharded_servers(tmp_path):
+    """Key-range sharding over TWO parameter servers (reference
+    kvstore_dist.h:44 + MXNET_KVSTORE_BIGARRAY_BOUND splitting,
+    docs/faq/distributed_training.md:50-53): big arrays flat-split one
+    range per server; small keys hash to one; server-side optimizer runs
+    per range."""
+    from incubator_mxnet_tpu.dist.server import (ParameterServer,
+                                                 register_with_root)
+
+    n_workers = 2
+    script = tmp_path / "worker.py"
+    script.write_text(SHARDED_WORKER)
+    root = ParameterServer(num_workers=n_workers, num_servers=2).start()
+    second = ParameterServer(num_workers=n_workers, num_servers=2,
+                             port=0).start()
+    register_with_root("127.0.0.1", root.port, 1, "127.0.0.1", second.port)
+    env = dict(os.environ,
+               DMLC_PS_ROOT_URI="127.0.0.1",
+               DMLC_PS_ROOT_PORT=str(root.port),
+               DMLC_NUM_WORKER=str(n_workers),
+               DMLC_NUM_SERVER="2",
+               DMLC_ROLE="worker",
+               MXNET_KVSTORE_COLLECTIVE="0",
+               MXNET_KVSTORE_BIGARRAY_BOUND="16",
+               JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    procs = [subprocess.Popen([sys.executable, str(script)],
+                              env=dict(env, DMLC_RANK=str(r)),
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT)
+             for r in range(n_workers)]
+    outs = [p.communicate(timeout=240)[0].decode() for p in procs]
+    root.shutdown()
+    second.shutdown()
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {r} failed:\n{out}"
+        assert f"worker {r} OK" in out
+    # both servers actually held key ranges of the big arrays
+    assert "big" in root._state.store and "big" in second._state.store
+    assert root._state.store["big"].size + \
+        second._state.store["big"].size == 40

@@ -187,3 +187,41 @@ def test_device_augment_mode_parity(tmp_path):
         np.zeros((4, 24, 24, 3), np.uint8))})
     y = ex.forward()[0]
     assert y.shape == (4, 3, 24, 24)
+
+
+def test_im2rec_and_rec2idx_tools(tmp_path):
+    """tools/im2rec.py builds .lst/.rec/.idx the ImageRecordIter consumes;
+    tools/rec2idx.py reproduces the index byte-for-byte (reference
+    tools/im2rec.py + rec2idx.py)."""
+    import subprocess
+    import sys
+    import cv2
+    root = tmp_path / "imgs"
+    for d in ("a", "b"):
+        (root / d).mkdir(parents=True)
+        rng = np.random.RandomState(0)
+        for i in range(3):
+            cv2.imwrite(str(root / d / f"{d}{i}.jpg"),
+                        (rng.rand(36, 36, 3) * 255).astype("uint8"))
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+    prefix = str(tmp_path / "ds")
+    subprocess.run([sys.executable, os.path.join(tools, "im2rec.py"),
+                    prefix, str(root), "--list", "--recursive"],
+                   check=True, capture_output=True)
+    subprocess.run([sys.executable, os.path.join(tools, "im2rec.py"),
+                    prefix, str(root), "--num-thread", "2"],
+                   check=True, capture_output=True)
+    rec, idx = prefix + ".rec", prefix + ".idx"
+    assert os.path.exists(rec) and os.path.exists(idx)
+    subprocess.run([sys.executable, os.path.join(tools, "rec2idx.py"),
+                    rec, prefix + "2.idx"], check=True,
+                   capture_output=True)
+    assert sorted(open(idx).read().splitlines()) == \
+        sorted(open(prefix + "2.idx").read().splitlines())
+    from incubator_mxnet_tpu.io import ImageRecordIter
+    it = ImageRecordIter(path_imgrec=rec, data_shape=(3, 32, 32),
+                         batch_size=3, preprocess_threads=1)
+    b = next(iter(it))
+    assert b.data[0].shape == (3, 3, 32, 32)
+    assert set(b.label[0].asnumpy().tolist()) <= {0.0, 1.0}

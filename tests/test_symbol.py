@@ -139,3 +139,36 @@ def test_scalar_ops_on_symbols():
     expr = (2 * a + 1) / (a - 0.5)
     ex = expr.bind(mx.cpu(), {"a": nd.array([1.5])})
     np.testing.assert_allclose(ex.forward()[0].asnumpy(), [4.0])
+
+
+def test_executor_backward_no_double_forward():
+    """forward(is_train=True) stashes vjp residuals; backward() runs ONLY
+    the linearized backward program (reference graph_executor.cc:63,76
+    reuses activations the same way) — one device execution per phase."""
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+
+    x = mx.sym.Variable("x")
+    w = mx.sym.Variable("w")
+    y = mx.sym.sum(mx.sym.broadcast_mul(mx.sym.square(x), w))
+    ex = y.bind(mx.cpu(),
+                {"x": mx.nd.array(np.array([1.0, 2.0, 3.0], "f4")),
+                 "w": mx.nd.array(np.array([2.0, 2.0, 2.0], "f4"))},
+                args_grad={"x": mx.nd.zeros(3), "w": mx.nd.zeros(3)})
+    ex._exec_count = 0
+    ex.forward(is_train=True)
+    assert ex._exec_count == 1, "forward must be one device execution"
+    ex.backward()
+    assert ex._exec_count == 2, \
+        "backward must NOT re-run the forward (one execution, not two)"
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(),
+                               [4.0, 8.0, 12.0])
+    np.testing.assert_allclose(ex.grad_dict["w"].asnumpy(),
+                               [1.0, 4.0, 9.0])
+    # the residuals are from forward TIME: mutating args between the
+    # passes must not change the gradients (reference activation reuse)
+    ex.forward(is_train=True)
+    ex.arg_dict["x"][:] = 100.0
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(),
+                               [4.0, 8.0, 12.0])

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Communication micro-benchmark (reference `tools/bandwidth/measure.py`:
+kvstore push/pull bandwidth over a model's weight shapes).
+
+Measures, on whatever mesh is available (the real chip, or the virtual
+8-device CPU mesh via `--cpu-mesh`):
+
+* raw `psum` all-reduce bus bandwidth across message sizes (the
+  collective data plane everything else rides), and
+* end-to-end kvstore push+pull rate over ResNet-50-like weight shapes
+  for each single-process kvstore type — the reference tool's number.
+
+Prints one JSON line.  Bus bandwidth uses the standard ring-all-reduce
+accounting: 2 * (n-1)/n * bytes / time.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def resnet50_shapes():
+    """Representative weight shapes (conv + fc) totalling ~25M params."""
+    shapes = [(64, 3, 7, 7), (1000, 2048), (1000,)]
+    for cin, cmid, cout, n in [(64, 64, 256, 3), (256, 128, 512, 4),
+                               (512, 256, 1024, 6), (1024, 512, 2048, 3)]:
+        for _ in range(n):
+            shapes += [(cmid, cin, 1, 1), (cmid, cmid, 3, 3),
+                       (cout, cmid, 1, 1)]
+            cin = cout
+    return shapes
+
+
+def measure_allreduce(sizes_mb, repeat=10):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = np.array(jax.devices())
+    n = len(devs)
+    mesh = Mesh(devs, ("x",))
+    out = {}
+    for mb in sizes_mb:
+        nelem = int(mb * 2 ** 20 // 4)
+        x = jax.device_put(
+            np.ones((n, nelem), np.float32),
+            NamedSharding(mesh, P("x")))
+
+        @jax.jit
+        def ar(v):
+            return jax.lax.with_sharding_constraint(
+                jnp.broadcast_to(v.sum(0, keepdims=True), v.shape),
+                NamedSharding(mesh, P("x")))
+
+        r = ar(x)
+        jax.block_until_ready(r)
+        t0 = time.perf_counter()
+        for _ in range(repeat):
+            r = ar(r)
+        jax.block_until_ready(r)
+        dt = (time.perf_counter() - t0) / repeat
+        bus = 2 * (n - 1) / n * (mb / 1024) / dt   # GB/s, ring accounting
+        out[f"allreduce_{mb}MB_GBps"] = round(bus, 2)
+    return out, n
+
+
+def measure_kvstore(kv_type, repeat=5):
+    import incubator_mxnet_tpu as mx
+
+    try:
+        kv = mx.kvstore.create(kv_type)
+    except Exception as e:
+        return {"error": repr(e)[:120]}
+    shapes = resnet50_shapes()
+    rng = np.random.RandomState(0)
+    vals = [mx.nd.array(rng.rand(*s).astype("f4")) for s in shapes]
+    keys = list(range(len(shapes)))
+    for k, v in zip(keys, vals):
+        kv.init(k, v)
+    outs = [mx.nd.zeros(s) for s in shapes]
+    total_mb = sum(v.size for v in vals) * 4 / 2 ** 20
+
+    def once():
+        kv.push(keys, vals)
+        kv.pull(keys, out=outs)
+        outs[-1].asnumpy()   # sync
+
+    once()
+    t0 = time.perf_counter()
+    for _ in range(repeat):
+        once()
+    dt = (time.perf_counter() - t0) / repeat
+    return {"total_MB": round(total_mb, 1),
+            "push_pull_GBps": round(total_mb / 1024 / dt, 3),
+            "push_pull_ms": round(dt * 1e3, 1)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu-mesh", type=int, default=0,
+                    help="force an N-device virtual CPU mesh (the dryrun "
+                         "configuration); 0 = whatever devices exist")
+    ap.add_argument("--sizes-mb", type=float, nargs="+",
+                    default=[1, 16, 64])
+    ap.add_argument("--kv-types", type=str, nargs="+",
+                    default=["local", "device"])
+    args = ap.parse_args()
+
+    if args.cpu_mesh:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") +
+            f" --xla_force_host_platform_device_count={args.cpu_mesh}")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    result = {"metric": "comm_bandwidth"}
+    ar, n = measure_allreduce(args.sizes_mb)
+    result["n_devices"] = n
+    result.update(ar)
+    for kvt in args.kv_types:
+        r = measure_kvstore(kvt)
+        result.update({f"kv_{kvt}_{k}": v for k, v in r.items()})
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

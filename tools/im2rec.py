@@ -1,0 +1,269 @@
+#!/usr/bin/env python
+"""Build .lst / .rec image databases (reference `tools/im2rec.py` +
+`tools/im2rec.cc`): list mode walks an image directory into a
+`index\\tlabel\\tpath` .lst file; pack mode encodes the listed images into
+an indexed RecordIO pair (.rec + .idx) the `ImageRecordIter` consumes.
+
+The byte format is the reference's exactly (recordio.pack_img headers),
+so .rec files interchange in both directions.  Threaded encode: cv2
+decode/encode releases the GIL, so --num-thread scales on multi-core
+hosts (the reference uses a process pool for the same reason).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import queue
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def list_image(root, recursive, exts):
+    """Yield (index, relpath, label) — label = folder index in recursive
+    mode (the reference's convention), 0 otherwise."""
+    i = 0
+    if recursive:
+        cat = {}
+        for path, dirs, files in sorted(os.walk(root, followlinks=True)):
+            dirs.sort()
+            files.sort()
+            for fname in files:
+                fpath = os.path.join(path, fname)
+                suffix = os.path.splitext(fname)[1].lower()
+                if os.path.isfile(fpath) and suffix in exts:
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    yield (i, os.path.relpath(fpath, root), cat[path])
+                    i += 1
+        for k, v in sorted(cat.items(), key=lambda kv: kv[1]):
+            print(os.path.relpath(k, root), v)
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            suffix = os.path.splitext(fname)[1].lower()
+            if os.path.isfile(fpath) and suffix in exts:
+                yield (i, fname, 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as fout:
+        for i, item in enumerate(image_list):
+            line = "%d\t" % item[0]
+            for j in item[2:]:
+                line += "%f\t" % j
+            line += "%s\n" % item[1]
+            fout.write(line)
+
+
+def make_list(args):
+    image_list = list(list_image(args.root, args.recursive, args.exts))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+    n = len(image_list)
+    chunk_size = (n + args.chunks - 1) // args.chunks
+    for i in range(args.chunks):
+        chunk = image_list[i * chunk_size:(i + 1) * chunk_size]
+        str_chunk = "_%d" % i if args.chunks > 1 else ""
+        sep = int(chunk_size * args.train_ratio)
+        sep_test = int(chunk_size * args.test_ratio)
+        if args.train_ratio == 1.0:
+            write_list(args.prefix + str_chunk + ".lst", chunk)
+        else:
+            if args.test_ratio:
+                write_list(args.prefix + str_chunk + "_test.lst",
+                           chunk[:sep_test])
+            if args.train_ratio + args.test_ratio < 1.0:
+                write_list(args.prefix + str_chunk + "_val.lst",
+                           chunk[sep_test + sep:])
+            write_list(args.prefix + str_chunk + "_train.lst",
+                       chunk[sep_test:sep_test + sep])
+
+
+def read_list(path_in):
+    with open(path_in) as fin:
+        while True:
+            line = fin.readline()
+            if not line:
+                break
+            line = [i.strip() for i in line.strip().split("\t")]
+            line_len = len(line)
+            if line_len < 3:
+                print("lst should have at least has three parts, but only "
+                      "has %s parts for %s" % (line_len, line))
+                continue
+            try:
+                item = [int(line[0])] + [line[-1]] + \
+                    [float(i) for i in line[1:-1]]
+            except Exception as e:
+                print("Parsing lst met error for %s, detail: %s"
+                      % (line, e))
+                continue
+            yield item
+
+
+def image_encode(args, i, item, q_out):
+    """Read + (resize/crop) + encode one image; enqueue the packed record."""
+    import cv2
+    from incubator_mxnet_tpu import recordio
+
+    fullpath = os.path.join(args.root, item[1])
+    if len(item) > 3 and args.pack_label:
+        header = recordio.IRHeader(0, item[2:], item[0], 0)
+    else:
+        header = recordio.IRHeader(0, item[2], item[0], 0)
+
+    if args.pass_through:
+        try:
+            with open(fullpath, "rb") as fin:
+                img = fin.read()
+            s = recordio.pack(header, img)
+            q_out.put((i, s, item))
+        except Exception as e:
+            print("pack_img error:", item[1], e)
+            q_out.put((i, None, item))
+        return
+
+    flag = {1: cv2.IMREAD_COLOR, 0: cv2.IMREAD_GRAYSCALE,
+            -1: cv2.IMREAD_UNCHANGED}[args.color]
+    img = cv2.imread(fullpath, flag)
+    if img is None:
+        print("imread read blank (None) image for file: %s" % fullpath)
+        q_out.put((i, None, item))
+        return
+    if args.center_crop:
+        if img.shape[0] > img.shape[1]:
+            margin = (img.shape[0] - img.shape[1]) // 2
+            img = img[margin:margin + img.shape[1], :]
+        else:
+            margin = (img.shape[1] - img.shape[0]) // 2
+            img = img[:, margin:margin + img.shape[0]]
+    if args.resize:
+        import cv2 as _cv2
+        if img.shape[0] > img.shape[1]:
+            newsize = (args.resize,
+                       img.shape[0] * args.resize // img.shape[1])
+        else:
+            newsize = (img.shape[1] * args.resize // img.shape[0],
+                       args.resize)
+        img = _cv2.resize(img, newsize)
+    try:
+        from incubator_mxnet_tpu import recordio as _rec
+        s = _rec.pack_img(header, img, quality=args.quality,
+                          img_fmt=args.encoding)
+        q_out.put((i, s, item))
+    except Exception as e:
+        print("pack_img error on file: %s" % fullpath, e)
+        q_out.put((i, None, item))
+
+
+def make_record(args, lst_path):
+    """Pack one .lst into .rec + .idx with a thread pool + in-order
+    writer (the reference's read_worker/write_worker shape)."""
+    from incubator_mxnet_tpu import recordio
+
+    items = list(read_list(lst_path))
+    fname = os.path.basename(lst_path)
+    base = os.path.splitext(fname)[0]
+    rec_path = os.path.join(args.working_dir or os.path.dirname(lst_path),
+                            base + ".rec")
+    idx_path = os.path.join(args.working_dir or os.path.dirname(lst_path),
+                            base + ".idx")
+    record = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+
+    q_out = queue.Queue(maxsize=args.num_thread * 8)
+    job_q = queue.Queue()
+    for i, item in enumerate(items):
+        job_q.put((i, item))
+
+    def worker():
+        while True:
+            try:
+                i, item = job_q.get_nowait()
+            except queue.Empty:
+                return
+            image_encode(args, i, item, q_out)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(max(args.num_thread, 1))]
+    for t in threads:
+        t.start()
+
+    tic = time.time()
+    buf = {}
+    count = 0
+    for _ in range(len(items)):
+        i, s, item = q_out.get()
+        buf[i] = (s, item)
+        while count in buf:
+            s2, item2 = buf.pop(count)
+            if s2 is not None:
+                record.write_idx(item2[0], s2)
+            if count % 1000 == 0 and count > 0:
+                print("time: %f count: %d" % (time.time() - tic, count))
+                tic = time.time()
+            count += 1
+    record.close()
+    print("wrote %d records to %s" % (count, rec_path))
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(
+        description="Create an image list or an indexed RecordIO database "
+                    "(reference tools/im2rec.py).")
+    parser.add_argument("prefix",
+                        help="prefix of input/output lst and rec files")
+    parser.add_argument("root", help="path to folder containing images")
+    cgroup = parser.add_argument_group("Options for creating image lists")
+    cgroup.add_argument("--list", action="store_true",
+                        help="make a list instead of a record")
+    cgroup.add_argument("--exts", nargs="+",
+                        default=[".jpeg", ".jpg", ".png"])
+    cgroup.add_argument("--chunks", type=int, default=1)
+    cgroup.add_argument("--train-ratio", type=float, default=1.0)
+    cgroup.add_argument("--test-ratio", type=float, default=0)
+    cgroup.add_argument("--recursive", action="store_true",
+                        help="label = folder index, walked recursively")
+    cgroup.add_argument("--no-shuffle", dest="shuffle",
+                        action="store_false")
+    rgroup = parser.add_argument_group("Options for creating database")
+    rgroup.add_argument("--pass-through", action="store_true",
+                        help="skip transcoding; pack raw file bytes")
+    rgroup.add_argument("--resize", type=int, default=0)
+    rgroup.add_argument("--center-crop", action="store_true")
+    rgroup.add_argument("--quality", type=int, default=95)
+    rgroup.add_argument("--num-thread", type=int, default=1)
+    rgroup.add_argument("--color", type=int, default=1, choices=[-1, 0, 1])
+    rgroup.add_argument("--encoding", type=str, default=".jpg",
+                        choices=[".jpg", ".png"])
+    rgroup.add_argument("--pack-label", action="store_true",
+                        help="pack multi-label from the lst")
+    rgroup.add_argument("--working-dir", type=str, default=None)
+    return parser.parse_args()
+
+
+def main():
+    args = parse_args()
+    if args.list:
+        make_list(args)
+        return
+    d = os.path.dirname(os.path.abspath(args.prefix))
+    files = [os.path.join(d, f) for f in os.listdir(d or ".")
+             if f.startswith(os.path.basename(args.prefix)) and
+             f.endswith(".lst")]
+    if not files:
+        print("no .lst files found with prefix %s; run --list first"
+              % args.prefix)
+        sys.exit(1)
+    for lst in sorted(files):
+        print("Creating .rec file from", lst)
+        make_record(args, lst)
+
+
+if __name__ == "__main__":
+    main()

@@ -35,7 +35,8 @@ def main(argv=None):
         description="Launch a distributed job (reference tools/launch.py)")
     parser.add_argument("-n", "--num-workers", type=int, required=True)
     parser.add_argument("-s", "--num-servers", type=int, default=1,
-                        help="only 1 supported (single-server control plane)")
+                        help="parameter servers; keys range-shard over "
+                             "them (MXNET_KVSTORE_BIGARRAY_BOUND)")
     parser.add_argument("--launcher", default="local",
                         choices=["local"],
                         help="cluster launchers: set the DMLC_* env with "
@@ -44,9 +45,6 @@ def main(argv=None):
     args = parser.parse_args(argv)
     if not args.command:
         parser.error("no command given")
-    if args.num_servers != 1:
-        print("warning: only 1 server is used; gradient traffic rides the "
-              "TPU mesh, the server is control-plane only", file=sys.stderr)
 
     port = _free_port()
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -56,11 +54,12 @@ def main(argv=None):
                     DMLC_PS_ROOT_URI="127.0.0.1",
                     DMLC_PS_ROOT_PORT=str(port),
                     DMLC_NUM_WORKER=str(args.num_workers),
-                    DMLC_NUM_SERVER="1")
+                    DMLC_NUM_SERVER=str(args.num_servers))
 
-    server = subprocess.Popen(
+    servers = [subprocess.Popen(
         [sys.executable, "-m", "incubator_mxnet_tpu.dist.server"],
-        env=dict(base_env, DMLC_ROLE="server"))
+        env=dict(base_env, DMLC_ROLE="server", DMLC_SERVER_ID=str(i)))
+        for i in range(args.num_servers)]
 
     workers = []
     for rank in range(args.num_workers):
@@ -71,12 +70,13 @@ def main(argv=None):
     rc = 0
     for w in workers:
         rc = w.wait() or rc
-    try:
-        # a clean run ends when every worker has sent its stop command; on
-        # worker failure the server never hears them all, so time out and kill
-        server.wait(timeout=15 if rc else 60)
-    except subprocess.TimeoutExpired:
-        server.terminate()
+    for server in servers:
+        try:
+            # a clean run ends when every worker has sent its stop command;
+            # on worker failure a server never hears them all — time out
+            server.wait(timeout=15 if rc else 60)
+        except subprocess.TimeoutExpired:
+            server.terminate()
     return rc
 
 

@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""Rebuild the .idx for an existing .rec (reference `tools/rec2idx.py`):
+scans the RecordIO framing and writes `key\\toffset` lines so
+`MXIndexedRecordIO` can random-access / shard the file."""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_index(rec_path, idx_path):
+    from incubator_mxnet_tpu import recordio
+
+    reader = recordio.MXRecordIO(rec_path, "r")
+    with open(idx_path, "w") as fidx:
+        counter = 0
+        while True:
+            pos = reader.tell()
+            item = reader.read()
+            if item is None:
+                break
+            try:
+                header, _ = recordio.unpack(item)
+                key = int(header.id)
+            except Exception:
+                key = counter
+            fidx.write("%d\t%d\n" % (key, pos))
+            counter += 1
+    reader.close()
+    return counter
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Generate the index file of an existing RecordIO file")
+    ap.add_argument("record", help="path to the .rec file")
+    ap.add_argument("index", nargs="?", default=None,
+                    help="output .idx path (default: .rec with .idx)")
+    args = ap.parse_args()
+    idx = args.index or os.path.splitext(args.record)[0] + ".idx"
+    n = build_index(args.record, idx)
+    print("wrote %d index entries to %s" % (n, idx))
+
+
+if __name__ == "__main__":
+    main()
