@@ -281,12 +281,13 @@ class KVStoreDist(KVStoreTPU):
                      "min_version": self._push_count.get((srv, sk), 0)}))
                 parts.append(_np.asarray(reply["value"]).reshape(-1))
             value = _np.concatenate(parts) if len(parts) > 1 else parts[0]
+            if value.size != size:
+                raise MXNetError(
+                    f"pull({k}): servers returned {value.size} elements, "
+                    f"local copy has {size} — worker/server shapes "
+                    "disagree (inconsistent init?)")
             value = value.reshape(shape)
-            if src.shape != value.shape:
-                from ..ndarray.ndarray import array
-                self._store[sk] = array(value, ctx=self._store_ctx)
-            else:
-                src._set_data(src._data * 0 + value.astype(src.dtype))
+            src._set_data(src._data * 0 + value.astype(src.dtype))
             # local fan-out reuses the single-collective broadcast engine
             super().pull(k, out=tgt_list)
 

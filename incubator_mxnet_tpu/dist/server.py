@@ -137,14 +137,16 @@ class ParameterServer:
             return {"ok": True}
 
         if cmd == "server_list":
+            want = set(range(1, st.num_servers))
             with st.cond:
                 ok = st.cond.wait_for(
-                    lambda: len(st.servers) >= st.num_servers - 1,
-                    timeout=300)
+                    lambda: want <= set(st.servers), timeout=300)
                 if not ok:
-                    return {"error": "timed out waiting for "
-                                     f"{st.num_servers - 1} secondary "
-                                     "servers to register"}
+                    missing = sorted(want - set(st.servers))
+                    return {"error": "timed out waiting for secondary "
+                                     f"server id(s) {missing} to register "
+                                     "(launch them with DMLC_SERVER_ID in "
+                                     f"1..{st.num_servers - 1})"}
                 return {"servers": [list(st.servers[i])
                                     for i in range(1, st.num_servers)],
                         "num_servers": st.num_servers}

@@ -216,6 +216,10 @@ class Executor:
                 for g, o in zip(ogs, self.outputs))
             self._exec_count = getattr(self, "_exec_count", 0) + 1
             grads = self._vjp_apply_jit(stashed, ogs)
+            # residuals pin the forward activations in device memory —
+            # release them now that they are consumed (a repeated bare
+            # backward() falls back to the combined program)
+            self._stashed_vjp = None
         else:
             run = self._backward_jit()
             args = self._gather_args(self.arg_arrays)

@@ -898,10 +898,18 @@ def _solve_param_shapes(node, env):
         setvar(1, (d[1], nf // g) + kernel)
         if not p.get("no_bias"):
             setvar(2, (nf,))
-    elif op_name in ("BatchNorm", "BatchNorm_v1"):
+    elif op_name in ("BatchNorm", "BatchNorm_v1", "_contrib_SyncBatchNorm"):
         c = d[int(p.get("axis", 1)) % len(d)]
         for i in range(1, 5):
             setvar(i, (c,))
+    elif op_name == "_contrib_DeformableConvolution":
+        # inputs: data, offset, weight[, bias]
+        nf = int(p["num_filter"])
+        g = int(p.get("num_group", 1))
+        kernel = tuple(p["kernel"])
+        setvar(2, (nf, d[1] // g) + kernel)
+        if not p.get("no_bias"):
+            setvar(3, (nf,))
     elif op_name == "LayerNorm":
         c = d[int(p.get("axis", -1)) % len(d)]
         setvar(1, (c,))

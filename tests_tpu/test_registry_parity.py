@@ -70,6 +70,26 @@ CASES = {
                       grad_req="null"),
     "BatchNorm": _case({"data": IMG}, grad_req="null",
                        use_global_stats=True, fix_gamma=False),
+    "_contrib_SyncBatchNorm": _case({"data": IMG}, grad_req="null",
+                                    use_global_stats=True, fix_gamma=False,
+                                    key="bn0"),
+    "cast_storage": _case({"data": V}, stype="default"),
+    # built by name in _run_case (structured inputs / subgraph attrs)
+    "khatri_rao": _case({"data": None}),
+    "_histogram": _case({"data": None}),
+    "_ravel_multi_index": _case({"data": None}),
+    "_unravel_index": _case({"data": None}),
+    "_contrib_count_sketch": _case({"data": None}),
+    "_foreach": _case({"data": None}),
+    "_while_loop": _case({"data": None}),
+    "_cond": _case({"data": None}),
+    "_contrib_DeformableConvolution": _case(
+        {"data": IMG, "offset": (2, 18, 6, 6)}, kernel=(3, 3),
+        num_filter=4, tol=5e-3),
+    "_contrib_DeformablePSROIPooling": _case(
+        {"data": (2, 8, 8, 8), "rois": (2, 5), "trans": (2, 2, 2, 2)},
+        grad_req="null", spatial_scale=1.0, output_dim=2, group_size=2,
+        pooled_size=2, part_size=2, sample_per_part=2, trans_std=0.1),
     "LayerNorm": _case({"data": (4, 6)}),
     "topk": _case({"data": (4, 6)}, grad_req="null", k=2),
     # scalar-op family: one representative shape, scalar=2.5
@@ -252,6 +272,76 @@ def _run_case(name):
     tol = (case or {}).get("tol") or 1e-3
     params = dict((case or {}).get("params", tweak.get("params", {})))
 
+    if name == "khatri_rao":
+        s = S.khatri_rao(S.Variable("a"), S.Variable("b"))
+        ctxs = [{"ctx": mx.cpu(), "a": (2, 3), "b": (4, 3)},
+                {"ctx": mx.tpu(), "a": (2, 3), "b": (4, 3)}]
+        check_consistency(s, ctxs, grad_req="write")
+        return
+    if name == "_histogram":
+        s = S.Group(list(S.histogram(S.Variable("data"), bin_cnt=5,
+                                     range=(-2, 2))))
+        ctxs = [{"ctx": mx.cpu(), "data": (40,)},
+                {"ctx": mx.tpu(), "data": (40,)}]
+        check_consistency(s, ctxs, grad_req="null")
+        return
+    if name in ("_ravel_multi_index", "_unravel_index"):
+        if name == "_unravel_index":
+            s = S.unravel_index(S.Variable("data"), shape=(3, 4))
+            idx = np.random.randint(0, 12, (6,)).astype("f4")
+            shapes = {"data": (6,)}
+        else:
+            s = S.ravel_multi_index(S.Variable("data"), shape=(3, 4))
+            idx = np.stack([np.random.randint(0, 3, 6),
+                            np.random.randint(0, 4, 6)]).astype("f4")
+            shapes = {"data": (2, 6)}
+        ctxs = [dict(shapes, ctx=mx.cpu()), dict(shapes, ctx=mx.tpu())]
+        check_consistency(s, ctxs, grad_req="null",
+                          arg_params={"data": idx})
+        return
+    if name == "_contrib_count_sketch":
+        s = S.contrib.count_sketch(S.Variable("data"), S.Variable("h"),
+                                   S.Variable("s"), out_dim=5)
+        h = np.random.randint(0, 5, (8,)).astype("f4")
+        sg = np.random.choice([-1.0, 1.0], 8).astype("f4")
+        shapes = {"data": (3, 8), "h": (8,), "s": (8,)}
+        ctxs = [dict(shapes, ctx=mx.cpu()), dict(shapes, ctx=mx.tpu())]
+        check_consistency(s, ctxs, grad_req="null",
+                          arg_params={"h": h, "s": sg})
+        return
+    if name == "_foreach":
+        w = S.Variable("w")
+        outs, st = S.contrib.foreach(
+            lambda x, st_: (S.broadcast_mul(x, w) + st_,
+                            S.broadcast_mul(x, w) + st_),
+            S.Variable("data"), S.Variable("init"))
+        s = S.Group([outs, st])
+        shapes = {"data": (5, 4), "init": (4,), "w": (4,)}
+        ctxs = [dict(shapes, ctx=mx.cpu()), dict(shapes, ctx=mx.tpu())]
+        check_consistency(s, ctxs, grad_req="write")
+        return
+    if name == "_while_loop":
+        outs, fin = S.contrib.while_loop(
+            cond=lambda i, acc: i < 4,
+            func=lambda i, acc: ([acc + i], [i + 1, acc + i]),
+            loop_vars=[S.Variable("i0"), S.Variable("acc0")],
+            max_iterations=6)
+        s = S.Group(list(outs) + list(fin))
+        shapes = {"i0": (1,), "acc0": (3,)}
+        ctxs = [dict(shapes, ctx=mx.cpu()), dict(shapes, ctx=mx.tpu())]
+        check_consistency(s, ctxs, grad_req="null",
+                          arg_params={"i0": np.zeros(1, "f4")})
+        return
+    if name == "_cond":
+        a = S.Variable("a")
+        b = S.Variable("b")
+        s = S.contrib.cond(S.sum(a) < 1.0,
+                           lambda: (a + 5) * (b + 5),
+                           lambda: (a - 5) * (b - 5))
+        shapes = {"a": (3,), "b": (3,)}
+        ctxs = [dict(shapes, ctx=mx.cpu()), dict(shapes, ctx=mx.tpu())]
+        check_consistency(s, ctxs, grad_req="null")
+        return
     if name == "Embedding":
         data = S.Variable("data")
         s = S.Embedding(data, input_dim=10, output_dim=4, name="emb")

@@ -187,7 +187,13 @@ def make_record(args, lst_path):
                 i, item = job_q.get_nowait()
             except queue.Empty:
                 return
-            image_encode(args, i, item, q_out)
+            try:
+                image_encode(args, i, item, q_out)
+            except Exception as e:
+                # the writer loop blocks on one sentinel per job: a dead
+                # worker without this enqueue would hang the tool forever
+                print("encode error on %s: %r" % (item[1], e))
+                q_out.put((i, None, item))
 
     threads = [threading.Thread(target=worker, daemon=True)
                for _ in range(max(args.num_thread, 1))]
