@@ -243,3 +243,35 @@ def test_fallback_block_keeps_per_batch_callbacks():
         assert seen == [(j, (j + 1) * 8) for j in range(8)], seen
     finally:
         os.environ.pop("MXNET_FUSED_STEP_BLOCK", None)
+
+
+def test_gluon_block_bf16_cast_net():
+    """A bf16-cast net's BN aux updates compute fp32 stats; the scan
+    carry must pin them back to the stored aux dtype (regression: this
+    broke lax.scan's carry-type invariance and silently dropped the
+    Estimator to the eager loop)."""
+    from incubator_mxnet_tpu import gluon
+
+    os.environ["MXNET_FUSED_STEP_BLOCK"] = "4"
+    try:
+        mx.random.seed(13)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(8, activation="relu"),
+                gluon.nn.BatchNorm(), gluon.nn.Dense(4))
+        net.initialize(mx.initializer.Xavier())
+        net.cast("bfloat16")
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9,
+                                 "multi_precision": True})
+        est = gluon.contrib.estimator.Estimator(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(),
+            train_metrics=[mx.metric.Accuracy()], trainer=trainer)
+        batches = [(mx.nd.array(d).astype("bfloat16"), mx.nd.array(l))
+                   for d, l in _batches(8, bs=8, dim=6, seed=2)]
+        est.fit(iter(batches), epochs=1, event_handlers=[])
+        assert est._fused is not None and not est._fused.broken, \
+            "bf16 net must stay on the fused path"
+        assert 4 in est._fused._jit_block, \
+            "the K=4 scan block must have run for the bf16 net"
+    finally:
+        os.environ.pop("MXNET_FUSED_STEP_BLOCK", None)
