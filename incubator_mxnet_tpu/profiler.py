@@ -15,7 +15,8 @@ import time
 import threading
 
 __all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
-           "resume", "Task", "Frame", "Counter", "Marker"]
+           "resume", "Task", "Frame", "Counter", "Marker",
+           "record_memory"]
 
 _config = {"profile_all": False, "profile_symbolic": False,
            "profile_imperative": False, "profile_memory": False,
@@ -107,6 +108,30 @@ def record_op(name, dur_us):
     table, reference `profiler.cc` ProfileOperator)."""
     _emit({"name": name, "cat": "operator", "ph": "X",
            "dur": float(dur_us), "ts": 0, "pid": 0, "tid": 0})
+    if _config.get("profile_memory") or _config.get("profile_all"):
+        record_memory(name)
+
+
+def record_memory(tag="memory", ctx=None):
+    """Record a device-memory sample (reference memory profiler:
+    `src/profiler/storage_profiler.h` DeviceStorageProfiler events,
+    aggregated as `Memory:<device>` counters in DumpProfile).
+
+    The reference hooks every StorageManager alloc/free; XLA owns
+    allocation here, so the equivalent observable is the PJRT counter set
+    (bytes_in_use / peak_bytes_in_use) sampled at op boundaries when
+    `profile_memory` is set, or on demand via this function."""
+    from .storage import memory_stats
+    stats = memory_stats(ctx)
+    if not stats:
+        return None
+    ev = {"name": f"Memory:{tag}", "cat": "memory", "ph": "C",
+          "ts": time.perf_counter() * 1e6, "pid": 0, "tid": 0,
+          "args": {"bytes_in_use": int(stats.get("bytes_in_use", 0)),
+                   "peak_bytes_in_use":
+                       int(stats.get("peak_bytes_in_use", 0))}}
+    _emit(ev)
+    return ev["args"]
 
 
 class _Named:
