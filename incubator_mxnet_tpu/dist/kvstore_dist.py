@@ -50,6 +50,9 @@ class KVStoreDist(KVStoreTPU):
             "MXNET_KVSTORE_BIGARRAY_BOUND"))
         self._push_count = {}    # (srv, key) -> completed sync pushes
         self._update_on_kvstore = False
+        # route profiler(profile_process='server') commands through us
+        from .. import profiler as _profiler
+        _profiler.set_kvstore_handle(self)
         # collective data plane: gradients all-reduce over the global device
         # mesh (ICI/DCN via XLA collectives — the reference's NCCL/ps-lite
         # data role done the TPU way, SURVEY §2.4); the socket server is
@@ -67,6 +70,23 @@ class KVStoreDist(KVStoreTPU):
                     "collective data plane unavailable (%s); gradients go "
                     "through the parameter server", str(e)[:200])
                 self._collective = None
+
+    def server_profiler_command(self, action, **kw):
+        """Drive every parameter server's profiler (reference
+        `mx.profiler.set_config/set_state/dump(profile_process='server')`
+        forwarded through MXKVStoreSendCommmandToServers).  Every server
+        is attempted; failures are aggregated so a bad first server
+        cannot leave the rest silently unconfigured."""
+        errors = []
+        for i, chan in enumerate(self._chans):
+            try:
+                _check(chan.request(dict({"cmd": "profiler",
+                                          "action": action}, **kw)))
+            except Exception as e:
+                errors.append(f"server {i}: {e}")
+        if errors:
+            raise MXNetError("server profiler command failed on: " +
+                             "; ".join(errors))
 
     @property
     def prefers_batched_push(self):
@@ -319,6 +339,9 @@ class KVStoreDist(KVStoreTPU):
         _check(self._chan.request({"cmd": "barrier"}))
 
     def close(self):
+        from .. import profiler as _profiler
+        if _profiler._kvstore_handle[0] is self:
+            _profiler.set_kvstore_handle(None)
         for chan in getattr(self, "_chans", [self._chan]):
             try:
                 chan.request({"cmd": "stop"})

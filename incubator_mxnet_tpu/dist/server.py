@@ -236,6 +236,28 @@ class ParameterServer:
                 st.updater = opt.get_updater(optimizer)
             return {"ok": True}
 
+        if cmd == "profiler":
+            # server-side profiling commands (reference kvstore.py
+            # set_server_profiler_state/dump forwarded through
+            # MXKVStoreSendCommmandToServers): drive THIS process's
+            # profiler so server-side aggregation cost is observable
+            from .. import profiler as _profiler
+            action = msg.get("action")
+            try:
+                if action == "set_config":
+                    _profiler.set_config(**msg.get("config", {}))
+                elif action == "set_state":
+                    _profiler.set_state(msg.get("state", "stop"))
+                elif action == "dump":
+                    _profiler.dump()
+                else:
+                    return {"error": f"unknown profiler action {action!r}"}
+            except Exception as e:
+                # every dispatch branch replies; a raise here would kill
+                # the handler thread with no reply and stall the worker
+                return {"error": f"server profiler {action} failed: {e!r}"}
+            return {"ok": True, "state": _profiler.state()}
+
         if cmd == "stop":
             with st.cond:
                 st.stopped += 1

@@ -27,15 +27,42 @@ _custom_events = []
 _lock = threading.Lock()
 
 
+_kvstore_handle = [None]
+
+
+def set_kvstore_handle(kv):
+    """Register the dist kvstore used to forward `profile_process=
+    'server'` commands (reference `profiler.py:29 set_kvstore_handle`;
+    KVStoreDist registers itself on creation)."""
+    _kvstore_handle[0] = kv
+
+
+def _forward_to_servers(action, **kw):
+    kv = _kvstore_handle[0]
+    if kv is None or not hasattr(kv, "server_profiler_command"):
+        raise RuntimeError(
+            "profile_process='server' requires a dist kvstore "
+            "(create one before driving the server profiler)")
+    kv.server_profiler_command(action, **kw)
+
+
 def set_config(**kwargs):
     """Reference `profiler.py:33 set_config`."""
+    if kwargs.pop("profile_process", "worker") == "server":
+        _forward_to_servers("set_config", config=kwargs)
+        return
     _config.update(kwargs)
 
 
 def set_state(state_="stop", profile_process="worker"):
     """'run' starts a JAX profiler trace; 'stop' ends and writes it
-    (reference `profiler.py set_state` → `MXSetProcessProfilerState`)."""
+    (reference `profiler.py set_state` → `MXSetProcessProfilerState`);
+    profile_process='server' drives the dist parameter servers'
+    profilers instead."""
     import jax
+    if profile_process == "server":
+        _forward_to_servers("set_state", state=state_)
+        return
     if state_ == "run" and not _state["running"]:
         trace_dir = os.path.splitext(_config["filename"])[0] + "_trace"
         os.makedirs(trace_dir, exist_ok=True)
@@ -57,16 +84,20 @@ def state():
 
 
 def pause(profile_process="worker"):
-    set_state("stop")
+    set_state("stop", profile_process=profile_process)
 
 
 def resume(profile_process="worker"):
-    set_state("run")
+    set_state("run", profile_process=profile_process)
 
 
 def dump(finished=True, profile_process="worker"):
     """Write custom-event chrome trace alongside the XLA trace
-    (reference `MXDumpProfile`)."""
+    (reference `MXDumpProfile`); profile_process='server' makes each
+    parameter server write ITS profile file."""
+    if profile_process == "server":
+        _forward_to_servers("dump")
+        return
     events = []
     with _lock:
         for ev in _custom_events:

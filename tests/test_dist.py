@@ -450,3 +450,34 @@ def test_dist_sync_sharded_servers(tmp_path):
     assert "big" in root._state.store and "big" in second._state.store
     assert root._state.store["big"].size + \
         second._state.store["big"].size == 40
+
+
+def test_server_profiler_commands(tmp_path):
+    """profiler.set_config/set_state/dump(profile_process='server') drive
+    the parameter server's profiler over the control channel (reference
+    set_kvstore_handle + MXKVStoreSendCommmandToServers)."""
+    from incubator_mxnet_tpu.dist.server import ParameterServer
+    from incubator_mxnet_tpu.dist.transport import Channel
+
+    server = ParameterServer(num_workers=1).start()
+    chan = Channel("127.0.0.1", server.port)
+    try:
+        out = str(tmp_path / "server_prof.json")
+        r = chan.request({"cmd": "profiler", "action": "set_config",
+                          "config": {"filename": out,
+                                     "aggregate_stats": True}})
+        assert r.get("ok"), r
+        r = chan.request({"cmd": "profiler", "action": "dump"})
+        assert r.get("ok"), r
+        assert os.path.exists(out)
+        r = chan.request({"cmd": "profiler", "action": "bogus"})
+        assert "error" in r
+    finally:
+        chan.request({"cmd": "stop"})
+        chan.close()
+        server.shutdown()
+        # the in-process test server shares this process's profiler
+        # module: restore the global config for later tests
+        from incubator_mxnet_tpu import profiler as _p
+        _p.set_config(filename="profile.json", aggregate_stats=False)
+        _p.set_kvstore_handle(None)

@@ -30,20 +30,19 @@ def test_profiler_memory_eventing(tmp_path):
     """profile_memory: PJRT memory counters land in the dumped trace as
     Memory:* counter events (reference storage_profiler.h role)."""
     import json
-    import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import profiler
 
     out = tmp_path / "prof.json"
     profiler.set_config(profile_memory=True, filename=str(out))
-    got = profiler.record_memory("unit")
-    # the CPU backend may report no counters; the API contract is then a
-    # clean None, no event
-    ev_file_ok = True
-    profiler.dump()
-    data = json.loads(out.read_text())
-    mems = [e for e in data["traceEvents"] if e.get("cat") == "memory"]
-    if got is not None:
-        assert mems and mems[-1]["args"]["bytes_in_use"] >= 0
-    else:
-        assert mems == []
-    profiler.set_config(profile_memory=False)
+    try:
+        got = profiler.record_memory("unit")
+        profiler.dump()
+        data = json.loads(out.read_text())
+        mems = [e for e in data["traceEvents"] if e.get("cat") == "memory"]
+        if got is not None:
+            assert mems and mems[-1]["args"]["bytes_in_use"] >= 0
+        else:
+            # the CPU backend reports no counters: clean None, no event
+            assert mems == []
+    finally:
+        profiler.set_config(profile_memory=False, filename="profile.json")
