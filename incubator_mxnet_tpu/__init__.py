@@ -45,7 +45,7 @@ for _mod_name, _aliases in [
     ("parallel", ()), ("test_utils", ()), ("image", ()), ("operator", ()),
     ("contrib", ()), ("rnn", ()), ("compat", ()), ("dist", ()),
     ("subgraph", ()), ("storage", ()), ("libinfo", ()),
-    ("kvstore_server", ()),
+    ("checkpoint", ()), ("kvstore_server", ()),
     ("native", ()),
 ]:
     try:

@@ -27,6 +27,36 @@ def do_checkpoint(prefix, period=1):
     return _callback
 
 
+def elastic_checkpoint(manager, mod, train_data=None, period=1):
+    """Batch-end callback taking async full-state snapshots through a
+    `checkpoint.CheckpointManager` — the wiring for training loops that
+    drive `fit_step` themselves instead of `Module.fit(checkpoint_dir=)`.
+
+    Unlike `module_checkpoint` (epoch-grained, synchronous, params+states
+    as loose files) this captures optimizer slots, iterator position and
+    RNG streams into one atomically-committed checkpoint directory while
+    the train step keeps running.
+
+    For custom loops stepping `fit_step` per batch.  Under `Module.fit`
+    prefer ``fit(checkpoint_dir=...)``: its fused block mode fires
+    batch-end callbacks in post-block bursts where ``param.nbatch`` lags
+    the already-applied updates, so a snapshot from inside the burst
+    records a position resume would replay (fit's built-in path
+    snapshots at block boundaries, where position and params agree)."""
+    period = int(max(1, period))
+    counter = {"step": 0}
+
+    def _callback(param):
+        counter["step"] += 1
+        if counter["step"] % period:
+            return
+        from .checkpoint import state as _state
+        arrays, blobs = _state.capture_module(mod, train_data)
+        manager.snapshot(arrays=arrays, blobs=blobs, step=counter["step"],
+                         epoch=param.epoch, nbatch=param.nbatch + 1)
+    return _callback
+
+
 def log_train_metric(period, auto_reset=False):
     def _callback(param):
         if param.nbatch % period == 0 and param.eval_metric is not None:

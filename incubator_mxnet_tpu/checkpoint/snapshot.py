@@ -1,0 +1,316 @@
+"""Async snapshot engine.
+
+``snapshot()`` splits a checkpoint into a cheap synchronous phase and a
+background phase so the train step keeps running while bytes hit disk:
+
+* **sync phase** — device arrays are gathered into pooled host buffers
+  (`storage.HostStagingPool`, the same size-class pool the input pipeline
+  recycles) and small python state (optimizer blobs, RNG) is captured.
+  This is the only part that must see a consistent view of training state.
+* **background phase** — a single daemon thread serializes the staged
+  buffers into shard files, hashes them, writes the manifest, and commits
+  the checkpoint directory with one ``os.replace`` rename.
+
+Double-buffering: at most ONE snapshot is in flight.  Submitting a new
+one first waits for the previous write to land (so a fast checkpoint
+period degrades to back-to-back writes, never to an unbounded queue of
+staged param copies), and ``flush()`` blocks until the in-flight write —
+if any — has committed.  Background failures are re-raised on the next
+``submit``/``flush`` so a dying disk cannot silently drop checkpoints.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import struct
+import threading
+import zlib
+
+import numpy as np
+
+from ..base import MXNetError
+from .. import storage
+from . import manifest as _manifest
+
+ARRAYS_SHARD = "arrays.npk"
+_PICKLE_PROTO = 4
+_HDR = struct.Struct("<Q")
+
+
+def write_array_shard(path, arrays):
+    """Stream ``{name: host ndarray}`` to one shard file:
+    ``[8-byte header length][pickled (name, dtype, shape, offset, nbytes)
+    table][raw array bytes...]``.
+
+    Raw buffers go straight from the staging pool to ``file.write`` and
+    ``zlib.crc32`` — both release the GIL on large buffers — so the
+    background writer never serializes a big pickle while the train
+    loop's host thread needs the interpreter.  Returns (bytes, crc32)
+    for the manifest without re-reading the file.
+    """
+    table = []
+    views = []
+    offset = 0
+    for name, arr in arrays.items():
+        a = np.ascontiguousarray(arr)
+        view = memoryview(a).cast("B")
+        table.append((name, str(a.dtype), tuple(a.shape), offset,
+                      len(view)))
+        views.append(view)
+        offset += len(view)
+    header = pickle.dumps(table, protocol=_PICKLE_PROTO)
+    crc = 0
+    with open(path, "wb") as f:
+        for chunk in (_HDR.pack(len(header)), header):
+            f.write(chunk)
+            crc = zlib.crc32(chunk, crc)
+        for view in views:
+            f.write(view)
+            crc = zlib.crc32(view, crc)
+    return _HDR.size + len(header) + offset, crc
+
+
+def read_array_shard(path):
+    """{name: np.ndarray} back out of a `write_array_shard` file."""
+    with open(path, "rb") as f:
+        hlen = _HDR.unpack(f.read(_HDR.size))[0]
+        table = pickle.loads(f.read(hlen))
+        payload = f.read()
+    out = {}
+    for name, dtype, shape, offset, nbytes in table:
+        dt = np.dtype(dtype)
+        arr = np.frombuffer(payload, dtype=dt, count=nbytes // dt.itemsize,
+                            offset=offset)
+        out[name] = arr.reshape(shape).copy()
+    return out
+
+
+def _as_host_array(value):
+    """Host ndarray view of an NDArray / jax array / numpy array (zero-copy
+    where the backend allows it)."""
+    data = getattr(value, "_data", value)
+    try:
+        return np.asarray(data)
+    except Exception:
+        # device-resident array that refuses a direct view: explicit fetch
+        import jax
+        return np.asarray(jax.device_get(data))
+
+
+def gather_to_pool(named_arrays, pool=None):
+    """Stage ``{name: array}`` into pooled host buffers.
+
+    Returns ``(staged, release)``: `staged` maps each name to a host
+    ndarray backed by the pool; `release()` hands every buffer back (the
+    background writer calls it once the bytes are on disk).
+    """
+    pool = pool or storage.default_pool()
+    staged = {}
+    bufs = []
+    for name, value in named_arrays.items():
+        src = _as_host_array(value)
+        buf = pool.acquire(src.shape, src.dtype)
+        np.copyto(buf, src)
+        staged[name] = buf
+        bufs.append(buf)
+
+    def release():
+        for b in bufs:
+            pool.release(b)
+    return staged, release
+
+
+class SnapshotJob:
+    """One staged checkpoint: everything the background writer needs."""
+
+    def __init__(self, root, step, epoch=0, nbatch=0, arrays=None,
+                 blobs=None, rng=None, meta=None, retire=None,
+                 rank=0, num_ranks=1, release=None):
+        self.root = root
+        self.step = int(step)
+        self.epoch = int(epoch)
+        self.nbatch = int(nbatch)
+        self.arrays = arrays or {}
+        self.blobs = dict(blobs or {})
+        self.rng = rng
+        self.meta = meta or {}
+        self.retire = retire    # committed-path -> [stale paths to delete]
+        self.rank = int(rank)
+        self.num_ranks = int(num_ranks)
+        self.release = release
+
+    # -- background phase ----------------------------------------------------
+    def write(self):
+        try:
+            if self.rank == 0:
+                self._write_primary()
+            else:
+                self._write_rank_shard()
+        finally:
+            if self.release is not None:
+                self.release()
+
+    def _serialize_shards(self, into_dir):
+        shards = {}
+        if self.arrays:
+            path = os.path.join(into_dir, ARRAYS_SHARD)
+            size, crc = write_array_shard(path, self.arrays)
+            shards[ARRAYS_SHARD] = {"bytes": size, "crc32": crc}
+        for name, blob in self.blobs.items():
+            fname = f"{name}.bin"
+            with open(os.path.join(into_dir, fname), "wb") as f:
+                f.write(blob)
+            shards[fname] = {"bytes": len(blob), "crc32": zlib.crc32(blob)}
+        return shards
+
+    def _write_primary(self):
+        os.makedirs(self.root, exist_ok=True)
+        tmp = os.path.join(
+            self.root, "%s%d-%d" % (_manifest._TMP_PREFIX, self.step,
+                                    os.getpid()))
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        try:
+            shards = self._serialize_shards(tmp)
+            # per-rank shards (dist layout) live OUTSIDE the renamed dir —
+            # other processes wrote them; the manifest records what rank 0
+            # expects so validate() still covers them after adoption
+            shards.update(self._adopt_rank_shards(tmp))
+            _manifest.write_manifest(
+                tmp, step=self.step, epoch=self.epoch, nbatch=self.nbatch,
+                shards=shards, rng=self.rng, meta=self.meta,
+                num_ranks=self.num_ranks)
+            final = os.path.join(self.root,
+                                 _manifest.checkpoint_dirname(self.step))
+            if os.path.isdir(final):
+                shutil.rmtree(final)
+            os.replace(tmp, final)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        if self.retire is not None:
+            # O(1) retention: the manager tracks its own commit history,
+            # so steady-state retirement deletes ONE known directory
+            # instead of re-scanning and re-validating the whole root on
+            # every snapshot (a full `manifest.gc` sweep runs once at
+            # manager construction to clear prior-run leftovers)
+            for stale in self.retire(final):
+                shutil.rmtree(stale, ignore_errors=True)
+
+    def _adopt_rank_shards(self, tmp):
+        """Move this step's per-rank shard files (written by other worker
+        processes into ``root/rank-shards/``) inside the checkpoint dir so
+        the atomic rename commits them together with rank 0's shards."""
+        shards = {}
+        pool_dir = os.path.join(self.root, "rank-shards")
+        if self.num_ranks <= 1 or not os.path.isdir(pool_dir):
+            return shards
+        prefix = "step-%d-" % self.step
+        for name in sorted(os.listdir(pool_dir)):
+            if not name.startswith(prefix):
+                continue
+            dst = os.path.join(tmp, name)
+            os.replace(os.path.join(pool_dir, name), dst)
+            shards[name] = _manifest.shard_entry(dst)
+        return shards
+
+    def _write_rank_shard(self):
+        """Non-primary ranks publish their shards into a shared side pool;
+        rank 0's manifest+rename is the only commit point.  Shards for
+        steps older than this one are this rank's own superseded
+        publications — retire them here so the pool cannot grow without
+        bound when commits lag."""
+        pool_dir = os.path.join(self.root, "rank-shards")
+        os.makedirs(pool_dir, exist_ok=True)
+        payload = {"arrays": self.arrays, "blobs": self.blobs,
+                   "rng": self.rng}
+        fname = "step-%d-rank-%d.bin" % (self.step, self.rank)
+        tmp = os.path.join(pool_dir, ".%s.tmp.%d" % (fname, os.getpid()))
+        with open(tmp, "wb") as f:
+            pickle.dump(payload, f, protocol=_PICKLE_PROTO)
+        os.replace(tmp, os.path.join(pool_dir, fname))
+        suffix = "-rank-%d.bin" % self.rank
+        for name in os.listdir(pool_dir):
+            if name.startswith("step-") and name.endswith(suffix):
+                try:
+                    if int(name[5:-len(suffix)]) < self.step:
+                        os.remove(os.path.join(pool_dir, name))
+                except (ValueError, OSError):
+                    continue
+
+
+class SnapshotWriter:
+    """Background serializer with double-buffering (one in-flight write)."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._job = None
+        self._busy = False
+        self._error = None
+        self._closed = False
+        self._thread = None
+
+    def _ensure_thread(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._run, name="checkpoint-writer", daemon=True)
+            self._thread.start()
+
+    def _run(self):
+        while True:
+            with self._cond:
+                while self._job is None and not self._closed:
+                    self._cond.wait()
+                if self._job is None and self._closed:
+                    return
+                job, self._job = self._job, None
+                self._busy = True
+            try:
+                job.write()
+            except BaseException as e:  # surfaced on next submit/flush
+                with self._cond:
+                    self._error = e
+            finally:
+                with self._cond:
+                    self._busy = False
+                    self._cond.notify_all()
+
+    def _raise_pending(self):
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise MXNetError(f"background checkpoint write failed: {err!r}") \
+                from err
+
+    def submit(self, job, sync=False):
+        """Queue `job`; waits for any in-flight write first (double-buffer:
+        at most one snapshot in flight).  ``sync=True`` additionally waits
+        for THIS job to land before returning."""
+        self._ensure_thread()
+        with self._cond:
+            while self._job is not None or self._busy:
+                self._cond.wait()
+            self._raise_pending()
+            self._job = job
+            self._cond.notify_all()
+        if sync:
+            self.flush()
+
+    def flush(self):
+        """Block until no snapshot is queued or being written (the
+        ``waitall()`` of the checkpoint plane); re-raise deferred errors."""
+        with self._cond:
+            while self._job is not None or self._busy:
+                self._cond.wait()
+            self._raise_pending()
+
+    def close(self):
+        self.flush()
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        self._closed = False

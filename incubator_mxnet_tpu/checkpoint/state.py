@@ -1,0 +1,160 @@
+"""Full training-state capture & restore.
+
+What a resumable checkpoint must hold beyond the weights (reference
+`save_checkpoint` loses all of it): optimizer slots (momentum / Adam
+moments via the `optimizer.Updater` state store, including the pickled
+optimizer itself so `num_update` and the LR-scheduler position travel
+along), Module/Trainer update counts, the data iterator's position, and
+every RNG stream that shapes the run (framework threefry chain, host
+SeedSequence counter, numpy's global generator — the one `NDArrayIter`
+shuffles with).  Restoring all of it makes a resumed run bit-for-bit
+identical to an uninterrupted one on the same backend.
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+from ..base import MXNetError
+
+OPTIMIZER_BLOB = "optimizer"
+ITERATOR_BLOB = "iterator"
+TRAINER_BLOB = "trainer"
+NET_ARRAYS_PREFIX = "param:"
+
+
+# -- RNG ---------------------------------------------------------------------
+def capture_rng():
+    """JSON-able snapshot of every RNG stream training consumes."""
+    from .. import random as _random
+    state = {}
+    key = getattr(_random._state, "key", None)
+    if key is not None:
+        state["key"] = np.asarray(key).tolist()
+    host_seq = getattr(_random._state, "host_seq", None)
+    if host_seq is not None:
+        state["host_seq"] = list(host_seq)
+    name, keys, pos, has_gauss, cached = np.random.get_state()
+    state["numpy"] = [name, np.asarray(keys).tolist(), int(pos),
+                      int(has_gauss), float(cached)]
+    return state
+
+
+def restore_rng(state):
+    if not state:
+        return
+    from .. import random as _random
+    if "key" in state:
+        import jax.numpy as jnp
+        _random._state.key = jnp.asarray(np.asarray(state["key"],
+                                                    dtype=np.uint32))
+    if "host_seq" in state:
+        _random._state.host_seq = [int(x) for x in state["host_seq"]]
+    if "numpy" in state:
+        name, keys, pos, has_gauss, cached = state["numpy"]
+        np.random.set_state((name, np.asarray(keys, dtype=np.uint32),
+                             int(pos), int(has_gauss), float(cached)))
+
+
+# -- data iterators ----------------------------------------------------------
+def capture_iterator(data_iter):
+    """Pickled native iterator state (``DataIter.checkpoint_state``), or
+    None when the iterator has nothing beyond its batch position — resume
+    then falls back to ``seek(nbatch)`` (reset + skip)."""
+    getter = getattr(data_iter, "checkpoint_state", None)
+    if getter is None:
+        return None
+    state = getter()
+    if not state:
+        return None
+    return pickle.dumps(state, protocol=4)
+
+
+def restore_iterator(data_iter, blob, nbatch):
+    """Native restore when the iterator supports it, reset+skip otherwise."""
+    state = pickle.loads(blob) if blob else {}
+    setter = getattr(data_iter, "set_checkpoint_state", None)
+    if setter is not None:
+        setter(state, nbatch=nbatch)
+        return
+    seek = getattr(data_iter, "seek", None)
+    if seek is not None:
+        seek(nbatch)
+        return
+    for _ in range(int(nbatch)):
+        next(data_iter)
+
+
+# -- Module ------------------------------------------------------------------
+def capture_module(mod, data_iter=None):
+    """(arrays, blobs) for a bound+initialized Module: params + aux under
+    the classic ``arg:``/``aux:`` prefixes, optimizer slots as one pickled
+    blob (kvstore-aware), the iterator's native state when given."""
+    arg_params, aux_params = mod.get_params()
+    arrays = {f"arg:{k}": v for k, v in arg_params.items()}
+    arrays.update({f"aux:{k}": v for k, v in aux_params.items()})
+    blobs = {}
+    if mod.optimizer_initialized:
+        blobs[OPTIMIZER_BLOB] = mod.get_optimizer_states_blob()
+    if data_iter is not None:
+        it_blob = capture_iterator(data_iter)
+        if it_blob is not None:
+            blobs[ITERATOR_BLOB] = it_blob
+    return arrays, blobs
+
+
+def split_params(arrays):
+    """{'arg:...'/'aux:...': np.ndarray} -> (arg_params, aux_params) of
+    NDArrays, the shape Module.init_params consumes."""
+    from ..ndarray.ndarray import array
+    arg_params, aux_params = {}, {}
+    for key, value in arrays.items():
+        kind, _, name = key.partition(":")
+        if kind == "arg":
+            arg_params[name] = array(value)
+        elif kind == "aux":
+            aux_params[name] = array(value)
+        else:
+            raise MXNetError(f"checkpoint array key {key!r} is neither "
+                             "arg: nor aux:")
+    return arg_params, aux_params
+
+
+def restore_module_optimizer(mod, blob):
+    if blob:
+        mod.set_optimizer_states_blob(blob)
+
+
+# -- Gluon -------------------------------------------------------------------
+def capture_gluon_net(net):
+    """{param: first-context value} for every parameter of a gluon block."""
+    arrays = {}
+    for name, param in net.collect_params().items():
+        try:
+            arrays[NET_ARRAYS_PREFIX + name] = param.list_data()[0]
+        except Exception:
+            continue  # deferred-init param with no value yet
+    return arrays
+
+
+def restore_gluon_net(net, arrays):
+    from .. import ndarray as nd
+    params = net.collect_params()
+    for key, value in arrays.items():
+        if not key.startswith(NET_ARRAYS_PREFIX):
+            continue
+        name = key[len(NET_ARRAYS_PREFIX):]
+        if name not in params:
+            raise MXNetError(
+                f"checkpoint has parameter {name!r} the net does not")
+        params[name].set_data(nd.array(np.asarray(value)))
+
+
+def capture_trainer(trainer):
+    return trainer.get_checkpoint_state() if trainer is not None else None
+
+
+def restore_trainer(trainer, blob):
+    if trainer is not None and blob:
+        trainer.set_checkpoint_state(blob)

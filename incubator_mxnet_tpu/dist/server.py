@@ -236,6 +236,26 @@ class ParameterServer:
                 st.updater = opt.get_updater(optimizer)
             return {"ok": True}
 
+        if cmd == "get_optimizer_states":
+            # checkpoint plane: a server-side optimizer's slots (momentum /
+            # Adam moments for THIS server's key ranges) travel back to the
+            # worker over the control channel, so an elastic checkpoint
+            # captures them without a dedicated server filesystem
+            with st.cond:
+                if st.updater is None:
+                    return {"states": None}
+                return {"states": st.updater.get_states(
+                    dump_optimizer=bool(msg.get("dump_optimizer")))}
+
+        if cmd == "set_optimizer_states":
+            with st.cond:
+                if st.updater is None:
+                    return {"error": "set_optimizer_states: no optimizer "
+                                     "installed on this server (send "
+                                     "set_optimizer first)"}
+                st.updater.set_states(msg["states"])
+            return {"ok": True}
+
         if cmd == "profiler":
             # server-side profiling commands (reference kvstore.py
             # set_server_profiler_state/dump forwarded through

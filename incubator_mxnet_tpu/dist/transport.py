@@ -99,6 +99,7 @@ class Channel:
     def __init__(self, host: str, port: int, timeout: float | None = 330.0,
                  connect_wait: float = 90.0):
         import time
+        self.host, self.port = host, int(port)  # for error reporting
         deadline = time.monotonic() + connect_wait
         while True:
             try:

@@ -80,7 +80,11 @@ class LoggingHandler(EventHandler):
 
 class CheckpointHandler(EventHandler):
     """Save parameters each epoch; keep the best by a monitored metric
-    (reference `event_handler.py:CheckpointHandler`)."""
+    (reference `event_handler.py:CheckpointHandler`).
+
+    Parameters only — for preemption-safe training (async full-state
+    snapshots, atomic manifests, mid-epoch auto-resume) use
+    `incubator_mxnet_tpu.checkpoint.ElasticCheckpointHandler`."""
 
     def __init__(self, model_dir, model_prefix="model", monitor=None,
                  mode="min", save_best=False):
@@ -172,6 +176,7 @@ class Estimator:
         self.epoch = 0
         self.batch_idx = 0
         self._epochs_done = 0
+        self._resume_batches = 0  # set by checkpoint.ElasticCheckpointHandler
 
     def _ctx(self):
         if self.context is not None:
@@ -236,14 +241,38 @@ class Estimator:
         try:
             for h in handlers:
                 h.train_begin(self)
-            for self.epoch in range(self._epochs_done,
-                                    self._epochs_done + epochs):
+            end_epoch = self._epochs_done + epochs
+            if getattr(self, "_resume_total_epochs", False):
+                # a checkpoint-resumed run relaunches the SAME command:
+                # `epochs` is the total budget, not extra epochs on top of
+                # the restored position (ElasticCheckpointHandler sets this)
+                self._resume_total_epochs = False
+                end_epoch = max(epochs, self._epochs_done)
+            for self.epoch in range(self._epochs_done, end_epoch):
                 for m in self.train_metrics:
                     m.reset()
                 for h in handlers:
                     h.epoch_begin(self)
                 self.batch_idx = 0
                 data_iter = iter(train_data)
+                # mid-epoch resume (checkpoint.ElasticCheckpointHandler
+                # sets _resume_batches in train_begin): fast-forward the
+                # already-trained batches of the first resumed epoch
+                skip = int(getattr(self, "_resume_batches", 0) or 0)
+                if skip:
+                    self._resume_batches = 0
+                    for _ in range(skip):
+                        try:
+                            next(data_iter)
+                        except StopIteration:
+                            break
+                    self.batch_idx = skip
+                # batches whose updates have fully LANDED in the params —
+                # in fused block mode this leads batch_idx during the
+                # post-block handler burst (the whole block applied before
+                # its K batch_end events fire); checkpoint handlers must
+                # record THIS as the resume position, not batch_idx
+                self._applied_batches = self.batch_idx
                 exhausted = False
                 while not exhausted:
                     block = []
@@ -260,6 +289,7 @@ class Estimator:
                     block = [self._place(d, l) for d, l in block]
                     if len(block) == want and want > 1 and \
                             fused.call_block(block, block[0][0].shape[0]):
+                        self._applied_batches = self.batch_idx + len(block)
                         for _dl in block:
                             for h in handlers:
                                 h.batch_begin(self)
@@ -275,6 +305,7 @@ class Estimator:
                             h.batch_begin(self)
                         if fused is not None and not fused.broken and \
                                 fused(data, label, data.shape[0]):
+                            self._applied_batches = self.batch_idx + 1
                             for h in handlers:
                                 h.batch_end(self)
                             self.batch_idx += 1
@@ -284,6 +315,7 @@ class Estimator:
                             loss = self.loss(out, label)
                         loss.backward()
                         self.trainer.step(data.shape[0])
+                        self._applied_batches = self.batch_idx + 1
                         for m in self.train_metrics:
                             m.update([label], [out])
                         for h in handlers:

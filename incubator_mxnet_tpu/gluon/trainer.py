@@ -184,17 +184,33 @@ class Trainer:
             if isinstance(leaf, NDArray):
                 leaf._set_data(jax.device_put(leaf._data, rep))
 
-    def save_states(self, fname):
+    def get_checkpoint_state(self):
+        """Optimizer slots + the pickled optimizer (update counts,
+        LR-scheduler position) as one bytes blob — what an elastic
+        checkpoint stores per Trainer (checkpoint/state.py)."""
         assert self._optimizer is not None
-        with open(fname, "wb") as f:
-            f.write(self._updaters[0].get_states(dump_optimizer=True))
+        return self._updaters[0].get_states(dump_optimizer=True)
 
-    def load_states(self, fname):
+    def set_checkpoint_state(self, blob):
+        """Restore a `get_checkpoint_state` blob; every context's updater
+        adopts the restored slots and the ONE restored optimizer so
+        update counting continues where the checkpoint left off."""
         if not self._kv_initialized:
             self._init_kvstore()
-        with open(fname, "rb") as f:
-            states = f.read()
         for updater in self._updaters:
-            updater.set_states(states)
+            updater.set_states(blob)
             updater.optimizer = self._updaters[0].optimizer
         self._optimizer = self._updaters[0].optimizer
+        self._optimizer.param_dict = {i: p for i, p in
+                                      enumerate(self._params)}
+        # fused multi-tensor apply caches per-optimizer programs: rebuild
+        # against the restored optimizer instance
+        self._fused = None
+
+    def save_states(self, fname):
+        with open(fname, "wb") as f:
+            f.write(self.get_checkpoint_state())
+
+    def load_states(self, fname):
+        with open(fname, "rb") as f:
+            self.set_checkpoint_state(f.read())

@@ -112,6 +112,24 @@ class DataIter:
     def getpad(self):
         raise NotImplementedError
 
+    # -- checkpoint/resume support (checkpoint/state.py) -----------------------
+    def seek(self, nbatch):
+        """Position so the next batch is batch `nbatch` of the epoch.
+        Generic reset+skip; iterators with cheap native positioning
+        override (NDArrayIter does)."""
+        self.reset()
+        for _ in range(int(nbatch)):
+            self.next()
+
+    def checkpoint_state(self):
+        """Epoch-internal state a checkpoint must carry for exact resume
+        beyond the batch counter (e.g. a shuffle permutation).  Empty ->
+        resume uses plain ``seek(nbatch)``."""
+        return {}
+
+    def set_checkpoint_state(self, state, nbatch=0):
+        self.seek(nbatch)
+
 
 class NDArrayIter(DataIter):
     """Iterate over in-memory arrays (reference `io.py:546 NDArrayIter`):
@@ -153,6 +171,11 @@ class NDArrayIter(DataIter):
             self.cursor = self.num_data + self.cursor
         else:
             self.cursor = -self.batch_size
+        # epoch-start cursor: batch n of THIS epoch begins at
+        # _epoch_cursor0 + (n+1)*batch_size — under roll_over the epoch
+        # carries leftover samples, so batches are NOT aligned to
+        # n*batch_size and seek() must anchor here
+        self._epoch_cursor0 = self.cursor
 
     def iter_next(self):
         self.cursor += self.batch_size
@@ -191,6 +214,36 @@ class NDArrayIter(DataIter):
                 self.cursor + self.batch_size > self.num_data:
             return self.cursor + self.batch_size - self.num_data
         return 0
+
+    def seek(self, nbatch):
+        """Native seek: pure cursor math, no data touched (iter_next
+        advances the cursor before the bounds check).  Anchored at the
+        epoch-start cursor so roll_over epochs — which begin mid-stride
+        with carried samples — seek to the same windows the interrupted
+        run walked."""
+        self.cursor = self._epoch_cursor0 + int(nbatch) * self.batch_size
+
+    def checkpoint_state(self):
+        # the shuffle permutation IS the epoch: without it, resume after a
+        # shuffled epoch would walk a different batch order than the run
+        # it is continuing; the epoch-start cursor carries roll_over's
+        # mid-stride alignment
+        return {"idx": self.idx.copy(),
+                "epoch_cursor0": int(self._epoch_cursor0)}
+
+    def set_checkpoint_state(self, state, nbatch=0):
+        idx = state.get("idx")
+        if idx is not None:
+            idx = _np.asarray(idx)
+            if idx.shape != self.idx.shape:
+                raise MXNetError(
+                    f"checkpoint iterator order has {idx.shape[0]} samples, "
+                    f"this iterator has {self.idx.shape[0]} — resuming "
+                    "against a different dataset?")
+            self.idx = idx
+        if "epoch_cursor0" in state:
+            self._epoch_cursor0 = int(state["epoch_cursor0"])
+        self.seek(nbatch)
 
 
 def _init_data(data, allow_empty, default_name):

@@ -210,17 +210,26 @@ class KVStore:
         self._updater = opt.get_updater(optimizer)
 
     # -- server-state (de)serialization parity ------------------------------------
-    def save_optimizer_states(self, fname, dump_optimizer=False):
+    def get_optimizer_states(self, dump_optimizer=False):
+        """Optimizer slots as one bytes blob (the checkpoint plane's
+        capture point; `dist/kvstore_dist.py` overrides to pull state back
+        from the parameter servers)."""
         if self._updater is None:
             raise MXNetError("Cannot save states for distributed training")
-        with open(fname, "wb") as f:
-            f.write(self._updater.get_states(dump_optimizer))
+        return self._updater.get_states(dump_optimizer)
 
-    def load_optimizer_states(self, fname):
+    def set_optimizer_states(self, blob):
         if self._updater is None:
             raise MXNetError("Cannot load states for distributed training")
+        self._updater.set_states(blob)
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        with open(fname, "wb") as f:
+            f.write(self.get_optimizer_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
         with open(fname, "rb") as f:
-            self._updater.set_states(f.read())
+            self.set_optimizer_states(f.read())
 
     def _barrier(self):
         """Single-process stores have nothing to synchronize: engine order

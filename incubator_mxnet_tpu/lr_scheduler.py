@@ -15,6 +15,21 @@ class LRScheduler:
     def __call__(self, num_update):
         raise NotImplementedError("must override this")
 
+    def state_dict(self):
+        """JSON-able schedule position.  Every built-in scheduler keeps
+        only plain scalars/lists (`base_lr`, `count`, `cur_step_ind`, ...)
+        so the generic copy covers them; stateful subclasses with richer
+        fields override.  Checkpoints record this so a resumed run decays
+        the learning rate from exactly where the interrupted one stopped."""
+        return {k: (list(v) if isinstance(v, (list, tuple)) else v)
+                for k, v in self.__dict__.items()
+                if isinstance(v, (int, float, str, bool, list, tuple))}
+
+    def load_state_dict(self, state):
+        for k, v in state.items():
+            if k in self.__dict__:
+                self.__dict__[k] = v
+
 
 class FactorScheduler(LRScheduler):
     """lr *= factor every `step` updates (reference FactorScheduler)."""

@@ -106,13 +106,33 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
     """Reference `model.py:383 save_checkpoint`: prefix-symbol.json +
-    prefix-%04d.params."""
+    prefix-%04d.params.
+
+    Kept as the thin reference-compatible wrapper (synchronous, whole
+    model, params only — the byte format interchanges with reference
+    MXNet); production fault tolerance lives in the `checkpoint` package
+    (async snapshots, atomic manifests, full training state, auto-resume).
+    Both files here are still committed via temp-file + ``os.replace`` so
+    even this legacy path never leaves a torn checkpoint behind.
+    """
+    import os
+
+    def _atomic(path, write):
+        tmp = "%s.tmp.%d" % (path, os.getpid())
+        try:
+            write(tmp)
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            raise
+
     if symbol is not None:
-        symbol.save(f"{prefix}-symbol.json")
+        _atomic(f"{prefix}-symbol.json", symbol.save)
     save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
     save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
-    nd.save(param_name, save_dict)
+    _atomic(param_name, lambda tmp: nd.save(tmp, save_dict))
     logging.info('Saved checkpoint to "%s"', param_name)
 
 

@@ -142,12 +142,60 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, sparse_row_id_fn=None):
-        """THE classic training loop (reference `base_module.py:409 fit`)."""
+            monitor=None, sparse_row_id_fn=None, checkpoint_dir=None,
+            checkpoint_period=100, checkpoint_keep_last=5, resume=False):
+        """THE classic training loop (reference `base_module.py:409 fit`).
+
+        Elastic checkpointing (no reference analogue): with
+        ``checkpoint_dir`` set, every `checkpoint_period` processed
+        batches an async snapshot of the FULL training state — params,
+        optimizer slots, update counts, iterator position, RNG streams —
+        is staged to pooled host buffers and committed atomically by a
+        background thread while training continues; ``resume=True``
+        restores the newest valid checkpoint and continues mid-epoch
+        (train-metric accumulation restarts at the resumed batch).  A
+        SIGTERM during fit triggers one final synchronous snapshot before
+        exiting (checkpoint/manager.py preemption hook).
+        """
         assert num_epoch is not None, "please specify number of epochs"
         from ..initializer import Uniform
         if initializer is None:
             initializer = Uniform(0.01)
+
+        ckpt_mgr = None
+        ckpt_resume = None
+        resume_nbatch = 0
+        gstep = 0
+        if checkpoint_dir is not None:
+            from .. import checkpoint as _ckpt
+            if resume:
+                # read-only: the manager (writer, retention, rank layout)
+                # is built AFTER init_optimizer, when the kvstore — and
+                # with it this process's rank — is known
+                path = _ckpt.latest(checkpoint_dir)
+                ckpt_resume = _ckpt.load(path) if path is not None else None
+            elif _ckpt.latest(checkpoint_dir, deep=False) is not None:
+                # a fresh run must not share a directory with an old run's
+                # checkpoints: the old run's higher step numbers would win
+                # `latest()` after this run's first crash and resume would
+                # silently continue the ABANDONED run
+                raise MXNetError(
+                    f"checkpoint_dir {checkpoint_dir!r} already holds "
+                    "checkpoints from a previous run; pass resume=True to "
+                    "continue it, or point a fresh run at a fresh "
+                    "directory (or delete the old checkpoints)")
+            if ckpt_resume is not None:
+                self.logger.info("resuming from %s (step %d, epoch %d, "
+                                 "batch %d)", ckpt_resume.path,
+                                 ckpt_resume.step, ckpt_resume.epoch,
+                                 ckpt_resume.nbatch)
+                arg_params, aux_params = _ckpt.state.split_params(
+                    ckpt_resume.arrays)
+                allow_missing = False
+                force_init = True
+                begin_epoch = ckpt_resume.epoch
+                resume_nbatch = ckpt_resume.nbatch
+                gstep = ckpt_resume.step
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
@@ -159,20 +207,87 @@ class BaseModule:
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+        if checkpoint_dir is not None:
+            from .. import checkpoint as _ckpt
+            # dist layout: the resolved kvstore names this process's rank —
+            # rank 0 owns params/manifest/retention, other ranks publish
+            # side shards only (checkpoint/manager.py dist layout)
+            kv = getattr(self, "_kvstore", None)
+            rank = getattr(kv, "rank", 0) if kv is not None else 0
+            num_ranks = getattr(kv, "num_workers", 1) if kv is not None \
+                else 1
+            ckpt_mgr = _ckpt.CheckpointManager(
+                checkpoint_dir, keep_last=checkpoint_keep_last,
+                rank=rank, num_ranks=num_ranks)
+            if ckpt_resume is not None and rank != 0:
+                # this worker's rank-local state (its own iterator
+                # position/permutation, RNG streams) lives in ITS shard;
+                # rank 0's blobs must not stand in for it — absent a shard
+                # (lagging rank at commit time) fall back to position-only
+                # resume via the manifest's nbatch
+                ckpt_resume.blobs.pop(_ckpt.state.ITERATOR_BLOB, None)
+                ckpt_resume.rng = None
+                shard = ckpt_resume.rank_shard(rank)
+                if shard is not None:
+                    ckpt_resume.blobs.update(shard.get("blobs") or {})
+                    ckpt_resume.rng = shard.get("rng")
+        if ckpt_resume is not None:
+            from .. import checkpoint as _ckpt
+            _ckpt.state.restore_module_optimizer(
+                self, ckpt_resume.blobs.get(_ckpt.state.OPTIMIZER_BLOB))
+            _ckpt.state.restore_rng(ckpt_resume.rng)
         if validation_metric is None:
             validation_metric = eval_metric
         if not isinstance(eval_metric, _metric.EvalMetric):
             eval_metric = _metric.create(eval_metric)
 
+        last_snap_step = gstep
+        if ckpt_mgr is not None:
+            ckpt_mgr.install_preemption_hook()
+        try:
+            self._fit_epochs(
+                train_data, eval_data, eval_metric, validation_metric,
+                epoch_end_callback, batch_end_callback, eval_end_callback,
+                eval_batch_end_callback, monitor, sparse_row_id_fn,
+                begin_epoch, num_epoch, ckpt_mgr, ckpt_resume,
+                resume_nbatch, gstep, last_snap_step, checkpoint_period)
+        finally:
+            if ckpt_mgr is not None:
+                try:
+                    ckpt_mgr.flush()
+                finally:
+                    ckpt_mgr.close()
+
+    def _fit_epochs(self, train_data, eval_data, eval_metric,
+                    validation_metric, epoch_end_callback,
+                    batch_end_callback, eval_end_callback,
+                    eval_batch_end_callback, monitor, sparse_row_id_fn,
+                    begin_epoch, num_epoch, ckpt_mgr, ckpt_resume,
+                    resume_nbatch, gstep, last_snap_step, checkpoint_period):
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
             nbatch = 0
+            if ckpt_resume is not None and epoch == begin_epoch:
+                # continue mid-epoch: native iterator restore (shuffle
+                # permutation + position) where supported, reset+skip
+                # otherwise; metric accumulation restarts here
+                from .. import checkpoint as _ckpt
+                _ckpt.state.restore_iterator(
+                    train_data,
+                    ckpt_resume.blobs.get(_ckpt.state.ITERATOR_BLOB),
+                    resume_nbatch)
+                nbatch = resume_nbatch
             data_iter = iter(train_data)
             end_of_batch = False
-            next_data_batch = next(data_iter)
+            try:
+                next_data_batch = next(data_iter)
+            except StopIteration:
+                end_of_batch = True
+                next_data_batch = None
             while not end_of_batch:
                 data_batch = next_data_batch
+                nbatch_at_entry = nbatch
                 # block mode: collect K batches and let the subclass run
                 # them as ONE dispatch (Module: lax.scan over K stacked
                 # batches — host bookkeeping amortizes across the block).
@@ -230,6 +345,19 @@ class BaseModule:
                             callback(batch_end_params)
                     nbatch += 1
 
+                gstep += nbatch - nbatch_at_entry
+                if ckpt_mgr is not None and nbatch > nbatch_at_entry:
+                    # batch boundary: params and (epoch, nbatch, step)
+                    # agree — the only place a snapshot may be taken
+                    ckpt_mgr.honor_preemption(
+                        lambda: self._elastic_snapshot(
+                            ckpt_mgr, train_data, epoch, nbatch, gstep,
+                            sync=True, meta={"preempted": True}))
+                    if gstep - last_snap_step >= checkpoint_period:
+                        self._elastic_snapshot(ckpt_mgr, train_data, epoch,
+                                               nbatch, gstep)
+                        last_snap_step = gstep
+
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
             toc = time.time()
@@ -251,6 +379,52 @@ class BaseModule:
                     self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
                                      name, val)
             train_data.reset()
+            if ckpt_mgr is not None:
+                # epoch-boundary snapshot AFTER the reset so the fresh
+                # shuffle permutation travels with it: resume starts the
+                # next epoch exactly as this run would have
+                self._elastic_snapshot(ckpt_mgr, train_data, epoch + 1, 0,
+                                       gstep)
+                last_snap_step = gstep
+                ckpt_mgr.honor_preemption(
+                    lambda: self._elastic_snapshot(
+                        ckpt_mgr, train_data, epoch + 1, 0, gstep,
+                        sync=True, meta={"preempted": True}))
+
+    def _elastic_snapshot(self, mgr, train_data, epoch, nbatch, step,
+                          sync=False, meta=None):
+        """Stage one elastic checkpoint: sync device->pooled-host gather,
+        background serialization + atomic commit (checkpoint/)."""
+        from .. import checkpoint as _ckpt
+        if mgr.rank != 0:
+            # non-primary ranks publish ONLY rank-local state (this
+            # worker's iterator position/permutation; its updater slots
+            # when the optimizer runs worker-side) as a side shard —
+            # params are identical across ranks and a server-side
+            # optimizer's slots are rank 0's to pull, so gathering either
+            # here would multiply checkpoint cost by the worker count for
+            # bytes that are thrown away
+            blobs = {}
+            if self.optimizer_initialized and \
+                    not getattr(self, "_update_on_kvstore", False) and \
+                    getattr(self, "_updater", None) is not None:
+                blobs[_ckpt.state.OPTIMIZER_BLOB] = \
+                    self._updater.get_states(dump_optimizer=True)
+            it_blob = _ckpt.state.capture_iterator(train_data)
+            if it_blob is not None:
+                blobs[_ckpt.state.ITERATOR_BLOB] = it_blob
+            mgr.snapshot(arrays={}, blobs=blobs, step=step, epoch=epoch,
+                         nbatch=nbatch, sync=sync, meta=meta)
+            return
+        arrays, blobs = _ckpt.state.capture_module(self, train_data)
+        meta = dict(meta or {})
+        optimizer = getattr(self, "_optimizer", None)
+        if optimizer is not None:
+            # scalar optimizer position in the manifest (human-inspectable
+            # evidence; the authoritative tensors ride the optimizer blob)
+            meta["optimizer"] = optimizer.state_dict()
+        mgr.snapshot(arrays=arrays, blobs=blobs, step=step, epoch=epoch,
+                     nbatch=nbatch, sync=sync, meta=meta)
 
     # -- properties / abstract -------------------------------------------------
     @property

@@ -511,22 +511,65 @@ class Module(BaseModule):
                 self._kvstore.pull(param_name, param_val)
         self._params_dirty = False
 
-    def save_optimizer_states(self, fname):
+    def get_optimizer_states_blob(self):
+        """Full optimizer state as one bytes blob (the checkpoint plane's
+        capture point): local updater slots + the pickled optimizer
+        (num_update / LR-scheduler position travel along); with a
+        server-side optimizer (`update_on_kvstore` on a dist store) the
+        slots are pulled back through the kvstore control channel."""
         assert self.optimizer_initialized
         self._flush_fused()
         if self._update_on_kvstore:
-            self._kvstore.save_optimizer_states(fname)
-        else:
-            with open(fname, "wb") as fout:
-                fout.write(self._updater.get_states())
+            getter = getattr(self._kvstore, "get_optimizer_states", None)
+            if getter is None:
+                raise MXNetError(
+                    f"kvstore {self._kvstore.type!r} runs the optimizer "
+                    "server-side but cannot export its state")
+            return getter(dump_optimizer=True)
+        return self._updater.get_states(dump_optimizer=True)
 
-    def load_optimizer_states(self, fname):
+    def set_optimizer_states_blob(self, blob):
         assert self.optimizer_initialized
         self._flush_fused()  # stale pending state must not clobber the load
         if self._update_on_kvstore:
-            self._kvstore.load_optimizer_states(fname)
-        else:
-            self._updater.set_states(open(fname, "rb").read())
+            setter = getattr(self._kvstore, "set_optimizer_states", None)
+            if setter is None:
+                raise MXNetError(
+                    f"kvstore {self._kvstore.type!r} runs the optimizer "
+                    "server-side but cannot restore its state")
+            setter(blob)
+            return
+        self._updater.set_states(blob)
+        # a resumed optimizer must keep counting updates where it left off:
+        # when the blob carried the pickled optimizer, adopt it as THE
+        # optimizer so Module and Updater agree on num_update
+        restored = getattr(self._updater, "optimizer", None)
+        if isinstance(restored, opt.Optimizer):
+            self._optimizer = restored
+            if self._fused_step is not None:
+                # the fused program captured the PRE-restore optimizer at
+                # construction (FusedTrainStep.__init__ caches
+                # updater.optimizer); rebuild it or every fused step would
+                # keep advancing the stale instance from num_update=0
+                # while the restored one stays frozen
+                try:
+                    from .. import fused as _fused
+                    self._fused_step = _fused.FusedTrainStep(self,
+                                                             self._updater)
+                except Exception as e:
+                    self.logger.warning(
+                        "fused train step unavailable after optimizer "
+                        "state restore (%s); falling back to "
+                        "forward_backward+update", str(e)[:200])
+                    self._fused_step = None
+
+    def save_optimizer_states(self, fname):
+        with open(fname, "wb") as fout:
+            fout.write(self.get_optimizer_states_blob())
+
+    def load_optimizer_states(self, fname):
+        with open(fname, "rb") as fin:
+            self.set_optimizer_states_blob(fin.read())
 
     def install_monitor(self, mon):
         assert self.binded

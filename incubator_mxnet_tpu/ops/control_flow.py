@@ -10,7 +10,10 @@ save/load like any other) is evaluated through `graph_eval_fn` inside
 * `_while_loop`  -> a masked `lax.scan` over max_iterations (static shapes
                     are what the XLA compilation model wants; entries past
                     termination are zeros, the reference leaves them
-                    undefined — `docs` of nd.contrib.while_loop)
+                    undefined — `docs` of nd.contrib.while_loop).  With NO
+                    per-step outputs (num_out_data == 0) and outside
+                    training, a TRUE `lax.while_loop` runs instead: early
+                    termination, cost scales with actual iterations
 * `_cond`        -> `jax.lax.cond`
 
 so a hybridized RNN becomes ONE scan in the compiled program instead of T
@@ -160,6 +163,29 @@ def _while_loop(params, *arrays):
     def pick(slots, vals):
         return tuple(vals[i] if k == "v" else closure[i]
                      for k, i in slots)
+
+    if n_out == 0 and not train:
+        # fast path: no per-step outputs to pad means the result shape is
+        # iteration-count independent, so a TRUE `lax.while_loop` applies —
+        # cost scales with ACTUAL iterations, not max_iterations (the
+        # masked scan below runs the full static trip count even when the
+        # condition fails on step 1).  Inference only: while_loop has no
+        # reverse-mode derivative, training keeps the differentiable scan.
+        def w_cond(carry):
+            vals, i, k = carry
+            (c,), _ = cfn(pick(c_slots, vals), (),
+                          jax.random.fold_in(k, 0))
+            return jnp.logical_and(i < max_iter, jnp.squeeze(c) != 0)
+
+        def w_body(carry):
+            vals, i, k = carry
+            k, fk = jax.random.split(k)
+            outs, _ = ffn(pick(f_slots, vals), (), fk)
+            return (tuple(outs), i + 1, k)
+
+        fin_vals, _, _ = jax.lax.while_loop(
+            w_cond, w_body, (vs, jnp.int32(0), key))
+        return tuple(fin_vals)
 
     def body(carry, _):
         vals, active, k = carry

@@ -156,6 +156,32 @@ class Optimizer:
             wd *= self.wd_mult.get(self.idx2name[index], 1.0)
         return wd
 
+    def state_dict(self):
+        """Host-serializable snapshot of the optimizer's SCALAR state —
+        update counters and the LR-scheduler position (the tensors live
+        in `Updater.states` and travel as checkpoint shards).  What the
+        elastic checkpoint manifest records so a resumed run schedules
+        learning rates exactly where the interrupted one stopped."""
+        d = {"num_update": int(self.num_update),
+             "begin_num_update": int(self.begin_num_update),
+             "index_update_count": {str(k): int(v) for k, v in
+                                    self._index_update_count.items()}}
+        if self.lr_scheduler is not None:
+            d["lr_scheduler"] = self.lr_scheduler.state_dict()
+        return d
+
+    def load_state_dict(self, d):
+        self.num_update = int(d.get("num_update", self.num_update))
+        self.begin_num_update = int(d.get("begin_num_update",
+                                          self.begin_num_update))
+        counts = d.get("index_update_count")
+        if counts is not None:
+            self._index_update_count = {
+                (int(k) if str(k).lstrip("-").isdigit() else k): int(v)
+                for k, v in counts.items()}
+        if self.lr_scheduler is not None and d.get("lr_scheduler"):
+            self.lr_scheduler.load_state_dict(d["lr_scheduler"])
+
     def __getstate__(self):
         d = self.__dict__.copy()
         d.pop("param_dict", None)

@@ -452,6 +452,175 @@ def test_dist_sync_sharded_servers(tmp_path):
         second._state.store["big"].size == 40
 
 
+THREE_SERVER_WORKER = r"""
+import os, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+
+kv = mx.kv.create("dist_sync")
+rank, nw = kv.rank, kv.num_workers
+assert kv._num_servers == 3, kv._num_servers
+assert len(kv._chans) == 3
+
+# uneven key ranges: 40 elements over 3 servers -> bounds [0,13,26,40],
+# slice sizes 13/13/14 — every boundary crossed inside one array
+big = np.arange(40, dtype="f4").reshape(8, 5)
+shards = kv._shards("big", 40)
+sizes = [sl.stop - sl.start for _, sl in shards]
+assert sizes == [13, 13, 14], sizes
+assert [srv for srv, _ in shards] == [0, 1, 2]
+kv.init("big", nd.array(big * 0))
+kv.push("big", nd.array(big * (rank + 1)))
+out = nd.zeros((8, 5))
+kv.pull("big", out=out)
+tot = sum(r + 1 for r in range(nw))
+np.testing.assert_allclose(out.asnumpy(), big * tot)
+
+# several small keys: hashed placement must stay within the server set
+# and every round trip reassembles exactly
+for i, shape in enumerate([(3,), (2, 2), (7,), (5,)]):
+    k = "k%d" % i
+    kv.init(k, nd.zeros(shape))
+    kv.push(k, nd.ones(shape) * (rank + 1) * (i + 1))
+    o = nd.zeros(shape)
+    kv.pull(k, out=o)
+    np.testing.assert_allclose(o.asnumpy(), tot * (i + 1))
+
+# server-side optimizer over uneven ranges + state pull-back through the
+# control channel (the checkpoint plane's dist resume path)
+kv.init("w", nd.ones((40,)))
+kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                                  rescale_grad=1.0 / nw))
+kv.push("w", nd.ones((40,)) * (rank + 1))
+w = nd.zeros((40,))
+kv.pull("w", out=w)
+gm = tot / nw
+np.testing.assert_allclose(w.asnumpy(), 1.0 - 0.1 * gm, rtol=1e-5)
+blob = kv.get_optimizer_states(dump_optimizer=True)
+import pickle
+per_server = pickle.loads(blob)["dist_server_states"]
+assert set(per_server) == {0, 1, 2}
+# every server holds the momentum slots for exactly ITS range of "w"
+sizes = []
+for srv, s in sorted(per_server.items()):
+    states = pickle.loads(s)
+    states = states[0] if isinstance(states, tuple) else states
+    mom = states["w"]
+    sizes.append(int(mom.size))
+assert sorted(sizes) == [13, 13, 14], sizes
+# restore round-trips cleanly (rank 0 writes back, everyone barriers)
+kv.set_optimizer_states(blob)
+
+kv._barrier()
+kv.close()
+print("worker %d OK" % rank)
+"""
+
+
+def test_dist_sync_three_servers_uneven_ranges(tmp_path):
+    """num_servers=3 with UNEVEN key ranges (40 elements -> 13/13/14), a
+    big-array split crossing every server boundary, and server-side
+    optimizer state pulled back through the control channel — the dist
+    layout the elastic checkpoint resume path depends on."""
+    from incubator_mxnet_tpu.dist.server import (ParameterServer,
+                                                 register_with_root)
+
+    n_workers = 2
+    script = tmp_path / "worker3.py"
+    script.write_text(THREE_SERVER_WORKER)
+    root = ParameterServer(num_workers=n_workers, num_servers=3).start()
+    secondaries = []
+    for sid in (1, 2):
+        srv = ParameterServer(num_workers=n_workers, num_servers=3,
+                              port=0).start()
+        register_with_root("127.0.0.1", root.port, sid, "127.0.0.1",
+                           srv.port)
+        secondaries.append(srv)
+    env = dict(os.environ,
+               DMLC_PS_ROOT_URI="127.0.0.1",
+               DMLC_PS_ROOT_PORT=str(root.port),
+               DMLC_NUM_WORKER=str(n_workers),
+               DMLC_NUM_SERVER="3",
+               DMLC_ROLE="worker",
+               MXNET_KVSTORE_COLLECTIVE="0",
+               MXNET_KVSTORE_BIGARRAY_BOUND="16",
+               JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    procs = [subprocess.Popen([sys.executable, str(script)],
+                              env=dict(env, DMLC_RANK=str(r)),
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT)
+             for r in range(n_workers)]
+    outs = [p.communicate(timeout=240)[0].decode() for p in procs]
+    root.shutdown()
+    for srv in secondaries:
+        srv.shutdown()
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {r} failed:\n{out}"
+        assert f"worker {r} OK" in out
+    # all three servers held a range of the big keys
+    for key in ("big", "w"):
+        sizes = sorted(s._state.store[key].size
+                       for s in [root] + secondaries)
+        assert sizes == [13, 13, 14], (key, sizes)
+
+
+def test_dist_killed_server_surfaces_clean_error():
+    """A killed secondary server must surface as a clean MXNetError naming
+    the server, not a raw socket traceback (VERDICT Next #9): run the
+    secondary as a real subprocess and SIGKILL it mid-training."""
+    from incubator_mxnet_tpu.base import MXNetError
+    from incubator_mxnet_tpu.dist.server import ParameterServer
+    from incubator_mxnet_tpu.dist.kvstore_dist import KVStoreDist
+    from incubator_mxnet_tpu import nd
+
+    root = ParameterServer(num_workers=1, num_servers=2).start()
+    env = dict(os.environ, DMLC_SERVER_ID="1",
+               DMLC_PS_ROOT_URI="127.0.0.1",
+               DMLC_PS_ROOT_PORT=str(root.port),
+               JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "incubator_mxnet_tpu.dist.server"], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    old = {k: os.environ.get(k) for k in
+           ("DMLC_PS_ROOT_URI", "DMLC_PS_ROOT_PORT", "DMLC_RANK",
+            "DMLC_NUM_WORKER", "DMLC_NUM_SERVER", "MXNET_KVSTORE_COLLECTIVE",
+            "MXNET_KVSTORE_BIGARRAY_BOUND")}
+    os.environ.update(DMLC_PS_ROOT_URI="127.0.0.1",
+                      DMLC_PS_ROOT_PORT=str(root.port), DMLC_RANK="0",
+                      DMLC_NUM_WORKER="1", DMLC_NUM_SERVER="2",
+                      MXNET_KVSTORE_COLLECTIVE="0",
+                      MXNET_KVSTORE_BIGARRAY_BOUND="16")
+    try:
+        kv = KVStoreDist("dist_sync")
+        kv.init("w", nd.ones((30,)))
+        kv.push("w", nd.ones((30,)))
+        out = nd.zeros((30,))
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(), 1.0)
+
+        proc.kill()
+        proc.wait(timeout=30)
+        with pytest.raises(MXNetError, match="parameter server 1 .* is "
+                                             "unreachable"):
+            kv.push("w", nd.ones((30,)))
+            kv.pull("w", out=out)
+        kv.close()
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        if proc.poll() is None:
+            proc.kill()
+        root.shutdown()
+
+
 def test_server_profiler_commands(tmp_path):
     """profiler.set_config/set_state/dump(profile_process='server') drive
     the parameter server's profiler over the control channel (reference

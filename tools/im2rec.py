@@ -1,227 +1,222 @@
 #!/usr/bin/env python
-"""Build .lst / .rec image databases (reference `tools/im2rec.py` +
-`tools/im2rec.cc`): list mode walks an image directory into a
-`index\\tlabel\\tpath` .lst file; pack mode encodes the listed images into
-an indexed RecordIO pair (.rec + .idx) the `ImageRecordIter` consumes.
+"""Build .lst / .rec image databases.
 
-The byte format is the reference's exactly (recordio.pack_img headers),
-so .rec files interchange in both directions.  Threaded encode: cv2
-decode/encode releases the GIL, so --num-thread scales on multi-core
-hosts (the reference uses a process pool for the same reason).
+Same CLI and byte formats as the classic tool (list mode emits
+``index\\tlabel...\\tpath`` .lst files; pack mode emits an indexed RecordIO
+pair the `ImageRecordIter` consumes, headers via `recordio.pack_img`, so
+.rec files interchange in both directions) — implementation is this
+repo's own: a scandir-based walker, numpy-seeded deterministic shuffling,
+and a ThreadPoolExecutor encode pool with an in-order writer (cv2
+releases the GIL, so threads scale across cores without the process-pool
+plumbing).
 """
 from __future__ import annotations
 
 import argparse
 import os
-import queue
-import random
 import sys
-import threading
 import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-
-def list_image(root, recursive, exts):
-    """Yield (index, relpath, label) — label = folder index in recursive
-    mode (the reference's convention), 0 otherwise."""
-    i = 0
-    if recursive:
-        cat = {}
-        for path, dirs, files in sorted(os.walk(root, followlinks=True)):
-            dirs.sort()
-            files.sort()
-            for fname in files:
-                fpath = os.path.join(path, fname)
-                suffix = os.path.splitext(fname)[1].lower()
-                if os.path.isfile(fpath) and suffix in exts:
-                    if path not in cat:
-                        cat[path] = len(cat)
-                    yield (i, os.path.relpath(fpath, root), cat[path])
-                    i += 1
-        for k, v in sorted(cat.items(), key=lambda kv: kv[1]):
-            print(os.path.relpath(k, root), v)
-    else:
-        for fname in sorted(os.listdir(root)):
-            fpath = os.path.join(root, fname)
-            suffix = os.path.splitext(fname)[1].lower()
-            if os.path.isfile(fpath) and suffix in exts:
-                yield (i, fname, 0)
-                i += 1
+_SHUFFLE_SEED = 100   # classic tool contract: same inputs -> same listing
 
 
-def write_list(path_out, image_list):
-    with open(path_out, "w") as fout:
-        for i, item in enumerate(image_list):
-            line = "%d\t" % item[0]
-            for j in item[2:]:
-                line += "%f\t" % j
-            line += "%s\n" % item[1]
-            fout.write(line)
+def scan_images(root, recursive, exts):
+    """[(index, relpath, label)] under `root`; in recursive mode the label
+    is the sorted-walk folder index (returned as the second element)."""
+    exts = {e.lower() for e in exts}
+
+    def keep(entry):
+        return entry.is_file() and \
+            os.path.splitext(entry.name)[1].lower() in exts
+
+    rows, categories = [], {}
+    if not recursive:
+        with os.scandir(root) as it:
+            names = sorted(e.name for e in it if keep(e))
+        rows = [(i, name, 0) for i, name in enumerate(names)]
+        return rows, categories
+
+    stack = [root]
+    while stack:
+        here = stack.pop()
+        subdirs, files = [], []
+        with os.scandir(here) as it:
+            for entry in it:
+                if entry.is_dir(follow_symlinks=True):
+                    subdirs.append(entry.path)
+                elif keep(entry):
+                    files.append(entry.path)
+        # depth-first in reverse-sorted stack order == sorted overall walk
+        stack.extend(sorted(subdirs, reverse=True))
+        if files:
+            label = categories.setdefault(os.path.relpath(here, root),
+                                          len(categories))
+            rows.extend((0, os.path.relpath(f, root), label)
+                        for f in sorted(files))
+    rows = [(i, rel, label) for i, (_, rel, label) in enumerate(rows)]
+    return rows, categories
 
 
-def make_list(args):
-    image_list = list(list_image(args.root, args.recursive, args.exts))
+def write_listing(path, rows):
+    """One ``index\\tlabel...\\tpath`` line per row — the .lst byte format
+    every consumer of the classic tool expects (labels as %f)."""
+    with open(path, "w") as out:
+        for row in rows:
+            labels = "".join("%f\t" % field for field in row[2:])
+            out.write("%d\t%s%s\n" % (row[0], labels, row[1]))
+
+
+def build_lists(args):
+    rows, categories = scan_images(args.root, args.recursive, args.exts)
+    for name, label in sorted(categories.items(), key=lambda kv: kv[1]):
+        print(name, label)
     if args.shuffle:
-        random.seed(100)
-        random.shuffle(image_list)
-    n = len(image_list)
-    chunk_size = (n + args.chunks - 1) // args.chunks
-    for i in range(args.chunks):
-        chunk = image_list[i * chunk_size:(i + 1) * chunk_size]
-        str_chunk = "_%d" % i if args.chunks > 1 else ""
-        sep = int(chunk_size * args.train_ratio)
-        sep_test = int(chunk_size * args.test_ratio)
+        order = np.random.RandomState(_SHUFFLE_SEED).permutation(len(rows))
+        rows = [rows[i] for i in order]
+    per_chunk = (len(rows) + args.chunks - 1) // max(args.chunks, 1)
+    for c in range(args.chunks):
+        chunk = rows[c * per_chunk:(c + 1) * per_chunk]
+        tag = "_%d" % c if args.chunks > 1 else ""
+        n_test = int(per_chunk * args.test_ratio)
+        n_train = int(per_chunk * args.train_ratio)
         if args.train_ratio == 1.0:
-            write_list(args.prefix + str_chunk + ".lst", chunk)
-        else:
-            if args.test_ratio:
-                write_list(args.prefix + str_chunk + "_test.lst",
-                           chunk[:sep_test])
-            if args.train_ratio + args.test_ratio < 1.0:
-                write_list(args.prefix + str_chunk + "_val.lst",
-                           chunk[sep_test + sep:])
-            write_list(args.prefix + str_chunk + "_train.lst",
-                       chunk[sep_test:sep_test + sep])
+            write_listing(args.prefix + tag + ".lst", chunk)
+            continue
+        if n_test:
+            write_listing(args.prefix + tag + "_test.lst", chunk[:n_test])
+        write_listing(args.prefix + tag + "_train.lst",
+                      chunk[n_test:n_test + n_train])
+        if args.train_ratio + args.test_ratio < 1.0:
+            write_listing(args.prefix + tag + "_val.lst",
+                          chunk[n_test + n_train:])
 
 
-def read_list(path_in):
-    with open(path_in) as fin:
-        while True:
-            line = fin.readline()
-            if not line:
-                break
-            line = [i.strip() for i in line.strip().split("\t")]
-            line_len = len(line)
-            if line_len < 3:
-                print("lst should have at least has three parts, but only "
-                      "has %s parts for %s" % (line_len, line))
+def parse_listing(path):
+    """Rows back out of a .lst: (index, relpath, label...).  Malformed
+    lines are reported and dropped, never fatal — a million-image listing
+    should not die on one bad row."""
+    rows = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            fields = [t.strip() for t in line.rstrip("\n").split("\t")]
+            if len(fields) < 3:
+                print("%s:%d: expected 'index\\tlabel\\tpath', got %r — "
+                      "skipped" % (path, lineno, line.rstrip()))
                 continue
             try:
-                item = [int(line[0])] + [line[-1]] + \
-                    [float(i) for i in line[1:-1]]
-            except Exception as e:
-                print("Parsing lst met error for %s, detail: %s"
-                      % (line, e))
-                continue
-            yield item
+                rows.append([int(float(fields[0])), fields[-1]] +
+                            [float(t) for t in fields[1:-1]])
+            except ValueError as exc:
+                print("%s:%d: unparseable row (%s) — skipped"
+                      % (path, lineno, exc))
+    return rows
 
 
-def image_encode(args, i, item, q_out):
-    """Read + (resize/crop) + encode one image; enqueue the packed record."""
+def _square_center(img):
+    h, w = img.shape[:2]
+    side = min(h, w)
+    top, left = (h - side) // 2, (w - side) // 2
+    return img[top:top + side, left:left + side]
+
+
+def load_and_encode(args, row):
+    """One listing row -> packed record bytes, or None on a bad image."""
     import cv2
     from incubator_mxnet_tpu import recordio
 
-    fullpath = os.path.join(args.root, item[1])
-    if len(item) > 3 and args.pack_label:
-        header = recordio.IRHeader(0, item[2:], item[0], 0)
-    else:
-        header = recordio.IRHeader(0, item[2], item[0], 0)
+    path = os.path.join(args.root, row[1])
+    label = row[2:] if (args.pack_label and len(row) > 3) else row[2]
+    header = recordio.IRHeader(0, label, row[0], 0)
 
     if args.pass_through:
         try:
-            with open(fullpath, "rb") as fin:
-                img = fin.read()
-            s = recordio.pack(header, img)
-            q_out.put((i, s, item))
-        except Exception as e:
-            print("pack_img error:", item[1], e)
-            q_out.put((i, None, item))
-        return
+            with open(path, "rb") as f:
+                return recordio.pack(header, f.read())
+        except OSError as exc:
+            print("cannot read %s: %s" % (path, exc))
+            return None
 
-    flag = {1: cv2.IMREAD_COLOR, 0: cv2.IMREAD_GRAYSCALE,
-            -1: cv2.IMREAD_UNCHANGED}[args.color]
-    img = cv2.imread(fullpath, flag)
+    modes = {1: cv2.IMREAD_COLOR, 0: cv2.IMREAD_GRAYSCALE,
+             -1: cv2.IMREAD_UNCHANGED}
+    img = cv2.imread(path, modes[args.color])
     if img is None:
-        print("imread read blank (None) image for file: %s" % fullpath)
-        q_out.put((i, None, item))
-        return
+        print("cannot decode %s — skipped" % path)
+        return None
     if args.center_crop:
-        if img.shape[0] > img.shape[1]:
-            margin = (img.shape[0] - img.shape[1]) // 2
-            img = img[margin:margin + img.shape[1], :]
-        else:
-            margin = (img.shape[1] - img.shape[0]) // 2
-            img = img[:, margin:margin + img.shape[0]]
-    if args.resize:
-        import cv2 as _cv2
-        if img.shape[0] > img.shape[1]:
-            newsize = (args.resize,
-                       img.shape[0] * args.resize // img.shape[1])
-        else:
-            newsize = (img.shape[1] * args.resize // img.shape[0],
-                       args.resize)
-        img = _cv2.resize(img, newsize)
+        img = _square_center(img)
+    if args.resize and min(img.shape[:2]) != args.resize:
+        h, w = img.shape[:2]
+        scale = args.resize / min(h, w)
+        img = cv2.resize(img, (max(1, round(w * scale)),
+                               max(1, round(h * scale))))
     try:
-        from incubator_mxnet_tpu import recordio as _rec
-        s = _rec.pack_img(header, img, quality=args.quality,
-                          img_fmt=args.encoding)
-        q_out.put((i, s, item))
-    except Exception as e:
-        print("pack_img error on file: %s" % fullpath, e)
-        q_out.put((i, None, item))
+        return recordio.pack_img(header, img, quality=args.quality,
+                                 img_fmt=args.encoding)
+    except Exception as exc:
+        print("encode failed for %s: %r" % (path, exc))
+        return None
 
 
-def make_record(args, lst_path):
-    """Pack one .lst into .rec + .idx with a thread pool + in-order
-    writer (the reference's read_worker/write_worker shape)."""
+def pack_records(args, lst_path):
+    """Encode one listing into .rec + .idx: a thread pool races ahead on
+    decode/encode while the single writer commits records in listing
+    order (the index must match the .lst)."""
     from incubator_mxnet_tpu import recordio
 
-    items = list(read_list(lst_path))
-    fname = os.path.basename(lst_path)
-    base = os.path.splitext(fname)[0]
-    rec_path = os.path.join(args.working_dir or os.path.dirname(lst_path),
-                            base + ".rec")
-    idx_path = os.path.join(args.working_dir or os.path.dirname(lst_path),
-                            base + ".idx")
-    record = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
-
-    q_out = queue.Queue(maxsize=args.num_thread * 8)
-    job_q = queue.Queue()
-    for i, item in enumerate(items):
-        job_q.put((i, item))
-
-    def worker():
-        while True:
-            try:
-                i, item = job_q.get_nowait()
-            except queue.Empty:
-                return
-            try:
-                image_encode(args, i, item, q_out)
-            except Exception as e:
-                # the writer loop blocks on one sentinel per job: a dead
-                # worker without this enqueue would hang the tool forever
-                print("encode error on %s: %r" % (item[1], e))
-                q_out.put((i, None, item))
-
-    threads = [threading.Thread(target=worker, daemon=True)
-               for _ in range(max(args.num_thread, 1))]
-    for t in threads:
-        t.start()
-
+    rows = parse_listing(lst_path)
+    out_dir = args.working_dir or os.path.dirname(lst_path)
+    stem = os.path.splitext(os.path.basename(lst_path))[0]
+    writer = recordio.MXIndexedRecordIO(os.path.join(out_dir, stem + ".idx"),
+                                        os.path.join(out_dir, stem + ".rec"),
+                                        "w")
+    written = 0
     tic = time.time()
-    buf = {}
-    count = 0
-    for _ in range(len(items)):
-        i, s, item = q_out.get()
-        buf[i] = (s, item)
-        while count in buf:
-            s2, item2 = buf.pop(count)
-            if s2 is not None:
-                record.write_idx(item2[0], s2)
-            if count % 1000 == 0 and count > 0:
-                print("time: %f count: %d" % (time.time() - tic, count))
+    threads = max(args.num_thread, 1)
+    # bounded submission window: encoders may run at most window records
+    # ahead of the in-order writer, so a slow disk never lets a million
+    # encoded JPEGs pile up in RAM
+    window = threads * 8
+    pending = deque()
+
+    def drain_one():
+        nonlocal written, tic
+        row, future = pending.popleft()
+        try:
+            packed = future.result()
+        except Exception as exc:
+            # one undecodable/oversized image must never abort a
+            # million-image pack — report it and keep writing
+            print("skipping %s: %r" % (row[1], exc))
+            packed = None
+        if packed is not None:
+            writer.write_idx(row[0], packed)
+            written += 1
+            if written % 1000 == 0:
+                print("packed %d records (%.1fs)" % (written,
+                                                     time.time() - tic))
                 tic = time.time()
-            count += 1
-    record.close()
-    print("wrote %d records to %s" % (count, rec_path))
+
+    with ThreadPoolExecutor(max_workers=threads) as pool:
+        for row in rows:
+            pending.append((row, pool.submit(load_and_encode, args, row)))
+            if len(pending) >= window:
+                drain_one()
+        while pending:
+            drain_one()
+    writer.close()
+    print("wrote %d records to %s" % (written,
+                                      os.path.join(out_dir, stem + ".rec")))
 
 
 def parse_args():
     parser = argparse.ArgumentParser(
-        description="Create an image list or an indexed RecordIO database "
-                    "(reference tools/im2rec.py).")
+        description="Create an image list or an indexed RecordIO database.")
     parser.add_argument("prefix",
                         help="prefix of input/output lst and rec files")
     parser.add_argument("root", help="path to folder containing images")
@@ -256,20 +251,22 @@ def parse_args():
 def main():
     args = parse_args()
     if args.list:
-        make_list(args)
-        return
-    d = os.path.dirname(os.path.abspath(args.prefix))
-    files = [os.path.join(d, f) for f in os.listdir(d or ".")
-             if f.startswith(os.path.basename(args.prefix)) and
-             f.endswith(".lst")]
-    if not files:
-        print("no .lst files found with prefix %s; run --list first"
+        build_lists(args)
+        return 0
+    base_dir = os.path.dirname(os.path.abspath(args.prefix)) or "."
+    stem = os.path.basename(args.prefix)
+    listings = sorted(os.path.join(base_dir, name)
+                      for name in os.listdir(base_dir)
+                      if name.startswith(stem) and name.endswith(".lst"))
+    if not listings:
+        print("no .lst files match prefix %r — generate one with --list"
               % args.prefix)
-        sys.exit(1)
-    for lst in sorted(files):
-        print("Creating .rec file from", lst)
-        make_record(args, lst)
+        return 1
+    for lst in listings:
+        print("packing", lst)
+        pack_records(args, lst)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -1,10 +1,15 @@
 #!/usr/bin/env python
-"""Run the on-chip registry parity battery (tests_tpu/) and emit a
-driver-visible artifact `TPU_PARITY_r<N>.json` with pass/fail/skip counts
-(reference pattern: `tests/python/gpu/test_operator_gpu.py` re-running the
-CPU suite on the device).
+"""Run the on-chip registry parity battery (tests_tpu/) and commit the
+evidence: a per-round ``TPU_PARITY_r<NN>.json`` artifact with pass/fail/
+skip counts, per-test outcomes, the git revision, and the backend that
+actually ran — so on-chip parity claims are checkable artifacts in the
+repo, not commit-message assertions.
 
 Usage: python tools/run_tpu_parity.py [round_number]
+
+Without an argument the round auto-increments past the highest committed
+``TPU_PARITY_r*.json``.  The artifact is written even when the battery
+fails — a red round is evidence too.
 """
 from __future__ import annotations
 
@@ -16,35 +21,85 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ARTIFACT_RE = re.compile(r"^TPU_PARITY_r(\d+)\.json$")
+
+
+def next_round():
+    rounds = [int(m.group(1)) for name in os.listdir(REPO)
+              if (m := _ARTIFACT_RE.match(name))]
+    return max(rounds, default=0) + 1
+
+
+def git_revision():
+    try:
+        out = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                             cwd=REPO, capture_output=True, text=True,
+                             timeout=10)
+        return out.stdout.strip() or None
+    except Exception:
+        return None
+
+
+def probe_backend():
+    """Backend/device census from a throwaway process (importing jax here
+    would pin THIS process's platform before pytest gets a say)."""
+    probe = ("import jax, json; "
+             "print(json.dumps({'backend': jax.default_backend(), "
+             "'device_count': jax.device_count(), "
+             "'device_kind': jax.devices()[0].device_kind}))")
+    try:
+        out = subprocess.run([sys.executable, "-c", probe], cwd=REPO,
+                             capture_output=True, text=True, timeout=120)
+        return json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception as exc:
+        return {"error": f"backend probe failed: {exc!r}"}
+
+
+def parse_outcomes(output):
+    """Counts + per-test outcomes from a ``-q -rA`` pytest run."""
+    counts = {"passed": 0, "failed": 0, "skipped": 0, "errors": 0}
+    words = {"passed": "passed", "failed": "failed", "skipped": "skipped",
+             "errors": "errors?"}
+    for key, word in words.items():
+        m = re.search(r"(\d+) %s\b" % word, output)
+        if m:
+            counts[key] = int(m.group(1))
+    tests = []
+    for line in output.splitlines():
+        m = re.match(r"^(PASSED|FAILED|ERROR|SKIPPED|XFAIL|XPASS)\s+(\S+)",
+                     line)
+        if m:
+            tests.append({"outcome": m.group(1).lower(),
+                          "test": m.group(2)})
+    return counts, tests
 
 
 def main():
-    rnd = sys.argv[1] if len(sys.argv) > 1 else "04"
+    rnd = "%02d" % (int(sys.argv[1]) if len(sys.argv) > 1 else next_round())
     t0 = time.time()
-    proc = subprocess.run(
-        [sys.executable, "-m", "pytest", "tests_tpu", "-q", "--tb=line",
-         "-p", "no:cacheprovider"],
-        cwd=REPO, capture_output=True, text=True, timeout=3000)
-    out = proc.stdout + proc.stderr
-    counts = {"passed": 0, "failed": 0, "skipped": 0, "errors": 0}
-    for key in counts:
-        m = re.search(rf"(\d+) {key[:-1] if key != 'errors' else 'error'}",
-                      out)
-        if m:
-            counts[key] = int(m.group(1))
-    tail = "\n".join(out.strip().splitlines()[-12:])
+    cmd = [sys.executable, "-m", "pytest", "tests_tpu", "-q", "-rA",
+           "--tb=line", "-p", "no:cacheprovider"]
+    proc = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                          timeout=3000)
+    output = proc.stdout + proc.stderr
+    counts, tests = parse_outcomes(output)
     artifact = {
         "round": rnd,
         "rc": proc.returncode,
         **counts,
         "duration_s": round(time.time() - t0, 1),
-        "cmd": "python -m pytest tests_tpu -q",
-        "tail": tail[-2000:],
+        "git_rev": git_revision(),
+        "jax": probe_backend(),
+        "cmd": " ".join(cmd[2:]),
+        "tests": tests[:500],
+        "tail": "\n".join(output.strip().splitlines()[-12:])[-2000:],
     }
     path = os.path.join(REPO, f"TPU_PARITY_r{rnd}.json")
     with open(path, "w") as f:
         json.dump(artifact, f, indent=1)
-    print(json.dumps({k: v for k, v in artifact.items() if k != "tail"}))
+    print(json.dumps({k: v for k, v in artifact.items()
+                      if k not in ("tail", "tests")}))
+    print("artifact:", path)
     return 0 if proc.returncode == 0 else 1
 
 
