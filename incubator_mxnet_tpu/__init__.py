@@ -19,6 +19,7 @@ __version__ = "0.1.0"
 
 from .attribute import AttrScope
 from .base import MXNetError
+from . import analysis
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus, num_tpus
 from . import config
 from . import engine

@@ -1,0 +1,99 @@
+"""`mxlint` — pass-based static & trace analysis for TPU hazards.
+
+Two front ends over one Finding/Report currency (findings.py):
+
+* **graph passes** (graph_passes.py) — topo-ordered analyses over
+  `Symbol` or saved symbol JSON: duplicate/empty names, dead outputs,
+  aux-state races, f64 promotion, unbound inputs, TPU tile-alignment
+  hints.  Reach them via `analysis.check(sym)`, `Module.check()`, or the
+  `tools/mxlint.py` CLI.
+
+* **trace passes** — runtime-adjacent, wired into the data plane:
+  - donation.py: names the parameter whose buffer a donated fused step
+    consumed when something reads it afterwards (replaces the opaque
+    PJRT "Array has been deleted" death);
+  - recompile.py: audits every new jit signature of the fused train
+    programs and diagnoses shape churn (ragged final batches);
+  - hostsync.py: attributes blocking `asnumpy`/`asscalar`/
+    `wait_to_read` calls inside `Module.fit` / `Trainer.step` loops to
+    the source line that asked for them;
+  - source_lint.py: the same hazards found statically in a script's AST
+    (the CLI's `.py` front end).
+
+Runtime passes activate with ``MXNET_ANALYSIS=1`` (or
+`analysis.enable()`); collected findings are read via
+`analysis.runtime_report()`.  Donation-error translation and
+recompilation recording are always on — they cost nothing on the happy
+path.
+"""
+from __future__ import annotations
+
+__all__ = ["check", "check_json", "check_source", "check_source_file",
+           "enable", "disable", "enabled", "runtime_report",
+           "reset_runtime", "Finding", "Report"]
+
+from .findings import Finding, Report, ERROR, WARN, HINT  # noqa: F401
+from . import donation  # noqa: F401
+from . import hostsync  # noqa: F401
+from . import recompile  # noqa: F401
+
+_enabled = None  # tri-state: None = read MXNET_ANALYSIS lazily
+
+
+def enabled():
+    """Whether the runtime trace passes are active."""
+    global _enabled
+    if _enabled is None:
+        from .. import config as _config
+        _enabled = bool(_config.get("MXNET_ANALYSIS"))
+    return _enabled
+
+
+def enable():
+    """Turn the runtime trace passes on (programmatic MXNET_ANALYSIS=1)."""
+    global _enabled
+    _enabled = True
+
+
+def disable():
+    global _enabled
+    _enabled = False
+
+
+def check(symbol, shapes=None, hints=True, target=None):
+    """Run the static graph-pass catalog over a Symbol -> Report."""
+    from . import graph_passes
+    return graph_passes.check(symbol, shapes=shapes, hints=hints,
+                              target=target)
+
+
+def check_json(text, shapes=None, hints=True, target=None):
+    """Analyze a saved symbol JSON string -> Report."""
+    from . import graph_passes
+    return graph_passes.check_json(text, shapes=shapes, hints=hints,
+                                   target=target)
+
+
+def check_source(text, filename="<string>"):
+    """AST-lint python training-script source -> Report."""
+    from . import source_lint
+    return source_lint.scan_source(text, filename=filename)
+
+
+def check_source_file(path):
+    from . import source_lint
+    return source_lint.scan_file(path)
+
+
+def runtime_report():
+    """Everything the runtime trace passes collected so far (host syncs
+    in hot loops, recompilation churn) as one Report."""
+    report = Report(target="runtime")
+    report.extend(hostsync.findings())
+    report.extend(recompile.findings())
+    return report
+
+
+def reset_runtime():
+    hostsync.reset()
+    recompile.reset()

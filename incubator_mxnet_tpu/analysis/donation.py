@@ -1,0 +1,126 @@
+"""Use-after-donation tracking.
+
+The fused train paths donate every persistent buffer (weights, optimizer
+state, aux states) to the XLA program each step — after dispatch the OLD
+`jax.Array`s are deleted, and any read through a stale NDArray used to
+die as an opaque PJRT "Array has been deleted" error (or was only caught
+by the ad-hoc probe this module replaces, formerly
+`fused._donated_invalidated`).  This tracker gives those failures names:
+
+* `record(...)` registers the donated leaves of each named pytree with
+  the step that consumed them (weakrefs — deleted arrays are still live
+  Python objects, so the registry entry survives exactly as long as the
+  stale wrapper that could be misread);
+* `explain(arr)` answers "whose buffer was this, and which step ate it";
+* `consumed(...)` / `raise_if_consumed(...)` are the post-dispatch triage
+  used by the fused paths: when a failed dispatch already consumed the
+  buffers, falling back to eager would replay onto deleted arrays — the
+  error must name the parameter, not fall back.
+
+Registration of every step's ~N·leaves is gated on `analysis.enabled()`
+(MXNET_ANALYSIS=1); the translation of deleted-buffer reads into
+`MXNetError` is always on (it costs nothing on the happy path — the
+check runs only inside exception handlers).
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+
+from ..base import MXNetError
+
+__all__ = ["record", "explain", "consumed", "raise_if_consumed",
+           "any_deleted", "is_deleted"]
+
+_lock = threading.Lock()
+# id(jax.Array) -> (weakref to the array, owner name, step description).
+# The weakref's callback removes the entry, so ids never dangle onto a
+# recycled object.
+_registry = {}
+
+
+def _leaves(tree):
+    import jax
+    return jax.tree_util.tree_leaves(tree)
+
+
+def is_deleted(arr):
+    """True when `arr` is a jax array whose buffer a donation consumed."""
+    try:
+        fn = getattr(arr, "is_deleted", None)
+        return bool(fn and fn())
+    except Exception:
+        return False
+
+
+def record(step_desc, named_trees):
+    """Register the leaves of each (owner_name, pytree) as donated by
+    `step_desc` (e.g. ``"FusedTrainStep step 42"``)."""
+    with _lock:
+        for name, tree in named_trees:
+            for leaf in _leaves(tree):
+                key = id(leaf)
+                try:
+                    ref = weakref.ref(
+                        leaf, lambda _r, _k=key: _registry.pop(_k, None))
+                except TypeError:
+                    continue  # not weakref-able (host numpy buffer etc.)
+                _registry[key] = (ref, name, step_desc)
+
+
+def explain(arr):
+    """Human message for a deleted buffer: the owning parameter and the
+    consuming step when tracked, generic donation guidance otherwise.
+    Returns None when `arr` is not a deleted jax array."""
+    if not is_deleted(arr):
+        return None
+    with _lock:
+        rec = _registry.get(id(arr))
+        rec = rec if rec is not None and rec[0]() is arr else None
+    if rec is not None:
+        _, name, step_desc = rec
+        return (f"use-after-donation: the buffer of '{name}' was donated "
+                f"to {step_desc} and no longer holds data. Read current "
+                "values through the public APIs (Module.get_params / "
+                "get_outputs, Trainer), which flush the fused step's "
+                "pending results first.")
+    return ("use-after-donation: this buffer was deleted, most likely by "
+            "donation to a fused XLA train step. Read current values "
+            "through the public APIs (Module.get_params / get_outputs, "
+            "Trainer), which flush pending fused results first; set "
+            "MXNET_ANALYSIS=1 to track donations by parameter name.")
+
+
+def any_deleted(*trees):
+    """True when any jax-array leaf in the given pytrees was deleted by a
+    donating dispatch (the probe formerly at fused._donated_invalidated)."""
+    for t in trees:
+        for leaf in _leaves(t):
+            if is_deleted(leaf):
+                return True
+    return False
+
+
+def consumed(named_trees):
+    """Names whose pytree contains at least one donated-and-deleted leaf."""
+    hit = []
+    for name, tree in named_trees:
+        if any(is_deleted(leaf) for leaf in _leaves(tree)):
+            hit.append(name)
+    return hit
+
+
+def raise_if_consumed(kind, exc, named_trees):
+    """Post-dispatch failure triage for the fused paths: when the donating
+    dispatch already consumed persistent buffers, raise an `MXNetError`
+    NAMING them (an eager fallback would replay onto deleted arrays and
+    leave training state unrecoverable).  Returns when a fallback is safe
+    (all buffers intact)."""
+    names = consumed(named_trees)
+    if names:
+        shown = ", ".join(repr(n) for n in names[:8])
+        more = f" (+{len(names) - 8} more)" if len(names) > 8 else ""
+        raise MXNetError(
+            f"{kind} failed AFTER its donating dispatch consumed the "
+            f"buffers of {shown}{more}; training state is unrecoverable — "
+            f"restart from a checkpoint (cause: {str(exc)[:300]})") from exc

@@ -1,0 +1,105 @@
+"""Finding/Report: the result currency of every analysis pass.
+
+Analysis results are plain data — a pass never prints, raises, or mutates
+the graph; it returns `Finding`s and the caller (CLI, `Module.check`,
+the MXNET_ANALYSIS runtime report) decides how to surface them.  This is
+the pass-infrastructure stance TVM and TF's grappler take (PAPERS.md):
+analyses compose because their only output is a report.
+"""
+from __future__ import annotations
+
+__all__ = ["Finding", "Report", "ERROR", "WARN", "HINT"]
+
+# severity ladder: errors break runs, warnings are correctness hazards,
+# hints are perf advisories (padded-tile waste etc.) that a clean example
+# graph may legitimately carry
+ERROR = "error"
+WARN = "warn"
+HINT = "hint"
+
+_SEV_RANK = {ERROR: 0, WARN: 1, HINT: 2}
+
+
+class Finding:
+    """One diagnostic: what pass fired, where, and why."""
+
+    __slots__ = ("pass_name", "code", "severity", "message", "node",
+                 "location", "count")
+
+    def __init__(self, pass_name, code, severity, message, node=None,
+                 location=None):
+        self.pass_name = pass_name    # e.g. "graph.names", "trace.hostsync"
+        self.code = code              # stable slug, e.g. "duplicate-name"
+        self.severity = severity      # ERROR | WARN | HINT
+        self.message = message
+        self.node = node              # graph node name, when graph-scoped
+        self.location = location      # "file:line" when source-scoped
+        self.count = 1                # occurrences (hostsync dedupes here)
+
+    def format(self):
+        where = self.location or (f"node '{self.node}'" if self.node else "")
+        times = f" (x{self.count})" if self.count > 1 else ""
+        head = f"{where}: " if where else ""
+        return f"{head}{self.severity} [{self.code}] {self.message}{times}"
+
+    def __repr__(self):
+        return f"<Finding {self.format()}>"
+
+    def as_dict(self):
+        return {"pass": self.pass_name, "code": self.code,
+                "severity": self.severity, "message": self.message,
+                "node": self.node, "location": self.location,
+                "count": self.count}
+
+
+class Report:
+    """An ordered collection of findings with filtering/summary helpers."""
+
+    def __init__(self, findings=(), target=None):
+        self.findings = list(findings)
+        self.target = target  # what was analyzed (symbol name, file, ...)
+
+    def add(self, finding):
+        self.findings.append(finding)
+
+    def extend(self, findings):
+        self.findings.extend(findings)
+
+    def __iter__(self):
+        return iter(self.findings)
+
+    def __len__(self):
+        return len(self.findings)
+
+    def __bool__(self):
+        return bool(self.findings)
+
+    def filter(self, max_severity=HINT, codes=None):
+        """Findings at or above a severity (ERROR < WARN < HINT ordering),
+        optionally restricted to a code set."""
+        keep = [f for f in self.findings
+                if _SEV_RANK[f.severity] <= _SEV_RANK[max_severity]
+                and (codes is None or f.code in codes)]
+        return Report(keep, target=self.target)
+
+    def suppress(self, codes):
+        """Drop findings whose code is in `codes` (CLI --suppress)."""
+        codes = set(codes)
+        return Report([f for f in self.findings if f.code not in codes],
+                      target=self.target)
+
+    def by_code(self):
+        out = {}
+        for f in self.findings:
+            out[f.code] = out.get(f.code, 0) + 1
+        return out
+
+    def by_pass(self):
+        out = {}
+        for f in self.findings:
+            out[f.pass_name] = out.get(f.pass_name, 0) + 1
+        return out
+
+    def format(self):
+        prefix = f"{self.target}: " if self.target else ""
+        return "\n".join(prefix + f.format() for f in self.findings)

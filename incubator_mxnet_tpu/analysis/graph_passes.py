@@ -1,0 +1,456 @@
+"""Static graph passes over `Symbol` (and saved symbol JSON).
+
+Topo-ordered analyses in the TVM/grappler pass mold: each pass walks the
+graph once and returns findings, no mutation.  The catalog:
+
+* ``graph.names``  — duplicate node names (distinct nodes sharing a name
+  silently shadow each other in `tojson` / `arg_dict`), empty names.
+* ``graph.dead``   — outputs of multi-output ops that no node consumes
+  and no head exposes: computed, shipped through XLA, thrown away.
+* ``graph.aux``    — aux-state hazards: one running-stat variable feeding
+  the aux slots of several ops (racing writers), or an aux variable also
+  consumed as a regular input.
+* ``graph.dtype``  — float64 introduction: explicit f64 variables/casts
+  (TPUs have no f64 ALU; XLA emulates slowly or demotes), plus which
+  graph outputs the promotion reaches when shapes allow inference.
+* ``graph.unbound``— variables whose shape can be inferred neither from
+  the provided input shapes nor from op attrs (bind will fail there).
+* ``graph.layout`` — TPU tiling hints: channel/feature dims that are not
+  multiples of 8 (sublane) / 128 (lane) pad to the next tile and waste
+  MXU throughput.  Hint severity: advisory, not a defect.
+
+Per-node suppression: set the ``__lint__`` attr on a Variable/op to
+``"off"`` (suppress everything on that node) or a comma list of codes,
+e.g. ``attr={"__lint__": "tpu-layout,dead-output"}``.
+"""
+from __future__ import annotations
+
+import json as _json
+
+import numpy as _np
+
+from ..base import np_dtype
+from .findings import Finding, Report, ERROR, WARN, HINT
+
+__all__ = ["check", "check_json", "PASS_CATALOG"]
+
+PASS_CATALOG = {
+    "graph.names": ("duplicate-name", "empty-name"),
+    "graph.dead": ("dead-output",),
+    "graph.aux": ("shared-aux", "aux-as-input", "unreachable-node"),
+    "graph.dtype": ("f64-promotion", "f64-output"),
+    "graph.unbound": ("unbound-input",),
+    "graph.layout": ("tpu-layout",),
+}
+
+# feature/channel attrs per op for the layout pass
+_FEATURE_ATTRS = {
+    "FullyConnected": ("num_hidden", "num_hidden"),
+    "Convolution": ("num_filter", "num_filter"),
+    "Deconvolution": ("num_filter", "num_filter"),
+    "Embedding": ("output_dim", "output_dim"),
+    "RNN": ("state_size", "state_size"),
+}
+
+# multi-output ops whose trailing outputs are optional state taps the
+# caller may legitimately ignore: op name -> index of the first optional
+# output (int, or a callable over the node attrs)
+_OPTIONAL_TAIL_OUTPUTS = {
+    "RNN": 1,
+    # control-flow ops: outputs past num_out_data are the final loop
+    # states (an unrolled LSTM discards them by design)
+    "_foreach": lambda attrs: int(attrs.get("num_out_data", 0)),
+    "_while_loop": lambda attrs: int(attrs.get("num_out_data", 0)),
+}
+
+
+def _suppressed(node, code):
+    tag = node._extra_attrs.get("__lint__")
+    if not tag:
+        return False
+    tag = str(tag)
+    return tag == "off" or code in {t.strip() for t in tag.split(",")}
+
+
+def _finding(out, node, pass_name, code, severity, message):
+    if not _suppressed(node, code):
+        out.append(Finding(pass_name, code, severity, message,
+                           node=node.name))
+
+
+# ---------------------------------------------------------------------------
+# individual passes
+# ---------------------------------------------------------------------------
+
+def _pass_names(symbol, topo):
+    out = []
+    seen = {}
+    for node in topo:
+        if not str(node.name).strip():
+            _finding(out, node, "graph.names", "empty-name", ERROR,
+                     "node has an empty name; it cannot be addressed in "
+                     "arg_dict / saved JSON")
+            continue
+        first = seen.get(node.name)
+        if first is None:
+            seen[node.name] = node
+            continue
+        involves_var = node.is_variable or first.is_variable
+        _finding(out, node, "graph.names", "duplicate-name",
+                 ERROR if involves_var else WARN,
+                 f"two distinct nodes share the name '{node.name}'; "
+                 + ("arg_dict collapses the duplicates and bind "
+                    "trains/feeds the wrong arrays (bind rejects this)"
+                    if involves_var else
+                    "by-name output lookup and tojson round-trips "
+                    "silently shadow one of them"))
+    return out
+
+
+def _pass_dead_outputs(symbol, topo):
+    consumed = set()
+    for node in topo:
+        for src, idx in node.inputs:
+            consumed.add((id(src), idx))
+    heads = {(id(n), i) for n, i in symbol._entries}
+    out = []
+    for node in topo:
+        if node.is_variable:
+            continue
+        nout = node.num_outputs()
+        if nout <= 1:
+            continue  # single-output non-heads cannot appear in topo
+        optional_from = _OPTIONAL_TAIL_OUTPUTS.get(node.op.name, nout)
+        if callable(optional_from):
+            optional_from = optional_from(node.attrs)
+        for i in range(nout):
+            if i >= optional_from:
+                continue
+            if (id(node), i) not in consumed and (id(node), i) not in heads:
+                _finding(out, node, "graph.dead", "dead-output", WARN,
+                         f"output {i} of '{node.name}' "
+                         f"('{node.name}_output{i}') is computed but never "
+                         "consumed and is not a graph head — dead compute "
+                         "shipped through XLA")
+    return out
+
+
+def _pass_aux(symbol, topo):
+    out = []
+    aux_writers = {}   # id(var) -> (var, [op names])
+    aux_readers = {}   # id(var) -> [op names] via NON-aux slots
+    for node in topo:
+        if node.is_variable:
+            continue
+        naux = node.op.num_aux(node.attrs)
+        n_in = len(node.inputs)
+        for k, (src, _idx) in enumerate(node.inputs):
+            if not src.is_variable:
+                continue
+            if naux and k >= n_in - naux:
+                aux_writers.setdefault(id(src), (src, []))[1].append(
+                    node.name)
+            else:
+                aux_readers.setdefault(id(src), []).append(node.name)
+    for vid, (var, writers) in aux_writers.items():
+        if len(writers) > 1:
+            _finding(out, var, "graph.aux", "shared-aux", WARN,
+                     f"aux state '{var.name}' feeds the running-state "
+                     f"slots of {len(writers)} ops ({', '.join(writers[:4])}"
+                     f"{', ...' if len(writers) > 4 else ''}); every train "
+                     "step races their writes — last writer wins")
+        readers = aux_readers.get(vid)
+        if readers:
+            _finding(out, var, "graph.aux", "aux-as-input", WARN,
+                     f"aux state '{var.name}' is also consumed as a "
+                     f"regular input by {readers[0]}; it will be updated "
+                     "in place under that reader")
+    return out
+
+
+def _is_f64(value):
+    try:
+        return np_dtype(value) == _np.float64
+    except Exception:
+        return False
+
+
+def _pass_dtype(symbol, topo, env):
+    out = []
+    origins = []
+    for node in topo:
+        if node.is_variable:
+            if _is_f64(node._extra_attrs.get("__dtype__")):
+                origins.append(node)
+                _finding(out, node, "graph.dtype", "f64-promotion", WARN,
+                         f"variable '{node.name}' is declared float64; "
+                         "TPUs have no f64 ALU — XLA emulates it slowly "
+                         "or demotes with precision surprises")
+            continue
+        for key, val in node.attrs.items():
+            if key in ("dtype", "out_type") and _is_f64(val):
+                origins.append(node)
+                _finding(out, node, "graph.dtype", "f64-promotion", WARN,
+                         f"op '{node.name}' ({node.op.name}) produces "
+                         f"float64 ({key}={val!r}); TPUs have no f64 ALU "
+                         "— the whole downstream graph pays for emulation")
+    if origins and env:
+        f64_heads = []
+        outs = symbol.list_outputs()
+        for oname, (node, idx) in zip(outs, symbol._entries):
+            avals = env.get(id(node))
+            if avals and idx < len(avals) and avals[idx] is not None and \
+                    _np.dtype(avals[idx].dtype) == _np.float64:
+                f64_heads.append(oname)
+        if f64_heads:
+            n, _i = symbol._entries[0]
+            out.append(Finding(
+                "graph.dtype", "f64-output", WARN,
+                "the f64 promotion reaches graph output(s) "
+                f"{', '.join(f64_heads[:4])}"
+                f"{', ...' if len(f64_heads) > 4 else ''}; every consumer "
+                "inherits the emulation cost", node=n.name))
+    return out
+
+
+def _pass_unbound(symbol, topo, shapes):
+    """Variables the framework's own partial shape inference cannot solve
+    from the provided inputs — `simple_bind` will fail exactly there."""
+    try:
+        kw = {k: tuple(v) for k, v in shapes.items() if v}
+        arg_shapes, _, aux_shapes = symbol.infer_shape_partial(**kw)
+    except Exception:
+        return []   # inference itself broke; other passes still apply
+    out = []
+    names = symbol.list_arguments() + symbol.list_auxiliary_states()
+    solved = list(arg_shapes or []) + list(aux_shapes or [])
+    var_nodes = {n.name: n for n in topo if n.is_variable}
+    for name, shp in zip(names, solved):
+        if shp is not None and all(shp):
+            continue
+        node = var_nodes.get(name)
+        if node is not None:
+            _finding(out, node, "graph.unbound", "unbound-input", WARN,
+                     f"shape of variable '{name}' cannot be inferred "
+                     "from the provided input shapes or op attrs; "
+                     "simple_bind will fail here — provide its shape")
+    return out
+
+
+def _pass_layout(symbol, topo):
+    out = []
+    for node in topo:
+        if node.is_variable or node.op.name not in _FEATURE_ATTRS:
+            continue
+        attr, label = _FEATURE_ATTRS[node.op.name]
+        try:
+            d = int(node.attrs.get(attr))
+        except (TypeError, ValueError):
+            continue
+        if d <= 0 or (d % 8 == 0 and d % 128 == 0):
+            continue
+        lane_pad = -d % 128
+        sub_pad = -d % 8
+        waste = 100.0 * lane_pad / (d + lane_pad)
+        parts = []
+        if sub_pad:
+            parts.append(f"pads {sub_pad} sublanes to the next multiple "
+                         "of 8")
+        if lane_pad:
+            parts.append(f"pads {lane_pad} lanes to the next multiple of "
+                         f"128 ({waste:.0f}% of the padded tile wasted)")
+        _finding(out, node, "graph.layout", "tpu-layout", HINT,
+                 f"'{node.name}' {label}={d} is not TPU-tile aligned: "
+                 + "; ".join(parts))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# best-effort abstract evaluation (shape+dtype), partial-tolerant
+# ---------------------------------------------------------------------------
+
+def _abstract_env(symbol, shapes):
+    """{id(node): tuple(ShapeDtypeStruct|None)} walking topo order; a node
+    whose inputs cannot be resolved gets None (partial inference — the
+    passes that consume the env skip unknowns).  Variables seed from the
+    provided `shapes`, then ``__shape__`` attrs; declared ``__dtype__``
+    attrs carry real dtypes so f64 propagation is visible."""
+    import jax
+    from ..symbol.symbol import _solve_param_shapes
+
+    shapes = dict(shapes or {})
+    topo = symbol._topo()
+    env = {}
+
+    def var_aval(node):
+        cand = None
+        if node.name in shapes and shapes[node.name]:
+            cand = tuple(shapes[node.name])
+        elif "__shape__" in node._extra_attrs:
+            cand = tuple(node._extra_attrs["__shape__"])
+        if cand is None or not all(isinstance(d, int) and d > 0
+                                   for d in cand):
+            return None
+        dt = _np.float32
+        declared = node._extra_attrs.get("__dtype__")
+        if declared is not None:
+            try:
+                dt = np_dtype(declared)
+            except Exception:
+                pass
+        return jax.ShapeDtypeStruct(cand, dt)
+
+    for node in topo:
+        if node.is_variable:
+            aval = var_aval(node)
+            env[id(node)] = (aval,) if aval is not None else None
+            continue
+        ins = []
+        unknown = False
+        for src, idx in node.inputs:
+            e = env.get(id(src))
+            if e is None or idx >= len(e) or e[idx] is None:
+                unknown = True
+                break
+            ins.append(e[idx])
+        if unknown:
+            try:
+                solved = _solve_param_shapes(node, env)
+            except Exception:
+                solved = False
+            if solved:
+                ins = [env[id(src)][idx] for src, idx in node.inputs]
+            else:
+                env[id(node)] = None
+                continue
+        params = dict(node.attrs)
+        if node.op.mode_dependent:
+            params["_train"] = False
+        if node.op.dynamic_params:
+            for pname in node.op.dynamic_params:
+                ins.append(jax.ShapeDtypeStruct((), _np.float32))
+                params.pop(pname, None)
+        if node.op.needs_rng:
+            ins.append(jax.ShapeDtypeStruct((2,), _np.uint32))
+        try:
+            outv = jax.eval_shape(lambda *xs: node.op.fn(params, *xs), *ins)
+        except Exception:
+            env[id(node)] = None
+            continue
+        if not isinstance(outv, (tuple, list)):
+            outv = (outv,)
+        env[id(node)] = tuple(outv[:node.num_outputs()])
+    return env
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def check(symbol, shapes=None, hints=True, target=None):
+    """Run the graph-pass catalog over a Symbol.
+
+    Parameters
+    ----------
+    symbol : Symbol
+    shapes : optional {var_name: shape} — enables the unbound-input pass
+        and dtype propagation (same convention as `infer_shape` kwargs).
+    hints : include perf hints (tpu-layout) alongside errors/warnings.
+    """
+    topo = symbol._topo()
+    report = Report(target=target)
+    report.extend(_pass_names(symbol, topo))
+    report.extend(_pass_dead_outputs(symbol, topo))
+    report.extend(_pass_aux(symbol, topo))
+    env = {}
+    try:
+        env = _abstract_env(symbol, shapes)
+    except Exception:
+        env = {}
+    report.extend(_pass_dtype(symbol, topo, env))
+    if shapes:
+        report.extend(_pass_unbound(symbol, topo, shapes))
+    if hints:
+        report.extend(_pass_layout(symbol, topo))
+    return report
+
+
+def _json_structural(graph, target):
+    """Passes that need the raw node table: duplicate names across the
+    WHOLE file and nodes unreachable from any head (a Symbol object only
+    ever holds reachable nodes, so these exist only for saved JSON)."""
+    out = []
+    nodes = graph.get("nodes", [])
+    seen = {}
+    for i, jn in enumerate(nodes):
+        name = jn.get("name", "")
+        if not str(name).strip():
+            out.append(Finding("graph.names", "empty-name", ERROR,
+                               f"node #{i} has an empty name", node=str(i),
+                               location=target))
+            continue
+        if name in seen:
+            out.append(Finding(
+                "graph.names", "duplicate-name", ERROR,
+                f"nodes #{seen[name]} and #{i} share the name '{name}'; "
+                "loading this graph silently shadows one of them",
+                node=name, location=target))
+        else:
+            seen[name] = i
+    heads = [h[0] for h in graph.get("heads", [])]
+    reachable = set()
+    stack = list(heads)
+    while stack:
+        nid = stack.pop()
+        if nid in reachable or nid >= len(nodes):
+            continue
+        reachable.add(nid)
+        for inp in nodes[nid].get("inputs", []):
+            stack.append(inp[0])
+    for i, jn in enumerate(nodes):
+        if i in reachable:
+            continue
+        is_var = jn.get("op") == "null"
+        kind = "aux/argument state" if is_var else "op"
+        out.append(Finding(
+            "graph.aux" if is_var else "graph.dead",
+            "unreachable-node", WARN,
+            f"{kind} '{jn.get('name')}' (node #{i}) is not reachable from "
+            "any graph head — dead " +
+            ("state the loader will still allocate" if is_var
+             else "compute"),
+            node=jn.get("name"), location=target))
+    return out
+
+
+def check_json(text, shapes=None, hints=True, target=None):
+    """Analyze a saved symbol JSON string: structural passes over the raw
+    node table, then the Symbol passes over the loadable graph."""
+    report = Report(target=target)
+    try:
+        graph = _json.loads(text)
+    except ValueError as e:
+        report.add(Finding("graph.names", "bad-json", ERROR,
+                           f"not valid JSON: {e}", location=target))
+        return report
+    if not isinstance(graph, dict) or "nodes" not in graph:
+        report.add(Finding("graph.names", "bad-json", ERROR,
+                           "no 'nodes' table — not a symbol JSON",
+                           location=target))
+        return report
+    report.extend(_json_structural(graph, target))
+    try:
+        from ..symbol.symbol import load_json
+        sym = load_json(text)
+    except Exception as e:
+        report.add(Finding(
+            "graph.names", "unloadable", ERROR,
+            f"graph does not load ({str(e)[:160]}); only structural "
+            "passes ran", location=target))
+        return report
+    # the structural pass already covered names over the WHOLE node table
+    # (the Symbol walk sees only reachable nodes) — don't double-report
+    sym_report = check(sym, shapes=shapes, hints=hints, target=target)
+    report.extend(f for f in sym_report.findings
+                  if f.pass_name != "graph.names")
+    return report

@@ -1,0 +1,123 @@
+"""Host-sync detection: who blocks the hot loop, and from which line.
+
+On TPU a single `asnumpy()`/`asscalar()`/`wait_to_read()` inside the
+training loop serializes the host with the device and can halve step
+throughput — and it is invisible in a profile of *device* time.  When
+analysis is enabled (MXNET_ANALYSIS=1 or `analysis.enable()`), the fit /
+step hot loops mark themselves with `hot_loop(...)` and every blocking
+read that happens inside one is attributed to the first stack frame
+outside the data-plane modules — the metric, callback, or user line that
+actually asked for the sync.
+
+Findings dedupe on (kind, file, line) with a count, so a 10k-batch epoch
+produces one finding per offending line, not 10k.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+
+from .findings import Finding, WARN
+
+__all__ = ["hot_loop", "note", "findings", "reset", "active"]
+
+# modules whose frames are the sync MECHANISM, not its cause: attribution
+# walks past them to the first caller outside the package data plane
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SKIP_SUFFIXES = (os.path.join("ndarray", "ndarray.py"), "engine.py",
+                  os.path.join("analysis", "hostsync.py"))
+
+_tls = threading.local()
+_lock = threading.Lock()
+_findings = {}  # (kind, file, line) -> Finding
+
+# module-level fast-path flag: NDArray.asnumpy checks this before paying
+# for anything else.  It counts hot scopes across ALL threads (one
+# thread leaving its loop must not blind another mid-epoch); the
+# thread-local depth decides whether THIS thread's read is in a loop.
+_active = 0
+
+
+def active():
+    return _active > 0 and getattr(_tls, "depth", 0) > 0
+
+
+@contextlib.contextmanager
+def hot_loop(label):
+    """Mark a training hot loop (Module.fit's batch loop, Trainer.step).
+    Blocking reads inside the scope are recorded; no-op unless analysis
+    is enabled."""
+    from . import enabled
+    global _active
+    if not enabled():
+        yield
+        return
+    _tls.depth = getattr(_tls, "depth", 0) + 1
+    _tls.label = label
+    with _lock:
+        _active += 1
+    try:
+        yield
+    finally:
+        _tls.depth -= 1
+        with _lock:
+            _active -= 1
+
+
+@contextlib.contextmanager
+def paused():
+    """Suspend hot-loop attribution for this thread: epoch-boundary work
+    (eval scoring, checkpoint gathers, epoch callbacks) legitimately
+    blocks once per epoch and must not be reported as a per-batch
+    hazard."""
+    depth = getattr(_tls, "depth", 0)
+    _tls.depth = 0
+    try:
+        yield
+    finally:
+        _tls.depth = depth
+
+
+def _attribute():
+    """file:line of the nearest caller outside the data-plane modules."""
+    f = sys._getframe(2)  # skip _attribute and note
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not (fn.startswith(_PKG_DIR) and fn.endswith(_SKIP_SUFFIXES)):
+            return fn, f.f_lineno
+        f = f.f_back
+    return "<unknown>", 0
+
+
+def note(kind):
+    """Record one blocking host read (call only when `active()`)."""
+    if not active():
+        return
+    fname, lineno = _attribute()
+    key = (kind, fname, lineno)
+    label = getattr(_tls, "label", "hot loop")
+    with _lock:
+        f = _findings.get(key)
+        if f is not None:
+            f.count += 1
+            return
+        if len(_findings) >= 512:   # bounded: a pathological loop cannot
+            return                  # grow the report without limit
+        _findings[key] = Finding(
+            "trace.hostsync", "host-sync-in-loop", WARN,
+            f"{kind}() blocks the host inside {label}; on TPU this "
+            "serializes dispatch with the device every batch (move the "
+            "read out of the loop, or use a device-side metric)",
+            location=f"{fname}:{lineno}")
+
+
+def findings():
+    with _lock:
+        return list(_findings.values())
+
+
+def reset():
+    with _lock:
+        _findings.clear()

@@ -1,0 +1,99 @@
+"""Recompilation audit: every new jit signature, and why it is new.
+
+An XLA compile of the fused train step costs seconds to minutes; a shape
+that churns (the classic: a ragged final batch without padding) pays it
+every epoch and looks like random multi-second stalls.  The fused paths
+report each dispatch signature here; the auditor records the history per
+program and, when a NEW signature arrives, diffs it against the previous
+one and emits a finding naming the exact argument that changed — plus a
+ragged-batch diagnosis when only the leading (batch) dimension moved.
+
+Recording is unconditional (a tuple compare per dispatch in the steady
+state); findings surface through `analysis.runtime_report()`.
+"""
+from __future__ import annotations
+
+import threading
+
+from .findings import Finding, WARN
+
+__all__ = ["note", "findings", "signatures", "reset"]
+
+_lock = threading.Lock()
+_seen = {}       # key -> list of signatures in first-seen order
+_findings = []
+_MAX_SIGS = 64   # per program; beyond this something is deeply wrong
+_MAX_FINDINGS = 256
+
+
+def _diff(names, prev, sig):
+    """Describe which args changed between two signatures."""
+    changed = []
+    batch_only = True
+    for i, (old, new) in enumerate(zip(prev, sig)):
+        if old == new:
+            continue
+        name = names[i] if names and i < len(names) else f"arg{i}"
+        (oshape, odt), (nshape, ndt) = old, new
+        if odt != ndt:
+            changed.append(f"'{name}' dtype {odt} -> {ndt}")
+            batch_only = False
+        else:
+            changed.append(f"'{name}' shape {tuple(oshape)} -> "
+                           f"{tuple(nshape)}")
+            same_tail = (len(oshape) == len(nshape) and
+                         tuple(oshape[1:]) == tuple(nshape[1:]))
+            if not same_tail:
+                batch_only = False
+    if len(prev) != len(sig):
+        changed.append(f"arg count {len(prev)} -> {len(sig)}")
+        batch_only = False
+    return changed, batch_only and bool(changed)
+
+
+def note(key, names, sig):
+    """Report one dispatch of program `key` with input signature `sig`
+    (a tuple of (shape, dtype) per arg, `names` naming the args).
+    Returns the Finding emitted for a churned signature, else None."""
+    sig = tuple(sig)
+    with _lock:
+        hist = _seen.get(key)
+        if hist is None:
+            _seen[key] = [sig]
+            return None
+        if sig == hist[-1] or sig in hist:
+            return None
+        prev = hist[-1]
+        if len(hist) < _MAX_SIGS:
+            hist.append(sig)
+    changed, batch_only = _diff(names, prev, sig)
+    detail = "; ".join(changed[:6]) or "signature changed"
+    hint = (" — looks like a ragged final batch; pad or discard the tail "
+            "(NDArrayIter last_batch_handle='pad'/'discard') so one "
+            "compiled program serves every step" if batch_only else "")
+    f = Finding(
+        "trace.recompile", "shape-churn", WARN,
+        f"{key}: new jit signature #{len(_seen[key])} forces a fresh XLA "
+        f"compile: {detail}{hint}",
+        location=key)
+    with _lock:
+        if len(_findings) < _MAX_FINDINGS:
+            _findings.append(f)
+    return f
+
+
+def signatures(key):
+    """The distinct signatures recorded for a program (oldest first)."""
+    with _lock:
+        return list(_seen.get(key, ()))
+
+
+def findings():
+    with _lock:
+        return list(_findings)
+
+
+def reset():
+    with _lock:
+        _seen.clear()
+        del _findings[:]

@@ -1,0 +1,139 @@
+"""AST lints over example/training scripts.
+
+The runtime host-sync detector (hostsync.py) catches blocking reads
+while they happen; this pass catches them BEFORE anything runs, by
+walking a script's AST:
+
+* ``host-sync-in-loop`` — `.asnumpy()` / `.asscalar()` / `.item()` /
+  `.wait_to_read()` / `waitall()` lexically inside a `for`/`while` body:
+  the classic TPU throughput killer (each call serializes the host with
+  the device once per iteration).
+* ``kvstore-local-on-tpu`` — a literal ``kvstore='local'`` passed to
+  `fit`/`init_optimizer`/`Trainer` in a script that also creates TPU
+  contexts: 'local' stages gradient reduction through host memory; on
+  TPU the reduce should ride ICI collectives (``kvstore='device'`` or
+  ``'tpu'``).
+
+Suppression: append ``# mxlint: disable`` (everything on the line) or
+``# mxlint: disable=<code>[,<code>...]`` to the offending line.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .findings import Finding, Report, WARN
+
+__all__ = ["scan_source", "scan_file"]
+
+_SYNC_METHODS = {"asnumpy", "asscalar", "item", "wait_to_read"}
+_SYNC_FREE = {"waitall"}
+_KV_KEYWORDS = {"kvstore", "kv_store"}
+_KV_SINKS = {"fit", "init_optimizer", "Trainer", "create"}
+_DISABLE_RE = re.compile(r"#\s*mxlint:\s*disable(?:=([\w\-, ]+))?")
+
+
+def _suppressed(lines, lineno, code):
+    if 1 <= lineno <= len(lines):
+        m = _DISABLE_RE.search(lines[lineno - 1])
+        if m:
+            codes = m.group(1)
+            if codes is None:
+                return True
+            return code in {c.strip() for c in codes.split(",")}
+    return False
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, filename, lines):
+        self.filename = filename
+        self.lines = lines
+        self.loop_depth = 0
+        self.findings = []
+        self.uses_tpu = False
+        self.kv_local_sites = []   # (lineno, sink name)
+
+    # -- loops ---------------------------------------------------------------
+    def _loop(self, node):
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_For = visit_While = visit_AsyncFor = _loop
+
+    # functions defined INSIDE a loop body don't run per-iteration at the
+    # definition site; reset the loop context for their bodies
+    def _fresh_scope(self, node):
+        saved, self.loop_depth = self.loop_depth, 0
+        self.generic_visit(node)
+        self.loop_depth = saved
+
+    visit_FunctionDef = visit_AsyncFunctionDef = visit_Lambda = _fresh_scope
+
+    # -- calls ---------------------------------------------------------------
+    def _add(self, code, lineno, message):
+        if _suppressed(self.lines, lineno, code):
+            return
+        self.findings.append(Finding(
+            "source.hostsync" if code == "host-sync-in-loop"
+            else "source.kvstore", code, WARN, message,
+            location=f"{self.filename}:{lineno}"))
+
+    def visit_Call(self, node):
+        func = node.func
+        name = None
+        if isinstance(func, ast.Attribute):
+            name = func.attr
+        elif isinstance(func, ast.Name):
+            name = func.id
+        if name == "tpu":
+            self.uses_tpu = True
+        if self.loop_depth > 0 and isinstance(func, ast.Attribute) and \
+                name in _SYNC_METHODS:
+            self._add("host-sync-in-loop", node.lineno,
+                      f".{name}() inside a loop blocks the host on the "
+                      "device every iteration; hoist it out of the loop "
+                      "or batch the reads")
+        if self.loop_depth > 0 and name in _SYNC_FREE:
+            self._add("host-sync-in-loop", node.lineno,
+                      f"{name}() inside a loop drains ALL in-flight work "
+                      "every iteration")
+        if name in _KV_SINKS:
+            for kw in node.keywords:
+                if kw.arg in _KV_KEYWORDS and \
+                        isinstance(kw.value, ast.Constant) and \
+                        kw.value.value == "local":
+                    self.kv_local_sites.append((node.lineno, name))
+        self.generic_visit(node)
+
+
+def scan_source(text, filename="<string>"):
+    """Lint python source; returns a Report."""
+    report = Report(target=filename)
+    try:
+        tree = ast.parse(text, filename=filename)
+    except SyntaxError as e:
+        report.add(Finding("source.parse", "syntax-error", WARN,
+                           f"cannot parse: {e.msg}",
+                           location=f"{filename}:{e.lineno or 0}"))
+        return report
+    lines = text.splitlines()
+    v = _Visitor(filename, lines)
+    v.visit(tree)
+    report.extend(v.findings)
+    if v.uses_tpu:
+        for lineno, sink in v.kv_local_sites:
+            if _suppressed(lines, lineno, "kvstore-local-on-tpu"):
+                continue
+            report.add(Finding(
+                "source.kvstore", "kvstore-local-on-tpu", WARN,
+                f"kvstore='local' passed to {sink}() in a script that "
+                "creates TPU contexts: 'local' reduces gradients through "
+                "host memory; use kvstore='device' (ICI collectives)",
+                location=f"{filename}:{lineno}"))
+    return report
+
+
+def scan_file(path):
+    with open(path, encoding="utf-8", errors="replace") as f:
+        return scan_source(f.read(), filename=str(path))

@@ -129,6 +129,11 @@ KNOBS = {
     "MXNET_COMPILATION_CACHE_DIR": (str, "", "honored",
                                     "persistent XLA compilation cache "
                                     "directory (bench.py)"),
+    "MXNET_ANALYSIS": (_BOOL, False, "honored",
+                       "analysis/: runtime trace passes — per-parameter "
+                       "donation tracking, host-sync attribution inside "
+                       "Module.fit/Trainer.step, recompilation audit "
+                       "(read with analysis.runtime_report())"),
 }
 
 _warned = set()

@@ -43,8 +43,13 @@ def _naive():
     return engine_type() == "NaiveEngine"
 
 
-def track(jarr):
-    """Register a dispatched jax.Array; block immediately under NaiveEngine."""
+def track(jarr, op=None):
+    """Register a dispatched jax.Array; block immediately under NaiveEngine.
+
+    `op` names the originating operator: NaiveEngine exists to surface
+    deferred errors AT the op that caused them, so its failure is chained
+    into a contextful MXNetError naming that op instead of re-raising the
+    bare XLA error with no attribution."""
     import jax.core as _jc
     if isinstance(jarr, _jc.Tracer):
         # abstract value inside a jax trace (fused train step / CachedOp):
@@ -54,8 +59,11 @@ def track(jarr):
     if _naive():
         try:
             jarr.block_until_ready()
-        except Exception:  # deferred errors surface at wait points, like the reference
-            raise
+        except Exception as e:
+            from .base import MXNetError
+            raise MXNetError(
+                f"NaiveEngine: operator '{op or '<unknown>'}' failed "
+                f"during synchronous execution: {e}") from e
         return jarr
     try:
         with _lock:
@@ -75,6 +83,9 @@ def wait_to_read(jarr):
 def waitall():
     """Block until all outstanding async work completes (reference
     `Engine::WaitForAll`, `mx.nd.waitall`)."""
+    from .analysis import hostsync as _hostsync
+    if _hostsync._active:
+        _hostsync.note("waitall")
     with _lock:
         arrs = list(_in_flight)
         _in_flight.clear()

@@ -349,6 +349,8 @@ class Executor:
     @staticmethod
     def _simple_bind(symbol, ctx, grad_req, type_dict, shape_kwargs,
                      group2ctx=None):
+        from .symbol.symbol import check_unique_names
+        check_unique_names(symbol)  # shadowed names would train wrong arrays
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
         arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shape_kwargs)
@@ -403,6 +405,8 @@ class Executor:
 
     @staticmethod
     def _bind(symbol, ctx, args, args_grad, grad_req, aux_states):
+        from .symbol.symbol import check_unique_names
+        check_unique_names(symbol)  # shadowed names would train wrong arrays
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
         ctx = ctx or current_context()

@@ -175,15 +175,27 @@ def _apply_traced(opt, indices, ws, gs, ss, ctx, lr_vec, wd_vec, t_vec,
 
 def _donated_invalidated(*trees):
     """True when any jax-array leaf in the given pytrees was deleted by a
-    donating dispatch.  A failed fused call whose donation already consumed
-    the persistent buffers must NOT fall back onto them — the eager replay
-    would raise on deleted arrays and leave training state unrecoverable."""
-    import jax
-    for t in trees:
-        for leaf in jax.tree_util.tree_leaves(t):
-            if getattr(leaf, "is_deleted", None) and leaf.is_deleted():
-                return True
-    return False
+    donating dispatch (promoted into `analysis.donation.any_deleted`; kept
+    as the historical name for callers of the probe)."""
+    from .analysis import donation as _donation
+    return _donation.any_deleted(*trees)
+
+
+def _opt_param_names(opt, indices):
+    """Best-effort human names for optimizer parameter indices (Module
+    installs `idx2name`; the gluon Trainer installs `param_dict`) — the
+    names the donation tracker and unrecoverable-failure errors report."""
+    i2n = getattr(opt, "idx2name", None) or {}
+    pd = getattr(opt, "param_dict", None) or {}
+    out = []
+    for i in indices:
+        if i in i2n:
+            out.append(str(i2n[i]))
+        elif i in pd and getattr(pd[i], "name", None):
+            out.append(str(pd[i].name))
+        else:
+            out.append(f"param[{i}]")
+    return out
 
 
 def _param_dict_mults(opt, indices):
@@ -199,17 +211,15 @@ def _param_dict_mults(opt, indices):
         if i in pd else None for i in indices)
 
 
-def _raise_if_unrecoverable(kind, exc, *trees):
+def _raise_if_unrecoverable(kind, exc, named_trees):
     """Shared post-dispatch failure triage for every fused path: when the
     donating dispatch already consumed the persistent buffers, falling
-    back would replay onto deleted arrays — raise instead.  Returns when a
-    fallback is safe (buffers intact)."""
-    if _donated_invalidated(*trees):
-        raise RuntimeError(
-            f"{kind} failed AFTER its donating dispatch consumed the "
-            "weight/optimizer-state buffers; training state is "
-            "unrecoverable — restart from a checkpoint "
-            f"(cause: {str(exc)[:300]})") from exc
+    back would replay onto deleted arrays — raise an `MXNetError` NAMING
+    the consumed parameters instead (analysis.donation).  `named_trees`
+    is an iterable of (owner_name, pytree).  Returns when a fallback is
+    safe (buffers intact)."""
+    from .analysis import donation as _donation
+    _donation.raise_if_consumed(kind, exc, named_trees)
 
 
 def _no_rng():
@@ -430,13 +440,25 @@ class FusedOptimizer:
         self._call_ctx = weights[0].context
         self._call_w_shardings = [getattr(w, "sharding", None) for w in ws]
         self._call_s_shardings = tuple(_sharding_tree(s) for s in states)
+        from . import analysis as _analysis
+        if _analysis.enabled():
+            self._step_no = getattr(self, "_step_no", 0) + 1
+            names = _opt_param_names(opt, self._call_indices)
+            _analysis.donation.record(
+                f"FusedOptimizer step {self._step_no}",
+                list(zip(names, ws)) +
+                [(n + ".state", s) for n, s in zip(names, ss)])
         # counts were already advanced; replay through the raw update on
         # fallback (not update_multi_precision, which would double-count)
         try:
             with _no_rng():
                 new_ws, new_ss = self._jit(ws, gs, ss, lrs, wds, ts, rescale)
         except Exception as e:
-            _raise_if_unrecoverable("fused optimizer apply", e, ws, ss)
+            names = _opt_param_names(opt, self._call_indices)
+            _raise_if_unrecoverable(
+                "fused optimizer apply", e,
+                list(zip(names, ws)) +
+                [(n + ".state", s) for n, s in zip(names, ss)])
             self._broken = True
             _log.warning(
                 "fused optimizer apply unavailable for %s (%s); using the "
@@ -539,12 +561,18 @@ class FusedTrainStep:
         self._jit = None          # 1-step program
         self._jit_block = {}      # K -> K-step scan program
         self._core_closed = None  # the once-traced step jaxpr
+        self._core_sig = None     # input signature the core was traced for
+        self._core_cache = {}     # in_sig -> traced program set (retrace
+                                  # survival for alternating signatures)
         self._derive_fn = None    # masters -> low-precision weights (flush)
         self.last_outputs = None
         self._block_outs = None   # scan ys: per-batch outputs of a block
         self.broken = False
         self._carry = None  # steady-state fast-path cache (see _dispatch)
         self._derive_ws = False  # set by _build_core (see _master_positions)
+        FusedTrainStep._seq = getattr(FusedTrainStep, "_seq", 0) + 1
+        self._audit_key = f"FusedTrainStep#{FusedTrainStep._seq}"
+        self._step_no = 0   # donation-tracker step counter
 
     # -- placement of persistent buffers -------------------------------------
     # Every call normalizes buffer shardings (a no-op once placed): other
@@ -845,6 +873,7 @@ class FusedTrainStep:
         if in_sig is None:
             self.flush()
             return False
+        from . import analysis as _analysis
         # steady-state fast path: when every persistent buffer is still the
         # array WE wrote back last step (verified by identity), placement,
         # sharding collection and signature validation are all known-good
@@ -878,6 +907,7 @@ class FusedTrainStep:
         if need_build:
             self._metric_ids = [id(m) for _, m in metric_fns]
             self._core_closed = None   # metric set is baked into the core
+            self._core_cache = {}      # shapes AND metrics key the cores
             carry = None
         if carry is None:
             if self._owns_exec_buffers():
@@ -961,6 +991,27 @@ class FusedTrainStep:
             self.flush()
             return False
 
+        # recompilation audit: past every unfused-bail check, a changed
+        # signature now really does force a fresh XLA compile — record it
+        # with the exact arg that moved (noting any earlier would claim
+        # compiles for batches the eligibility checks sent unfused, and
+        # poison the history for the eventual real compile)
+        _analysis.recompile.note(self._audit_key, self._input_names, in_sig)
+        if self._core_closed is not None and \
+                in_sig != getattr(self, "_core_sig", None):
+            # the input signature changed (the recompile auditor recorded
+            # the churn above): the once-traced core jaxpr is
+            # shape-specialized, so swap in this signature's cached
+            # program set — or drop the core and re-trace.  A ragged tail
+            # batch costs a recompile, not a permanently broken fast path.
+            cached = getattr(self, "_core_cache", {}).get(in_sig)
+            if cached is not None:
+                (self._core_closed, self._jit, self._scan_jit,
+                 self._jit_block, self._derive_ws, self._mp_pos,
+                 self._w_dtypes) = cached
+            else:
+                self._core_closed = None
+
         opt = self._opt
         # snapshot counts so a failed attempt doesn't double-count the step
         # when the caller re-runs it through the unfused path
@@ -981,6 +1032,17 @@ class FusedTrainStep:
                  self._key, t_vec)
         xs = [(tuple(inp), lr_j, wd_j)
               for inp, (lr_j, wd_j) in zip(xs_inputs, rows)]
+
+        if _analysis.enabled():
+            # name every donated carry leaf BEFORE the consuming dispatch:
+            # a later read of a stale buffer then names its parameter and
+            # the step that ate it (analysis.donation)
+            self._step_no += k
+            _analysis.donation.record(
+                f"{self._audit_key} step {self._step_no}",
+                self._donation_groups(ws, ss, auxs) +
+                [("<metric accumulator>", mcarry),
+                 ("<rng key>", self._key), ("<update counts>", t_vec)])
 
         try:
             with _no_rng():
@@ -1010,7 +1072,8 @@ class FusedTrainStep:
             opt._index_update_count = counts_before
             opt.num_update = num_update_before
             try:
-                _raise_if_unrecoverable("fused train step", e, ws, ss, auxs)
+                _raise_if_unrecoverable("fused train step", e,
+                                        self._donation_groups(ws, ss, auxs))
             except RuntimeError:
                 self.broken = True
                 self._carry = None
@@ -1050,11 +1113,29 @@ class FusedTrainStep:
         self._carry_sdict = self._updater.states
         self._carry_in_sig = in_sig
         self._flushed = False
+        self._core_sig = in_sig
+        if len(self._core_cache) < 8 or in_sig in self._core_cache:
+            # keep the freshest program set per signature so an
+            # alternating shape (epoch tail) swaps instead of re-tracing
+            self._core_cache[in_sig] = (
+                self._core_closed, self._jit, self._scan_jit,
+                self._jit_block, self._derive_ws,
+                getattr(self, "_mp_pos", None),
+                getattr(self, "_w_dtypes", None))
         if was_cold:
             # first step of a signature: write through immediately so the
             # `_seen_*` identity snapshots exist for the fast-path check
             self.flush()
         return True
+
+    def _donation_groups(self, ws, ss, auxs):
+        """(owner_name, pytree) pairs for every donated persistent buffer
+        — the donation tracker's and the unrecoverable-failure error's
+        naming source."""
+        groups = list(zip(self._param_names, ws))
+        groups += [(n + ".state", s) for n, s in zip(self._param_names, ss)]
+        groups += list(zip(self._aux_names, auxs))
+        return groups
 
     def _stage_inputs(self, data):
         """Place a batch's arrays onto the data sharding (dtype-cast

@@ -112,11 +112,25 @@ class GluonFusedStep:
         self._jit = None
         self._jit_block = {}
         self._core_closed = None
+        self._core_sig = None     # input signature the core was traced for
+        self._core_cache = {}     # in_sig -> traced program set
         self.broken = False
         self._carry = None
         self._t_vec = None
         self.last_loss = None
         self.last_outputs = None
+        GluonFusedStep._seq = getattr(GluonFusedStep, "_seq", 0) + 1
+        self._audit_key = f"GluonFusedStep#{GluonFusedStep._seq}"
+        self._step_no = 0   # donation-tracker step counter
+
+    def _donation_groups(self, ws, ss, auxs):
+        """(owner_name, pytree) pairs for the donated carries — naming
+        source for the donation tracker and unrecoverable errors."""
+        groups = [(p.name, w) for p, w in zip(self._train_params, ws)]
+        groups += [(p.name + ".state", s)
+                   for p, s in zip(self._train_params, ss)]
+        groups += [(p.name, a) for p, a in zip(self._aux_params, auxs)]
+        return groups
 
     # -- build ---------------------------------------------------------------
     def _build_core(self):
@@ -240,6 +254,8 @@ class GluonFusedStep:
             self._jit = None
             self._jit_block = {}
             self._core_closed = None
+            self._core_sig = None
+            self._core_cache = {}   # cached programs trace the OLD optimizer
             self._carry = None
             self._t_vec = None
         opt = self._opt
@@ -264,6 +280,10 @@ class GluonFusedStep:
             elif s != sig0:
                 return False   # ragged block cannot share one program
         in_sig = sig0
+        from .. import analysis as _analysis
+        _analysis.recompile.note(
+            self._audit_key, ("data", "label"),
+            ((sig0[0], sig0[1]), (sig0[2], sig0[3])))
         dev = self._ctx.jax_device
         staged = [(jax.device_put(d._data, dev), jax.device_put(l._data, dev))
                   for d, l in pairs]
@@ -309,6 +329,24 @@ class GluonFusedStep:
         xs = [(dval, lval, lr_j, wd_j)
               for (dval, lval), (lr_j, wd_j) in zip(staged, rows)]
 
+        if _analysis.enabled():
+            self._step_no += k
+            _analysis.donation.record(
+                f"{self._audit_key} step {self._step_no}",
+                self._donation_groups(ws, ss, auxs))
+
+        if self._core_closed is not None and in_sig != self._core_sig:
+            # signature changed: the traced core jaxpr is shape-
+            # specialized — swap in the cached program set for this
+            # signature or re-trace (churn recorded by the auditor above);
+            # a ragged tail batch must not permanently break the fast path
+            cached = self._core_cache.get(in_sig)
+            if cached is not None:
+                (self._core_closed, self._jit, self._scan_jit,
+                 self._jit_block) = cached
+            else:
+                self._core_closed = None
+
         try:
             with _no_rng():
                 if self._core_closed is None:
@@ -335,7 +373,8 @@ class GluonFusedStep:
             self._carry = None
             self._t_vec = None
             self.broken = True
-            _raise_if_unrecoverable("gluon fused step", e, ws, ss, auxs)
+            _raise_if_unrecoverable("gluon fused step", e,
+                                    self._donation_groups(ws, ss, auxs))
             _log.warning("gluon fused step unavailable (%s); Estimator "
                          "uses the eager loop", str(e)[:300])
             return False
@@ -359,4 +398,8 @@ class GluonFusedStep:
                        tuple(_state_data(s) for s in states))
         self._carry_sig = in_sig
         self._carry_sdict = self._updater.states
+        self._core_sig = in_sig
+        if len(self._core_cache) < 8 or in_sig in self._core_cache:
+            self._core_cache[in_sig] = (self._core_closed, self._jit,
+                                        self._scan_jit, self._jit_block)
         return True

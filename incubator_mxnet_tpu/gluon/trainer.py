@@ -103,11 +103,13 @@ class Trainer:
 
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce + update (reference `trainer.py:254 step`)."""
-        if not self._kv_initialized:
-            self._init_kvstore()
-        self._optimizer.rescale_grad = self._scale / batch_size
-        self._allreduce_grads()
-        self._update(ignore_stale_grad)
+        from .. import analysis as _analysis
+        with _analysis.hostsync.hot_loop("Trainer.step"):
+            if not self._kv_initialized:
+                self._init_kvstore()
+            self._optimizer.rescale_grad = self._scale / batch_size
+            self._allreduce_grads()
+            self._update(ignore_stale_grad)
 
     def allreduce_grads(self):
         if not self._kv_initialized:

@@ -55,6 +55,26 @@ class BaseModule:
         """Hook: `fit` is about to fire batch j's callbacks for the last
         processed block (subclasses point per-batch output views at j)."""
 
+    def check(self, hints=True):
+        """Run the `mxlint` static graph passes over this module's Symbol
+        (analysis/graph_passes.py) — duplicate names, dead outputs, aux
+        races, f64 promotion, unbound inputs, TPU tile-alignment hints —
+        seeded with the bound data/label shapes when available.  Returns
+        an `analysis.Report`; raises nothing."""
+        from .. import analysis as _analysis
+        if self._symbol is None:
+            return _analysis.Report(target=type(self).__name__)
+        shapes = {}
+        for desc in list(getattr(self, "_data_shapes", None) or []) + \
+                list(getattr(self, "_label_shapes", None) or []):
+            if hasattr(desc, "name"):
+                shapes[desc.name] = tuple(desc.shape)
+            else:
+                shapes[desc[0]] = tuple(desc[1])
+        return _analysis.check(self._symbol, shapes=shapes or None,
+                               hints=hints,
+                               target=self._symbol.name or "symbol")
+
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
               epoch=0, sparse_row_id_fn=None):
@@ -244,13 +264,16 @@ class BaseModule:
         last_snap_step = gstep
         if ckpt_mgr is not None:
             ckpt_mgr.install_preemption_hook()
+        from .. import analysis as _analysis
         try:
-            self._fit_epochs(
-                train_data, eval_data, eval_metric, validation_metric,
-                epoch_end_callback, batch_end_callback, eval_end_callback,
-                eval_batch_end_callback, monitor, sparse_row_id_fn,
-                begin_epoch, num_epoch, ckpt_mgr, ckpt_resume,
-                resume_nbatch, gstep, last_snap_step, checkpoint_period)
+            with _analysis.hostsync.hot_loop("Module.fit"):
+                self._fit_epochs(
+                    train_data, eval_data, eval_metric, validation_metric,
+                    epoch_end_callback, batch_end_callback,
+                    eval_end_callback, eval_batch_end_callback, monitor,
+                    sparse_row_id_fn, begin_epoch, num_epoch, ckpt_mgr,
+                    ckpt_resume, resume_nbatch, gstep, last_snap_step,
+                    checkpoint_period)
         finally:
             if ckpt_mgr is not None:
                 try:
@@ -358,43 +381,62 @@ class BaseModule:
                                                nbatch, gstep)
                         last_snap_step = gstep
 
-            for name, val in eval_metric.get_name_value():
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+            # epoch boundary: eval scoring, param syncs, callbacks and
+            # snapshots legitimately block once per epoch — not hot-loop
+            # host-sync hazards (analysis.hostsync would misattribute)
+            from .. import analysis as _analysis
+            with _analysis.hostsync.paused():
+                for name, val in eval_metric.get_name_value():
+                    self.logger.info("Epoch[%d] Train-%s=%f", epoch, name,
+                                     val)
+                toc = time.time()
+                self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                                 (toc - tic))
 
-            arg_params_, aux_params_ = self.get_params()
-            self.set_params(arg_params_, aux_params_)
+                arg_params_, aux_params_ = self.get_params()
+                self.set_params(arg_params_, aux_params_)
 
-            if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params_, aux_params_)
+                if epoch_end_callback is not None:
+                    for callback in _as_list(epoch_end_callback):
+                        callback(epoch, self.symbol, arg_params_,
+                                 aux_params_)
 
-            if eval_data:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
-                                     name, val)
-            train_data.reset()
-            if ckpt_mgr is not None:
-                # epoch-boundary snapshot AFTER the reset so the fresh
-                # shuffle permutation travels with it: resume starts the
-                # next epoch exactly as this run would have
-                self._elastic_snapshot(ckpt_mgr, train_data, epoch + 1, 0,
-                                       gstep)
-                last_snap_step = gstep
-                ckpt_mgr.honor_preemption(
-                    lambda: self._elastic_snapshot(
-                        ckpt_mgr, train_data, epoch + 1, 0, gstep,
-                        sync=True, meta={"preempted": True}))
+                if eval_data:
+                    res = self.score(
+                        eval_data, validation_metric,
+                        score_end_callback=eval_end_callback,
+                        batch_end_callback=eval_batch_end_callback,
+                        epoch=epoch)
+                    for name, val in res:
+                        self.logger.info("Epoch[%d] Validation-%s=%f",
+                                         epoch, name, val)
+                train_data.reset()
+                if ckpt_mgr is not None:
+                    # epoch-boundary snapshot AFTER the reset so the fresh
+                    # shuffle permutation travels with it: resume starts
+                    # the next epoch exactly as this run would have
+                    self._elastic_snapshot(ckpt_mgr, train_data, epoch + 1,
+                                           0, gstep)
+                    last_snap_step = gstep
+                    ckpt_mgr.honor_preemption(
+                        lambda: self._elastic_snapshot(
+                            ckpt_mgr, train_data, epoch + 1, 0, gstep,
+                            sync=True, meta={"preempted": True}))
 
     def _elastic_snapshot(self, mgr, train_data, epoch, nbatch, step,
                           sync=False, meta=None):
         """Stage one elastic checkpoint: sync device->pooled-host gather,
         background serialization + atomic commit (checkpoint/)."""
+        from .. import analysis as _analysis
+        with _analysis.hostsync.paused():
+            return self._elastic_snapshot_impl(mgr, train_data, epoch,
+                                               nbatch, step, sync=sync,
+                                               meta=meta)
+
+    def _elastic_snapshot_impl(self, mgr, train_data, epoch, nbatch, step,
+                               sync=False, meta=None):
+        """Checkpoint gathers block by design — not hot-loop host syncs
+        (hence the `paused()` wrapper above)."""
         from .. import checkpoint as _ckpt
         if mgr.rank != 0:
             # non-primary ranks publish ONLY rank-local state (this

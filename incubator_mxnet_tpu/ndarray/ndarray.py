@@ -27,7 +27,18 @@ from ..base import MXNetError, np_dtype, dtype_name
 from ..context import Context, current_context, cpu
 from .. import engine as _engine
 from .. import autograd as _autograd
+from ..analysis import hostsync as _hostsync
 from ..ops import registry as _reg
+
+
+def _raise_use_after_donation(jarr, exc):
+    """Translate a read of a donation-deleted buffer into an MXNetError
+    naming the owning parameter (analysis.donation); no-op — and free —
+    when the buffer is alive (only ever called from exception handlers)."""
+    from ..analysis import donation as _donation
+    msg = _donation.explain(jarr)
+    if msg is not None:
+        raise MXNetError(msg) from exc
 
 __all__ = ["NDArray", "invoke", "array", "zeros", "ones", "full", "empty",
            "arange", "eye", "linspace", "concatenate", "moveaxis", "waitall",
@@ -116,11 +127,23 @@ class NDArray:
     # -- sync / conversion ---------------------------------------------------
     def wait_to_read(self):
         """Block until the value is computed (reference `NDArray::WaitToRead`)."""
-        _engine.wait_to_read(self._data)
+        if _hostsync._active:
+            _hostsync.note("wait_to_read")
+        try:
+            _engine.wait_to_read(self._data)
+        except Exception as e:
+            _raise_use_after_donation(self._data, e)
+            raise
 
     def asnumpy(self):
         """Copy to a numpy array, blocking (reference `ndarray.py asnumpy`)."""
-        return _np.asarray(self._data)
+        if _hostsync._active:
+            _hostsync.note("asnumpy")
+        try:
+            return _np.asarray(self._data)
+        except Exception as e:
+            _raise_use_after_donation(self._data, e)
+            raise
 
     def asscalar(self):
         if self.size != 1:
@@ -477,18 +500,27 @@ def invoke(op, data, kwargs, out=None):
         in_arrays = in_arrays + [_random.next_key()]
 
     from .. import profiler as _profiler
-    if _profiler._imperative_active():
-        # honest per-op timing requires waiting out async dispatch; only
-        # paid while the profiler runs (reference profile_imperative)
-        import time as _time
-        import jax as _jax
-        t0 = _time.perf_counter()
-        results = _reg.eager_call(op, params, in_arrays)
-        _jax.block_until_ready(results)
-        _profiler.record_op(op.name,
-                            (_time.perf_counter() - t0) * 1e6)
-    else:
-        results = _reg.eager_call(op, params, in_arrays)
+    try:
+        if _profiler._imperative_active():
+            # honest per-op timing requires waiting out async dispatch;
+            # only paid while the profiler runs (profile_imperative)
+            import time as _time
+            import jax as _jax
+            t0 = _time.perf_counter()
+            results = _reg.eager_call(op, params, in_arrays)
+            _jax.block_until_ready(results)
+            _profiler.record_op(op.name,
+                                (_time.perf_counter() - t0) * 1e6)
+        else:
+            results = _reg.eager_call(op, params, in_arrays)
+    except Exception as e:
+        # an input whose buffer a fused step's donation consumed dies
+        # inside jax with an opaque "Array has been deleted" — name the
+        # parameter instead (analysis.donation)
+        for d in data:
+            if isinstance(d, NDArray):
+                _raise_use_after_donation(d._data, e)
+        raise
     n_out = op.num_outputs(params)
     vis, aux_updates = results[:n_out], results[n_out:]
 
@@ -502,7 +534,7 @@ def invoke(op, data, kwargs, out=None):
         vis = tuple(jax.device_put(v, out_ctx.jax_device) for v in vis)
 
     for v in vis:
-        _engine.track(v)
+        _engine.track(v, op=op.name)
 
     # write updated aux states in place (BatchNorm running stats etc.)
     if aux_updates and n_aux:

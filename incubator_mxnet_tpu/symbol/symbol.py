@@ -24,7 +24,37 @@ import numpy as _np
 from ..base import MXNetError
 from ..ops import registry as _reg
 
-__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "check_unique_names"]
+
+
+def check_unique_names(symbol):
+    """Reject graphs whose VARIABLE names shadow each other (bind-time
+    gate, called by the Executor).
+
+    Two distinct nodes sharing a name where at least one is a variable
+    break `arg_dict`: the dict collapses the duplicates and binding
+    silently trains/feeds the wrong arrays.  Same-name OP pairs are
+    tolerated — gluon's hybridize traces name every layer's op ``fwd``
+    by design, and op identity is positional — the `mxlint`
+    duplicate-name warning covers them.  Empty names always raise."""
+    seen = {}
+    for node in symbol._topo():
+        if not str(node.name).strip():
+            kind = "variable" if node.is_variable else f"op {node.op.name}"
+            raise MXNetError(f"invalid graph: {kind} node has an empty "
+                             "name")
+        first = seen.get(node.name)
+        if first is None:
+            seen[node.name] = node
+        elif node.is_variable or first.is_variable:
+            raise MXNetError(
+                f"invalid graph: two distinct nodes share the name "
+                f"'{node.name}' "
+                f"({'variable' if first.is_variable else first.op.name} vs "
+                f"{'variable' if node.is_variable else node.op.name}); "
+                "duplicate names silently shadow each other in "
+                "arg_dict/tojson — rename one (mxlint: duplicate-name)")
 
 
 class _NameManager:
@@ -410,10 +440,49 @@ class Symbol:
 
 # ---------------------------------------------------------------------------
 
+_WALK_CAP = 2000  # composition-time name-check budget (see below)
+
+
+def _reject_name_collision(names, entries, op_name):
+    """Composition-time duplicate rejection for EXPLICITLY named ops: the
+    new node's name and its to-be-auto-created parameter variable names
+    must not collide with a VARIABLE already in the input graphs —
+    `arg_dict` would collapse the duplicates and bind would train/feed
+    the wrong arrays.  Same-name OP pairs stay legal (gluon names every
+    layer's traced op ``fwd``; op identity is positional) and are left
+    to the mxlint duplicate-name warning.  Auto-generated names are
+    collision-free per thread (_NameManager counters), so only explicit
+    names pay this walk — and the walk is CAPPED: past _WALK_CAP visited
+    nodes (big unrolled graphs, where per-op walks go quadratic) the
+    early build-time error is ceded to the O(n) bind-time gate
+    `check_unique_names`, which enforces the same invariant."""
+    seen = set()
+    stack = [n for n, _ in entries]
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        if len(seen) >= _WALK_CAP:
+            return
+        seen.add(id(node))
+        if node.is_variable and node.name in names:
+            raise MXNetError(
+                f"cannot create op ({op_name}) named "
+                f"'{sorted(names, key=len)[0]}': it would carry the name "
+                f"'{node.name}', which already names a variable in the "
+                "input graph; duplicate node names silently shadow each "
+                "other in arg_dict/tojson — pick a unique name")
+        stack.extend(src for src, _ in node.inputs)
+
+
 def _sym_apply(op_name, inputs, kwargs):
     op = _reg.get(op_name)
     name = kwargs.pop("name", None)
     attr = kwargs.pop("attr", None)
+    if name is not None and not str(name).strip():
+        raise MXNetError(f"Operator {op_name}: node name must be a "
+                         "non-empty string")
+    explicit_name = name is not None
     if op.variadic_param and op.variadic_param not in kwargs:
         kwargs[op.variadic_param] = len(inputs)
     params = op.canonicalize_params(kwargs)
@@ -436,6 +505,11 @@ def _sym_apply(op_name, inputs, kwargs):
     from ..attribute import current_attrs
     scope_attrs = current_attrs()
     slot_names = op.list_input_names(params)
+    if explicit_name:
+        missing = slot_names[len(entries):] if slot_names is not None else []
+        _reject_name_collision(
+            {name} | {f"{name}_{slot}" for slot in missing}, entries,
+            op.name)
     if slot_names is not None and len(entries) < len(slot_names):
         for slot in slot_names[len(entries):]:
             vnode = _Node(None, f"{name}_{slot}", {}, [])
@@ -468,6 +542,10 @@ def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
     """Create a symbolic variable (reference `symbol.py Variable`)."""
     if not isinstance(name, str):
         raise TypeError("Expect a string for variable name")
+    if not name.strip():
+        raise MXNetError("variable name must be a non-empty string "
+                         "(empty names cannot be addressed in arg_dict "
+                         "or saved JSON)")
     node = _Node(None, name, {}, [])
     from ..attribute import current_attrs
     node._extra_attrs.update(current_attrs())

@@ -1,0 +1,485 @@
+"""mxlint static & trace analysis: every lint class detects its seeded
+defect with exact names/locations, and the clean example graphs produce
+zero false positives (the ISSUE-3 acceptance gate).
+
+Covers: graph passes (f64 promotion, dead outputs, unbound inputs, bad
+layout, duplicate/empty names, shared aux), JSON structural passes,
+script AST lints + suppression, the mxlint CLI over examples/, runtime
+donation tracking (use-after-donate raises MXNetError naming the
+parameter), host-sync attribution inside Module.fit, the recompilation
+audit for ragged batches, and the NaiveEngine contextful error chain.
+"""
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import analysis, engine, fused, io, nd, rnn, sym
+from incubator_mxnet_tpu.base import MXNetError
+from incubator_mxnet_tpu.io import DataBatch
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+THIS_FILE = os.path.abspath(__file__)
+
+
+@pytest.fixture(autouse=True)
+def _analysis_clean():
+    analysis.reset_runtime()
+    yield
+    analysis.disable()
+    analysis.reset_runtime()
+
+
+def _load_example(relpath, name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, relpath))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _mlp_symbol():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _fused_module(batch_size=16):
+    X = np.random.randn(64, 16).astype("f4")
+    y = np.random.randint(0, 4, 64).astype("f4")
+    it = io.NDArrayIter(X, y, batch_size=batch_size,
+                        label_name="softmax_label")
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(kvstore="device", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    return mod, list(it), X, y
+
+
+# ---------------------------------------------------------------------------
+# graph passes: seeded defects
+# ---------------------------------------------------------------------------
+
+def test_f64_promotion_detected_with_node_name():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=8, name="fca")
+    net = sym.Cast(net, dtype="float64", name="to64")
+    net = sym.SoftmaxOutput(sym.FullyConnected(net, num_hidden=4,
+                                               name="fcb"), name="sm")
+    report = analysis.check(net, shapes={"data": (8, 16), "sm_label": (8,)})
+    hits = [f for f in report if f.code == "f64-promotion"]
+    assert len(hits) == 1
+    assert hits[0].node == "to64"
+    assert "float64" in hits[0].message
+    # declared-f64 variable is an origin too
+    v64 = sym.Variable("big", dtype="float64")
+    out = sym.SoftmaxOutput(sym.FullyConnected(v64, num_hidden=4,
+                                               name="fcv"), name="sv")
+    hits = [f for f in analysis.check(out) if f.code == "f64-promotion"]
+    assert [f.node for f in hits] == ["big"]
+
+
+def test_dead_output_detected():
+    split = sym.SliceChannel(sym.Variable("x"), num_outputs=3, name="spl")
+    only_first = split[0]
+    hits = [f for f in analysis.check(only_first)
+            if f.code == "dead-output"]
+    assert sorted(f.message for f in hits)
+    assert len(hits) == 2 and all(f.node == "spl" for f in hits)
+    assert any("spl_output1" in f.message for f in hits)
+    assert any("spl_output2" in f.message for f in hits)
+    # all outputs consumed -> clean
+    joined = sym.Group([split[0], split[1], split[2]])
+    assert not [f for f in analysis.check(joined)
+                if f.code == "dead-output"]
+
+
+def test_unbound_input_detected():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = sym.broadcast_add(net, sym.Variable("mystery"))
+    net = sym.SoftmaxOutput(net, name="softmax")
+    report = analysis.check(net, shapes={"data": (8, 16),
+                                         "softmax_label": (8,)})
+    hits = [f for f in report if f.code == "unbound-input"]
+    assert [f.node for f in hits] == ["mystery"]
+    # with no shapes given the pass stays quiet (nothing is inferable)
+    assert not [f for f in analysis.check(net)
+                if f.code == "unbound-input"]
+
+
+def test_bad_layout_hint_and_severity():
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(sym.Variable("data"), num_hidden=100,
+                           name="odd_fc"), name="softmax")
+    report = analysis.check(net)
+    hits = [f for f in report if f.code == "tpu-layout"]
+    assert [f.node for f in hits] == ["odd_fc"]
+    assert hits[0].severity == "hint" and "100" in hits[0].message
+    # hints never survive a warn-level filter (CLI default)
+    assert not [f for f in report.filter(max_severity=analysis.WARN)
+                if f.code == "tpu-layout"]
+    # aligned dims are clean
+    ok = sym.SoftmaxOutput(
+        sym.FullyConnected(sym.Variable("data"), num_hidden=256,
+                           name="fc"), name="softmax")
+    assert not [f for f in analysis.check(ok) if f.code == "tpu-layout"]
+    # per-node suppression via the __lint__ attr
+    sup = sym.SoftmaxOutput(
+        sym.FullyConnected(sym.Variable("data"), num_hidden=100,
+                           name="odd2", attr={"__lint__": "tpu-layout"}),
+        name="softmax")
+    assert not [f for f in analysis.check(sup) if f.code == "tpu-layout"]
+
+
+def test_shared_aux_detected():
+    data = sym.Variable("data")
+    mm, mv = sym.Variable("shared_mean"), sym.Variable("shared_var")
+    bn1 = sym.BatchNorm(data, sym.Variable("g1"), sym.Variable("b1"),
+                        mm, mv, name="bn1")
+    bn2 = sym.BatchNorm(bn1, sym.Variable("g2"), sym.Variable("b2"),
+                        mm, mv, name="bn2")
+    hits = [f for f in analysis.check(bn2) if f.code == "shared-aux"]
+    assert sorted(f.node for f in hits) == ["shared_mean", "shared_var"]
+    assert "bn1" in hits[0].message and "bn2" in hits[0].message
+
+
+def test_duplicate_and_empty_names_rejected_at_build_time():
+    data = sym.Variable("data")
+    first = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    with pytest.raises(MXNetError, match="fc1"):
+        sym.FullyConnected(first, num_hidden=8, name="fc1")
+    with pytest.raises(MXNetError, match="non-empty"):
+        sym.FullyConnected(data, num_hidden=8, name="  ")
+    with pytest.raises(MXNetError, match="non-empty"):
+        sym.Variable("")
+    # an op name shadowing an input VARIABLE is rejected too
+    with pytest.raises(MXNetError, match="data"):
+        sym.FullyConnected(data, num_hidden=8, name="data")
+
+
+def test_duplicate_names_in_json_detected_and_bind_rejects():
+    graph = {
+        "nodes": [
+            {"op": "null", "name": "x", "inputs": []},
+            {"op": "relu", "name": "x", "inputs": [[0, 0, 0]]},
+            {"op": "null", "name": "orphan_moving_mean", "inputs": []},
+        ],
+        "arg_nodes": [0, 2],
+        "heads": [[1, 0, 0]],
+    }
+    report = analysis.check_json(json.dumps(graph), target="g.json")
+    codes = report.by_code()
+    assert codes.get("duplicate-name") == 1
+    assert codes.get("unreachable-node") == 1
+    unreachable = [f for f in report if f.code == "unreachable-node"]
+    assert unreachable[0].node == "orphan_moving_mean"
+    # binding a graph whose op shadows a VARIABLE name fails loudly
+    # instead of training the wrong arrays
+    loaded = mx.sym.load_json(json.dumps(graph))
+    with pytest.raises(MXNetError, match="'x'"):
+        loaded.simple_bind(ctx=mx.cpu(), x=(2, 3))
+    # op-op duplicates are the gluon `fwd` idiom: lint-warn, not an error
+    dup_ops = sym.Group([
+        sym.Activation(sym.Variable("a"), act_type="relu", name="fwd"),
+        sym.Activation(sym.Variable("b"), act_type="relu", name="fwd")])
+    hits = [f for f in analysis.check(dup_ops)
+            if f.code == "duplicate-name"]
+    assert len(hits) == 1 and hits[0].severity == "warn"
+    dup_ops.simple_bind(ctx=mx.cpu(), a=(2, 2), b=(2, 2))  # binds fine
+
+
+# ---------------------------------------------------------------------------
+# zero false positives on the example graphs
+# ---------------------------------------------------------------------------
+
+def _assert_clean(symbol, shapes, what):
+    report = analysis.check(symbol, shapes=shapes)
+    bad = report.filter(max_severity=analysis.WARN)
+    assert not bad, f"{what}: unexpected findings:\n{bad.format()}"
+
+
+def test_zero_false_positives_image_classification_graphs():
+    mnist = _load_example(
+        "examples/image_classification/train_mnist.py", "_ex_mnist")
+    _assert_clean(mnist.get_mlp(),
+                  {"data": (8, 1, 28, 28), "softmax_label": (8,)}, "mlp")
+    _assert_clean(mnist.get_lenet(),
+                  {"data": (8, 1, 28, 28), "softmax_label": (8,)}, "lenet")
+    resnet = _load_example(
+        "examples/image_classification/symbols/resnet.py", "_ex_resnet")
+    _assert_clean(resnet.get_symbol(10, 8, "3,28,28"),
+                  {"data": (4, 3, 28, 28), "softmax_label": (4,)},
+                  "resnet-8")
+
+
+def test_zero_false_positives_rnn_graph():
+    # the lstm_bucketing sym_gen graph (examples/rnn) rebuilt verbatim
+    stack = rnn.SequentialRNNCell()
+    for i in range(2):
+        stack.add(rnn.LSTMCell(50, prefix=f"lstm_l{i}_"))
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    embed = mx.sym.Embedding(data, input_dim=100, output_dim=32,
+                             name="embed")
+    stack.reset()
+    outputs, _ = stack.unroll(10, inputs=embed, merge_outputs=True)
+    pred = mx.sym.Reshape(outputs, shape=(-1, 50))
+    pred = mx.sym.FullyConnected(pred, num_hidden=100, name="pred")
+    lab = mx.sym.Reshape(label, shape=(-1,))
+    pred = mx.sym.SoftmaxOutput(pred, lab, name="softmax")
+    _assert_clean(pred, {"data": (8, 10), "softmax_label": (8, 10)},
+                  "lstm-bucketing")
+
+
+def test_module_check_clean_and_roundtrip_json():
+    X = np.random.randn(32, 16).astype("f4")
+    y = np.random.randint(0, 4, 32).astype("f4")
+    it = io.NDArrayIter(X, y, batch_size=8, label_name="softmax_label")
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    report = mod.check()
+    assert not report.filter(max_severity=analysis.WARN), report.format()
+    # saved-JSON front end agrees with the Symbol front end
+    jreport = analysis.check_json(_mlp_symbol().tojson(), target="mlp")
+    assert not jreport.filter(max_severity=analysis.WARN), jreport.format()
+
+
+# ---------------------------------------------------------------------------
+# script AST lints + CLI
+# ---------------------------------------------------------------------------
+
+def test_source_lints_detect_and_suppress():
+    src = (
+        "import incubator_mxnet_tpu as mx\n"            # 1
+        "ctx = mx.tpu()\n"                              # 2
+        "for i in range(10):\n"                         # 3
+        "    v = out.asnumpy()\n"                       # 4
+        "    w = other.asnumpy()  # mxlint: disable\n"  # 5
+        "    u = x.wait_to_read()"
+        "  # mxlint: disable=kvstore-local-on-tpu\n"    # 6 (wrong code)
+        "mod.fit(data, kvstore='local')\n"              # 7
+    )
+    report = analysis.check_source(src, "demo.py")
+    locs = {f.code: f.location for f in report}
+    assert locs["kvstore-local-on-tpu"] == "demo.py:7"
+    syncs = sorted(f.location for f in report
+                   if f.code == "host-sync-in-loop")
+    assert syncs == ["demo.py:4", "demo.py:6"]   # line 5 suppressed
+    # no tpu usage -> kvstore lint stays quiet
+    quiet = analysis.check_source("mod.fit(d, kvstore='local')\n", "q.py")
+    assert not [f for f in quiet if f.code == "kvstore-local-on-tpu"]
+    # function defined inside a loop is not a per-iteration sync
+    fn_src = "for i in r:\n    def cb(p):\n        q = o.asnumpy()\n"
+    assert not analysis.check_source(fn_src, "f.py").findings
+
+
+def test_mxlint_cli_examples_zero_findings_and_seeded_defects(tmp_path,
+                                                              capsys):
+    import importlib
+    spec = importlib.util.spec_from_file_location(
+        "_mxlint_cli", os.path.join(REPO, "tools", "mxlint.py"))
+    cli = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cli)
+
+    # acceptance gate: zero findings over the clean examples tree
+    rc = cli.main([os.path.join(REPO, "examples"), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["failing"] == 0 and out["findings"] == 0
+
+    # seeded defects: a hot-loop script and a shadowed-graph JSON
+    bad_py = tmp_path / "train_bad.py"
+    bad_py.write_text("import incubator_mxnet_tpu as mx\n"
+                      "ctx = mx.tpu()\n"
+                      "for b in it:\n"
+                      "    print(loss.asnumpy())\n"
+                      "m.fit(it, kvstore='local')\n")
+    bad_json = tmp_path / "net-symbol.json"
+    bad_json.write_text(json.dumps({
+        "nodes": [{"op": "null", "name": "w", "inputs": []},
+                  {"op": "null", "name": "w", "inputs": []}],
+        "arg_nodes": [0, 1], "heads": [[0, 0, 0]]}))
+    rc = cli.main([str(tmp_path), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["by_code"]["host-sync-in-loop"] == 1
+    assert out["by_code"]["kvstore-local-on-tpu"] == 1
+    assert out["by_code"]["duplicate-name"] == 1
+    assert out["by_code"]["unreachable-node"] == 1
+    items = {i["code"]: i for i in out["items"]}
+    assert items["host-sync-in-loop"]["location"] == f"{bad_py}:4"
+
+
+# ---------------------------------------------------------------------------
+# runtime trace passes
+# ---------------------------------------------------------------------------
+
+def test_use_after_donation_raises_naming_parameter():
+    analysis.enable()
+    mod, batches, _, _ = _fused_module()
+    metric = mx.metric.create("acc")
+    mod.fit_step(batches[0], metric)   # cold step: flushes through
+    mod.fit_step(batches[1], metric)   # steady step: donates the flushed
+    assert mod._fused_step is not None and not mod._fused_step.broken
+    stale = mod._exec_group.execs[0].arg_dict["fc1_weight"]
+    with pytest.raises(MXNetError, match=r"fc1_weight.*donated"):
+        stale.asnumpy()
+    # eager ops on the stale buffer get the same named error
+    with pytest.raises(MXNetError, match="use-after-donation"):
+        (stale * 2).asnumpy()
+    # the public path flushes and keeps working
+    args, _ = mod.get_params()
+    assert np.isfinite(args["fc1_weight"].asnumpy()).all()
+
+
+def test_use_after_donation_generic_message_when_disabled():
+    analysis.disable()
+    mod, batches, _, _ = _fused_module()
+    metric = mx.metric.create("acc")
+    mod.fit_step(batches[0], metric)
+    mod.fit_step(batches[1], metric)
+    stale = mod._exec_group.execs[0].arg_dict["fc1_weight"]
+    with pytest.raises(MXNetError, match="use-after-donation"):
+        stale.asnumpy()
+
+
+def test_unrecoverable_failure_names_consumed_parameters():
+    import jax
+    import jax.numpy as jnp
+    arr = jax.device_put(jnp.zeros((2,)))
+    arr.delete()
+    live = jax.device_put(jnp.ones((2,)))
+    with pytest.raises(MXNetError, match=r"'fc9_weight'.*unrecoverable"):
+        fused._raise_if_unrecoverable(
+            "fused train step", ValueError("boom"),
+            [("ok_param", [live]), ("fc9_weight", [arr])])
+    # intact buffers: triage returns, fallback is allowed
+    fused._raise_if_unrecoverable("fused train step", ValueError("x"),
+                                  [("ok_param", [live])])
+
+
+def test_ragged_batch_retraces_and_audit_names_the_arg():
+    analysis.enable()
+    mod, batches, X, y = _fused_module()
+    metric = mx.metric.create("acc")
+    mod.fit_step(batches[0], metric)
+    mod.fit_step(batches[1], metric)
+    ragged = DataBatch([nd.array(X[:5])], [nd.array(y[:5])])
+    assert mod._fused_step(ragged, metric)        # retrace, not breakage
+    assert not mod._fused_step.broken
+    assert mod._fused_step(batches[2], metric)    # cached program swaps back
+    hits = [f for f in analysis.runtime_report()
+            if f.code == "shape-churn"]
+    assert len(hits) == 1, [f.message for f in hits]
+    msg = hits[0].message
+    assert "'data' shape (16, 16) -> (5, 16)" in msg
+    assert "'softmax_label' shape (16,) -> (5,)" in msg
+    assert "ragged final batch" in msg
+    args, _ = mod.get_params()
+    assert np.isfinite(args["fc1_weight"].asnumpy()).all()
+
+
+def test_gluon_fused_step_ragged_batch_retraces():
+    from incubator_mxnet_tpu import gluon
+    from incubator_mxnet_tpu.gluon.fused_step import GluonFusedStep
+    analysis.enable()
+    rng = np.random.RandomState(3)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"))
+    net.add(gluon.nn.Dense(3))
+    net.initialize()
+    net(nd.array(np.zeros((2, 12), "f4")))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    metric = mx.metric.Accuracy()
+    step = GluonFusedStep.try_build(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), trainer, [metric])
+    assert step is not None
+    X = rng.randn(64, 12).astype("f4")
+    y = rng.randint(0, 3, 64).astype("f4")
+    assert step(nd.array(X[:16]), nd.array(y[:16]), 16)
+    assert step(nd.array(X[16:32]), nd.array(y[16:32]), 16)
+    assert step(nd.array(X[:7]), nd.array(y[:7]), 7)      # ragged tail
+    assert not step.broken, "ragged batch must retrace, not break"
+    assert step(nd.array(X[32:48]), nd.array(y[32:48]), 16)  # cache swap
+    hits = [f for f in analysis.runtime_report()
+            if f.code == "shape-churn" and "GluonFusedStep" in f.location]
+    assert len(hits) == 1, [f.message for f in hits]
+    assert "'data' shape (16, 12) -> (7, 12)" in hits[0].message
+
+
+def test_hostsync_attributed_to_callback_line():
+    analysis.enable()
+    X = np.random.randn(32, 16).astype("f4")
+    y = np.random.randint(0, 4, 32).astype("f4")
+    it = io.NDArrayIter(X, y, batch_size=8, label_name="softmax_label")
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    seen = []
+
+    def peek(_param):
+        seen.append(mod.get_outputs()[0].asnumpy())   # the hot-loop sync
+
+    sync_line = peek.__code__.co_firstlineno + 1
+    mod.fit(it, num_epoch=1, optimizer="sgd", batch_end_callback=peek)
+    hits = [f for f in analysis.runtime_report()
+            if f.code == "host-sync-in-loop" and
+            f.location == f"{THIS_FILE}:{sync_line}"]
+    assert len(hits) == 1, analysis.runtime_report().format()
+    assert hits[0].count == len(seen) == 4
+    assert "Module.fit" in hits[0].message
+
+
+def test_hostsync_quiet_when_disabled():
+    analysis.disable()
+    X = np.random.randn(16, 16).astype("f4")
+    y = np.random.randint(0, 4, 16).astype("f4")
+    it = io.NDArrayIter(X, y, batch_size=8, label_name="softmax_label")
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.fit(it, num_epoch=1, optimizer="sgd",
+            batch_end_callback=lambda p: mod.get_outputs()[0].asnumpy())
+    assert not [f for f in analysis.runtime_report()
+                if f.code == "host-sync-in-loop"]
+
+
+def test_recompile_auditor_unit():
+    key = "unit-test-program"
+    sig16 = ((( 16, 16), "float32"), ((16,), "float32"))
+    sig5 = (((5, 16), "float32"), ((5,), "float32"))
+    assert analysis.recompile.note(key, ("data", "label"), sig16) is None
+    assert analysis.recompile.note(key, ("data", "label"), sig16) is None
+    f = analysis.recompile.note(key, ("data", "label"), sig5)
+    assert f is not None and "'data' shape (16, 16) -> (5, 16)" in f.message
+    # a previously-seen signature does not re-fire
+    assert analysis.recompile.note(key, ("data", "label"), sig16) is None
+    assert len(analysis.recompile.signatures(key)) == 2
+    # dtype churn is named as such, without the ragged diagnosis
+    f2 = analysis.recompile.note(key, ("data", "label"),
+                                 (((16, 16), "float16"), ((16,), "float32")))
+    assert "dtype float32 -> float16" in f2.message
+    assert "ragged" not in f2.message
+
+
+def test_naive_engine_track_chains_contextful_error():
+    class Boom:
+        def block_until_ready(self):
+            raise RuntimeError("XLA buffer poisoned")
+
+    prev = os.environ.get("MXNET_ENGINE_TYPE")
+    os.environ["MXNET_ENGINE_TYPE"] = "NaiveEngine"
+    try:
+        with pytest.raises(MXNetError,
+                           match=r"NaiveEngine: operator 'dot'") as exc:
+            engine.track(Boom(), op="dot")
+        assert "XLA buffer poisoned" in str(exc.value)
+        assert isinstance(exc.value.__cause__, RuntimeError)
+    finally:
+        if prev is None:
+            os.environ.pop("MXNET_ENGINE_TYPE", None)
+        else:
+            os.environ["MXNET_ENGINE_TYPE"] = prev

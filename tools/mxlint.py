@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""mxlint — static TPU-hazard linter for symbol graphs and scripts.
+
+Front ends (analysis/ package):
+
+* saved symbol JSON  — duplicate/empty names, unreachable nodes, dead
+  outputs, aux races, f64 promotion, unbound inputs, TPU tile hints;
+* python scripts     — AST lints: `.asnumpy()`/`.asscalar()`/
+  `.wait_to_read()`/`waitall()` inside loops (host-sync-in-loop),
+  literal ``kvstore='local'`` in TPU scripts.
+
+Usage:
+    python tools/mxlint.py PATH [PATH ...]
+        PATH: a .py script, a symbol .json, or a directory (scanned
+        recursively for both).
+    --hints            include perf hints (tpu-layout) in the output
+    --shape name=d,... seed graph shape inference (repeatable), e.g.
+                       --shape data=64,3,224,224
+    --suppress codes   comma list of finding codes to drop
+    --json             machine-readable summary (one JSON object)
+
+Exit status: 0 when no error/warn findings survive, 1 otherwise (hints
+never fail the run).  Inline suppression: ``# mxlint: disable[=code]``
+on the offending source line, or a ``__lint__`` attr on a graph node.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _collect(paths):
+    py, js = [], []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                for f in sorted(files):
+                    full = os.path.join(root, f)
+                    if f.endswith(".py"):
+                        py.append(full)
+                    elif f.endswith(".json"):
+                        js.append(full)
+        elif p.endswith(".py"):
+            py.append(p)
+        elif p.endswith(".json"):
+            js.append(p)
+        else:
+            print(f"mxlint: skipping {p!r} (not a .py/.json or directory)",
+                  file=sys.stderr)
+    return py, js
+
+
+def _looks_like_symbol_json(text):
+    head = text.lstrip()[:1]
+    return head == "{" and '"nodes"' in text
+
+
+def _parse_shapes(items):
+    shapes = {}
+    for item in items or ():
+        name, _, dims = item.partition("=")
+        if not dims:
+            raise SystemExit(f"mxlint: bad --shape {item!r} "
+                             "(want name=d0,d1,...)")
+        shapes[name] = tuple(int(d) for d in dims.split(",") if d)
+    return shapes
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="mxlint", description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="+")
+    ap.add_argument("--hints", action="store_true",
+                    help="include perf hints (tpu-layout)")
+    ap.add_argument("--shape", action="append", default=[],
+                    metavar="NAME=D0,D1,...")
+    ap.add_argument("--suppress", default="",
+                    metavar="CODE[,CODE...]")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    from incubator_mxnet_tpu import analysis
+    shapes = _parse_shapes(args.shape)
+    suppress = {c.strip() for c in args.suppress.split(",") if c.strip()}
+
+    py_files, json_files = _collect(args.paths)
+    reports = []
+    scanned = 0
+    for path in py_files:
+        scanned += 1
+        reports.append(analysis.check_source_file(path))
+    for path in json_files:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            text = f.read()
+        if not _looks_like_symbol_json(text):
+            continue  # round artifacts etc., not graphs
+        scanned += 1
+        reports.append(analysis.check_json(text, shapes=shapes or None,
+                                           hints=args.hints, target=path))
+
+    findings = []
+    for r in reports:
+        r = r.suppress(suppress)
+        if not args.hints:
+            r = r.filter(max_severity=analysis.WARN)
+        findings.extend(r.findings)
+
+    by_code, by_pass = {}, {}
+    for f in findings:
+        by_code[f.code] = by_code.get(f.code, 0) + 1
+        by_pass[f.pass_name] = by_pass.get(f.pass_name, 0) + 1
+    failing = [f for f in findings if f.severity in ("error", "warn")]
+
+    if args.as_json:
+        print(json.dumps({
+            "scanned": scanned,
+            "findings": len(findings),
+            "failing": len(failing),
+            "by_code": by_code,
+            "by_pass": by_pass,
+            "items": [f.as_dict() for f in findings[:200]],
+        }, indent=1))
+    else:
+        for f in findings:
+            print(f.format())
+        print(f"mxlint: {scanned} file(s) scanned, "
+              f"{len(findings)} finding(s)"
+              + (f" ({json.dumps(by_code)})" if findings else ""))
+    return 1 if failing else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -74,6 +74,24 @@ def parse_outcomes(output):
     return counts, tests
 
 
+def mxlint_stage():
+    """Static-analysis stage: run tools/mxlint.py over examples/ in a
+    throwaway process and return its JSON summary (finding counts per
+    pass/code) for the round artifact — graph-hygiene regressions become
+    checkable evidence next to the parity outcomes."""
+    cmd = [sys.executable, os.path.join(REPO, "tools", "mxlint.py"),
+           os.path.join(REPO, "examples"), "--json"]
+    try:
+        out = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                             timeout=600)
+        summary = json.loads(out.stdout)
+        summary.pop("items", None)   # counts are the artifact; findings
+        summary["rc"] = out.returncode  # themselves live in the lint run
+        return summary
+    except Exception as exc:
+        return {"error": f"mxlint stage failed: {exc!r}"}
+
+
 def main():
     rnd = "%02d" % (int(sys.argv[1]) if len(sys.argv) > 1 else next_round())
     t0 = time.time()
@@ -90,6 +108,7 @@ def main():
         "duration_s": round(time.time() - t0, 1),
         "git_rev": git_revision(),
         "jax": probe_backend(),
+        "mxlint": mxlint_stage(),
         "cmd": " ".join(cmd[2:]),
         "tests": tests[:500],
         "tail": "\n".join(output.strip().splitlines()[-12:])[-2000:],
