@@ -46,7 +46,7 @@ for _mod_name, _aliases in [
     ("parallel", ()), ("test_utils", ()), ("image", ()), ("operator", ()),
     ("contrib", ()), ("rnn", ()), ("compat", ()), ("dist", ()),
     ("subgraph", ()), ("storage", ()), ("libinfo", ()),
-    ("checkpoint", ()), ("kvstore_server", ()),
+    ("checkpoint", ()), ("serving", ()), ("kvstore_server", ()),
     ("native", ()),
 ]:
     try:

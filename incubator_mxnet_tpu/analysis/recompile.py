@@ -17,7 +17,7 @@ import threading
 
 from .findings import Finding, WARN
 
-__all__ = ["note", "findings", "signatures", "reset"]
+__all__ = ["note", "register", "findings", "signatures", "reset"]
 
 _lock = threading.Lock()
 _seen = {}       # key -> list of signatures in first-seen order
@@ -80,6 +80,20 @@ def note(key, names, sig):
         if len(_findings) < _MAX_FINDINGS:
             _findings.append(f)
     return f
+
+
+def register(key, names, sig):
+    """Pre-declare an EXPECTED signature for program `key` without a
+    shape-churn finding — the serving runtime's warmup path registers every
+    bucket it compiles up front, so only post-warmup novelty (a request
+    shape no bucket covers) surfaces as churn.  `names` is accepted for
+    symmetry with `note` (the later diff uses the noted names)."""
+    del names
+    sig = tuple(sig)
+    with _lock:
+        hist = _seen.setdefault(key, [])
+        if sig not in hist and len(hist) < _MAX_SIGS:
+            hist.append(sig)
 
 
 def signatures(key):

@@ -3,11 +3,12 @@
 The reference ships a standalone inference ABI
 (`include/mxnet/c_predict_api.h:78-200`: create a predictor from saved
 symbol JSON + params bytes, set inputs, forward, read outputs) used by the
-amalgamation/mobile builds.  The TPU build keeps the same surface: the C
-shared library embeds CPython and drives THIS module, whose predictor
-binds the symbol through the ordinary executor (one XLA program per
-signature), so C callers get the same compiled inference path as Python
-callers.
+amalgamation/mobile builds.  The TPU build keeps the same surface, but the
+predictor is now a thin adapter over the serving runtime's single-request
+path (`serving.ServedModel.infer`): the C parity API and a `ModelServer`
+hosting the same model share ONE per-signature compiled-program cache
+(`fused.FusedInference`), so a process that both serves traffic and
+answers C-ABI calls compiles each shape exactly once.
 """
 from __future__ import annotations
 
@@ -22,7 +23,7 @@ class Predictor:
         from . import context as ctx_mod
         from . import symbol as sym_mod
         from .compat.mxnet_params import load_params
-        from .executor import Executor
+        from .serving.model import ServedModel
 
         ctx = (ctx_mod.cpu(dev_id) if dev_type == 1 else
                ctx_mod.tpu(dev_id))
@@ -30,10 +31,12 @@ class Predictor:
         sym = sym_mod.load_json(symbol_json)
         arg_names = sym.list_arguments()
         aux_names = sym.list_auxiliary_states()
+        input_shapes = {k: tuple(v) for k, v in dict(input_shapes).items()}
+        self._input_shapes = input_shapes
         self._input_names = list(input_shapes)
-        self._exec = Executor._simple_bind(sym, ctx, "null", None,
-                                           dict(input_shapes))
         params = load_params(param_bytes)
+        if not isinstance(params, dict):   # nameless save of zero params
+            params = {}
         args, auxs = {}, {}
         for k, v in params.items():
             if ":" in k:
@@ -43,30 +46,42 @@ class Predictor:
                 args[k] = v
             elif k in aux_names:
                 auxs[k] = v
-        self._exec.copy_params_from(args, auxs, allow_extra_params=True)
+        # the ABI declares ONE exact signature: a single bucket sized to
+        # the declared batch, compiled on first forward (no warmup pass —
+        # the first call pays the one compile either way).  Each predictor
+        # audits under its own key so two predictors in one process don't
+        # read as each other's shape churn.
+        batch = max(int(next(iter(input_shapes.values()))[0]), 1) \
+            if input_shapes else 1
+        Predictor._seq = getattr(Predictor, "_seq", 0) + 1
+        self._model = ServedModel(sym, args, auxs,
+                                  data_shapes=list(input_shapes.items()),
+                                  buckets=(batch,), ctx=ctx,
+                                  name=f"c_predict#{Predictor._seq}")
+        self._inputs = {name: np.zeros(shape, np.float32)
+                        for name, shape in input_shapes.items()}
         self._outputs = None
 
     def output_count(self):
-        return len(self._exec._symbol.list_outputs())
+        return len(self._model.output_names)
 
     def set_input(self, name, flat_f32):
-        tgt = self._exec.arg_dict[name]
-        arr = np.asarray(flat_f32, dtype=np.float32).reshape(tgt.shape)
-        from .ndarray.ndarray import array
-        if self._ctx.device_type != "cpu":
-            # device_put is ASYNC and may read the caller's buffer after
-            # this call returns; the ABI promises copy semantics, so take a
-            # private host copy before handing it to the transfer
-            arr = np.array(arr, copy=True)
-        self._exec.arg_dict[name]._set_data(
-            array(arr, ctx=self._ctx, dtype=tgt.dtype)._data)
+        shape = self._input_shapes[name]
+        # the ABI promises copy semantics: the caller's buffer may be
+        # reused the moment this returns, so take a private host copy
+        self._inputs[name] = np.array(flat_f32, dtype=np.float32,
+                                      copy=True).reshape(shape)
+        self._outputs = None
 
     def set_input_bytes(self, name, view):
         """C ABI path: `view` is a read-only memoryview over float32."""
         self.set_input(name, np.frombuffer(view, dtype=np.float32))
 
     def forward(self):
-        self._outputs = self._exec.forward(is_train=False)
+        # exact declared shapes, no batch-axis coalescing semantics: the
+        # ABI's inputs need not share a leading dimension (a (8, 784)
+        # data input next to a (1, 256) state input is legal)
+        self._outputs = self._model.infer_exact(self._inputs)
 
     def output_shape(self, index):
         if self._outputs is None:

@@ -41,9 +41,10 @@ import logging
 
 import numpy as _np
 
+from .base import MXNetError
 from .ndarray.ndarray import NDArray
 
-__all__ = ["FusedOptimizer", "FusedTrainStep"]
+__all__ = ["FusedOptimizer", "FusedTrainStep", "FusedInference"]
 
 _log = logging.getLogger(__name__)
 
@@ -1251,3 +1252,160 @@ class FusedTrainStep:
 
 def metric_fns_changed(prev_ids, metric_fns):
     return prev_ids != [id(m) for _, m in metric_fns]
+
+
+# ---------------------------------------------------------------------------
+# FusedInference: the request path's per-signature program cache
+# ---------------------------------------------------------------------------
+
+class FusedInference:
+    """Inference over a pinned parameter set as one XLA program per input
+    signature — the request-path face of the per-signature caches the
+    fused train steps keep.
+
+    The whole Symbol compiles to ONE program (graph_eval_fn); parameters
+    and aux states are device-resident constants of the call, so every
+    dispatch ships only the request tensors.  `jax.jit`'s own cache keys
+    on the input signature: a fixed set of shape buckets therefore costs
+    exactly one compile each (paid at warmup), and every dispatch is
+    noted with the recompile auditor under `audit_key` so
+    ``MXNET_ANALYSIS=1`` can certify zero post-warmup compiles.
+
+    Thread-safe for concurrent callers: dispatch state is per-call; the
+    only mutation, `set_params`, swaps the whole param list atomically
+    (in-flight calls finish against the snapshot they captured).
+    """
+
+    def __init__(self, symbol, ctx, data_names, audit_key=None):
+        import jax
+        from .symbol.symbol import graph_eval_fn
+        self._symbol = symbol
+        self._ctx = ctx
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        unknown = [n for n in data_names if n not in self._arg_names]
+        if unknown:
+            # silently filtering would misalign every later input list
+            raise MXNetError(
+                f"FusedInference: data names {unknown} are not arguments "
+                f"of the symbol (has {self._arg_names})")
+        self._data_names = list(data_names)
+        # every non-data argument is a candidate parameter slot; slots the
+        # param dict never fills (e.g. a loss head's label input, whose
+        # shape follows the batch) become per-call inputs instead —
+        # `extra_names` after set_params — fed zeros by the serving layer
+        self._slot_names = [n for n in self._arg_names
+                            if n not in self._data_names]
+        self._input_names = list(self._data_names)
+        self._gfn, _, _, self._n_rng = graph_eval_fn(symbol, False)
+        # (jit, extra_names, params, aux): ONE reference, swapped whole,
+        # so a concurrent dispatch never pairs a rebuilt program with the
+        # previous partition's param list (or new params with old aux)
+        self._state = None
+        self._key = jax.random.PRNGKey(0)   # inference path draws nothing
+        FusedInference._seq = getattr(FusedInference, "_seq", 0) + 1
+        self.audit_key = audit_key or f"FusedInference#{FusedInference._seq}"
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    def set_params(self, arg_params, aux_params=None, aux_shapes=None):
+        """Pin the parameter set: every argument `arg_params` covers and
+        every aux state becomes a device-resident array, moved in ONE
+        batched transfer.  Uncovered argument slots become per-call
+        inputs (`extra_names` — their shapes may follow the batch); aux
+        states absent from `aux_params` are zeros of ``aux_shapes[name]``
+        (the `Executor._simple_bind` convention).  Atomic with respect to
+        concurrent dispatches: in-flight calls finish against the
+        (params, aux) snapshot they captured."""
+        import jax
+        aux_params = aux_params or {}
+        aux_shapes = aux_shapes or {}
+        param_names = [n for n in self._slot_names if n in arg_params]
+        extra_names = [n for n in self._slot_names if n not in arg_params]
+
+        def value(v):
+            return v._data if isinstance(v, NDArray) else _np.asarray(v)
+
+        plan = [value(arg_params[n]) for n in param_names]
+        for n in self._aux_names:
+            if n in aux_params:
+                plan.append(value(aux_params[n]))
+            elif n in aux_shapes:
+                plan.append(_np.zeros(aux_shapes[n], _np.float32))
+            else:
+                raise MXNetError(
+                    f"FusedInference: no value or shape for aux '{n}'")
+        moved = jax.device_put(plan, self._ctx.jax_device)
+        state = self._state
+        if state is not None and state[1] == extra_names:
+            jit = state[0]   # same partition: keep every compiled program
+        else:
+            jit = self._build(param_names, extra_names)
+        self._state = (jit, extra_names,
+                       moved[:len(param_names)], moved[len(param_names):])
+
+    @property
+    def extra_names(self):
+        """Argument slots fed per-call (shapes may follow the batch)."""
+        return self._state[1] if self._state is not None else []
+
+    def _build(self, param_names, extra_names):
+        import jax
+        gfn = self._gfn
+        param_pos = {n: k for k, n in enumerate(param_names)}
+        input_pos = {n: k for k, n in enumerate(self._input_names)}
+        extra_pos = {n: k for k, n in enumerate(extra_names)}
+        arg_names = self._arg_names
+
+        def run(params, inputs, extras, aux, key):
+            args = []
+            for n in arg_names:
+                if n in param_pos:
+                    args.append(params[param_pos[n]])
+                elif n in input_pos:
+                    args.append(inputs[input_pos[n]])
+                else:
+                    args.append(extras[extra_pos[n]])
+            outs, _ = gfn(tuple(args), tuple(aux), key)
+            return outs
+
+        return jax.jit(run)
+
+    def signature(self, inputs):
+        """(shape, dtype) per data input — the recompile auditor's
+        currency for this program."""
+        return tuple((tuple(v.shape), str(v.dtype)) for v in inputs)
+
+    def program_count(self):
+        """Compiled programs so far (one per signature)."""
+        return self._state[0]._cache_size() if self._state is not None \
+            else 0
+
+    def register_warm(self, inputs):
+        """Declare `inputs`' signature as an expected bucket BEFORE
+        compiling it, so warmup compiles never read as shape churn."""
+        from .analysis import recompile as _recompile
+        _recompile.register(self.audit_key, self._input_names,
+                            self.signature(inputs))
+
+    def __call__(self, inputs, extras=()):
+        """Run the program for `inputs` (raw arrays ordered like
+        `data_names`; `extras` ordered like `extra_names`); returns the
+        raw output arrays."""
+        state = self._state
+        if state is None:
+            raise MXNetError("FusedInference: set_params before calling")
+        jit, extra_names, params, aux = state
+        if len(extras) != len(extra_names):
+            # caller built extras against a partition a concurrent
+            # set_params just replaced: fail clean (retryable), never
+            # bind the wrong arrays
+            raise MXNetError(
+                "FusedInference: extras changed under a concurrent "
+                "set_params; retry the request")
+        from .analysis import recompile as _recompile
+        _recompile.note(self.audit_key, self._input_names,
+                        self.signature(inputs))
+        return jit(params, list(inputs), list(extras), aux, self._key)

@@ -22,7 +22,7 @@ from .ndarray.ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
-           "LibSVMIter"]
+           "LibSVMIter", "pad_to_bucket"]
 
 
 class DataDesc:
@@ -74,6 +74,56 @@ class DataBatch:
         label_shapes = [l.shape for l in self.label] if self.label else None
         return f"{self.__class__.__name__}: data shapes: {data_shapes} " \
                f"label shapes: {label_shapes}"
+
+    def pad_to_bucket(self, buckets):
+        """Pad this batch up to the nearest shape bucket — see the
+        module-level `pad_to_bucket`."""
+        return pad_to_bucket(self, buckets)
+
+
+def _pad_rows(arr, pad):
+    """Append `pad` replicas of the final row (NDArray or numpy)."""
+    if isinstance(arr, NDArray):
+        from .ndarray.ndarray import concatenate
+        tail = arr[arr.shape[0] - 1:arr.shape[0]]
+        return concatenate([arr] + [tail] * pad, axis=0)
+    arr = _np.asarray(arr)
+    return _np.concatenate([arr, _np.repeat(arr[-1:], pad, axis=0)])
+
+
+def pad_to_bucket(batch, buckets):
+    """Pad a `DataBatch` along the batch axis to the smallest bucket that
+    fits it, accounting the padding in ``batch.pad``.
+
+    A ragged final batch (a non-divisible dataset) is the classic TPU
+    recompile hazard `analysis/recompile.py` diagnoses: its novel batch
+    dimension forces a fresh multi-second XLA compile every epoch.
+    `Module.predict`/`iter_predict` route every batch through here with
+    the iterator's batch size as the single bucket, so the tail reuses
+    the full-batch compiled program and its pad rows are sliced off with
+    the existing ``pad`` machinery.  Pad rows replicate the final sample
+    (row-independent inference never reads them).
+
+    Returns `batch` unchanged when its size already matches a bucket or
+    exceeds them all; otherwise a NEW DataBatch (the input is not
+    mutated)."""
+    if not batch.data:
+        return batch
+    n = int(batch.data[0].shape[0])
+    target = None
+    for b in sorted(int(x) for x in buckets):
+        if n <= b:
+            target = b
+            break
+    if target is None or target == n:
+        return batch
+    pad = target - n
+    return DataBatch(
+        data=[_pad_rows(d, pad) for d in batch.data],
+        label=[_pad_rows(l, pad) for l in (batch.label or [])] or None,
+        pad=(batch.pad or 0) + pad, index=batch.index,
+        bucket_key=batch.bucket_key, provide_data=batch.provide_data,
+        provide_label=batch.provide_label)
 
 
 class DataIter:

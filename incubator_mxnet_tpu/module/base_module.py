@@ -104,13 +104,33 @@ class BaseModule:
                 cb(params)
         return eval_metric.get_name_value()
 
+    def _infer_buckets(self, eval_data):
+        """The shape buckets inference batches pad up to: the iterator's
+        batch size (plus any bound data shape, which warmup compiled)."""
+        buckets = set()
+        bs = getattr(eval_data, "batch_size", 0) or 0
+        if bs:
+            buckets.add(int(bs))
+        if self.binded and getattr(self, "_data_shapes", None):
+            shape = self._data_shapes[0][1] if not hasattr(
+                self._data_shapes[0], "shape") else self._data_shapes[0].shape
+            if shape:
+                buckets.add(int(shape[0]))
+        return sorted(buckets)
+
     def iter_predict(self, eval_data, num_batch=None, reset=True):
         assert self.binded and self.params_initialized
         if reset:
             eval_data.reset()
+        buckets = self._infer_buckets(eval_data)
         for nbatch, eval_batch in enumerate(eval_data):
             if num_batch is not None and nbatch == num_batch:
                 break
+            if buckets:
+                # a ragged tail batch would force a fresh XLA compile
+                # (analysis/recompile.py's shape-churn hazard); pad it to
+                # the compiled bucket and slice the pad rows back off
+                eval_batch = _io.pad_to_bucket(eval_batch, buckets)
             self.forward(eval_batch, is_train=False)
             pad = eval_batch.pad
             outputs = [out[0:out.shape[0] - (pad or 0)]
@@ -130,10 +150,15 @@ class BaseModule:
             return self.get_outputs()[0]
         if reset:
             eval_data.reset()
+        buckets = self._infer_buckets(eval_data)
         output_list = []
         for nbatch, eval_batch in enumerate(eval_data):
             if num_batch is not None and nbatch == num_batch:
                 break
+            if buckets:
+                # pad the ragged tail to a compiled bucket instead of
+                # recompiling for it (see iter_predict)
+                eval_batch = _io.pad_to_bucket(eval_batch, buckets)
             self.forward(eval_batch, is_train=False)
             pad = eval_batch.pad
             outputs = [out[0:out.shape[0] - (pad or 0)].copy()

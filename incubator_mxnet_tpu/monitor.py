@@ -1,9 +1,21 @@
 """Per-layer output monitoring (reference `python/mxnet/monitor.py:33`,
-backed by `MXExecutorSetMonitorCallback` → our Executor.set_monitor_callback)."""
+backed by `MXExecutorSetMonitorCallback` → our Executor.set_monitor_callback).
+
+Installs on training executors AND on serving executors
+(`serving.ServedModel` exposes the same `set_monitor_callback` face): on
+the request path the callback fires over the BATCHED outputs of each
+executed bucket, and the micro-batcher drives `tic`/`toc_print` around
+every batch the way the fit loop does.  Serving executors keep no
+persistent per-layer arg arrays, so the arg sweeps degrade gracefully to
+whatever the executor exposes, and stat functions may return plain
+numbers (a float over a batched output) as well as NDArrays.
+"""
 from __future__ import annotations
 
 import logging
 import re
+
+import numpy as _np
 
 from .ndarray.ndarray import NDArray
 
@@ -31,14 +43,20 @@ class Monitor:
         self.queue.append((self.step, name, self.stat_func(array)))
 
     def install(self, exe):
+        """Install on anything exposing `set_monitor_callback` — an
+        `Executor` or a serving executor (`serving.ServedModel`)."""
         exe.set_monitor_callback(self.stat_helper)
         self.exes.append(exe)
 
+    def _wait_args(self):
+        for exe in self.exes:
+            for array in getattr(exe, "arg_arrays", ()) or ():
+                if array is not None:
+                    array.wait_to_read()
+
     def tic(self):
         if self.step % self.interval == 0:
-            for exe in self.exes:
-                for array in exe.arg_arrays:
-                    array.wait_to_read()
+            self._wait_args()
             self.queue = []
             self.activated = True
         self.step += 1
@@ -46,13 +64,12 @@ class Monitor:
     def toc(self):
         if not self.activated:
             return []
+        self._wait_args()
         for exe in self.exes:
-            for array in exe.arg_arrays:
-                array.wait_to_read()
-        for exe in self.exes:
-            for name, array in exe.arg_dict.items():
-                if self.re_prog.match(name):
-                    self.queue.append((self.step, name, self.stat_func(array)))
+            for name, array in (getattr(exe, "arg_dict", None) or {}).items():
+                if array is not None and self.re_prog.match(name):
+                    self.queue.append((self.step, name,
+                                       self.stat_func(array)))
         self.activated = False
         res = []
         if self.sort:
@@ -60,11 +77,16 @@ class Monitor:
         for n, k, v_list in self.queue:
             if isinstance(v_list, NDArray):
                 v_list = [v_list]
-            assert isinstance(v_list, list)
+            if not isinstance(v_list, list):
+                # a stat_func over batched serving outputs may return a
+                # plain number / numpy value; render it as-is
+                res.append((n, k, str(_np.asarray(v_list)) + "\t"))
+                continue
             s = ""
             for v in v_list:
-                assert isinstance(v, NDArray)
-                if v.shape == (1,) or v.shape == ():
+                if not isinstance(v, NDArray):
+                    s += str(_np.asarray(v)) + "\t"
+                elif v.shape == (1,) or v.shape == ():
                     s += str(v.asnumpy().reshape(-1)[0]) + "\t"
                 else:
                     s += str(v.asnumpy()) + "\t"

@@ -199,11 +199,34 @@ def list_ops():
 # handles the per-signature level.
 # ---------------------------------------------------------------------------
 
+def _freeze(v):
+    """Hashable stand-in for a param value: the jit caches key on frozen
+    params, and basic-index keys carry `slice` objects, which are
+    unhashable before Python 3.12."""
+    if isinstance(v, slice):
+        return ("__slice__", v.start, v.stop, v.step)
+    if isinstance(v, tuple):
+        return tuple(_freeze(x) for x in v)
+    return v
+
+
+def _thaw(v):
+    if isinstance(v, tuple):
+        if len(v) == 4 and v[0] == "__slice__":
+            return slice(v[1], v[2], v[3])
+        return tuple(_thaw(x) for x in v)
+    return v
+
+
+def _freeze_params(params):
+    return tuple(sorted((k, _freeze(v)) for k, v in params.items()))
+
+
 @functools.lru_cache(maxsize=None)
 def _jitted(op_name, frozen_params):
     import jax
     op = _REGISTRY[op_name]
-    params = dict(frozen_params)
+    params = {k: _thaw(v) for k, v in frozen_params}
 
     def run(*arrays):
         return op.fn(params, *arrays)
@@ -222,8 +245,7 @@ def eager_call(op: OpDef, params: dict, arrays):
     if any(isinstance(a, jax.core.Tracer) for a in arrays):
         out = op.fn(dict(params), *arrays)
     else:
-        frozen = tuple(sorted(params.items()))
-        out = _jitted(op.name, frozen)(*arrays)
+        out = _jitted(op.name, _freeze_params(params))(*arrays)
     if not isinstance(out, (tuple, list)):
         out = (out,)
     return tuple(out)
@@ -233,7 +255,7 @@ def eager_call(op: OpDef, params: dict, arrays):
 def _jitted_vjp(op_name, frozen_params):
     import jax
     op = _REGISTRY[op_name]
-    params = dict(frozen_params)
+    params = {k: _thaw(v) for k, v in frozen_params}
 
     def run(arrays, cotangents):
         import jax.numpy as jnp
@@ -257,8 +279,8 @@ def vjp_call(op: OpDef, params: dict, arrays, cotangents):
     The `FGradient` equivalent (`include/mxnet/op_attr_types.h` FGradient):
     computed from the same compute function via jax.vjp, compiled and cached.
     """
-    frozen = tuple(sorted(params.items()))
-    return _jitted_vjp(op.name, frozen)(tuple(arrays), tuple(cotangents))
+    return _jitted_vjp(op.name, _freeze_params(params))(tuple(arrays),
+                                                        tuple(cotangents))
 
 
 def eval_shape(op: OpDef, params: dict, avals):

@@ -16,7 +16,7 @@ import threading
 
 __all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
            "resume", "Task", "Frame", "Counter", "Marker",
-           "record_memory"]
+           "record_memory", "record_serving"]
 
 _config = {"profile_all": False, "profile_symbolic": False,
            "profile_imperative": False, "profile_memory": False,
@@ -163,6 +163,18 @@ def record_memory(tag="memory", ctx=None):
                        int(stats.get("peak_bytes_in_use", 0))}}
     _emit(ev)
     return ev["args"]
+
+
+def record_serving(name, dur_us, **args):
+    """Record one serving batch execution (serving.metrics feeds this per
+    executed bucket) into the chrome trace next to the custom-object
+    events.  A no-op unless a profile is running, so the serving hot path
+    never accumulates events nobody will dump."""
+    if not _state["running"]:
+        return
+    _emit({"name": name, "cat": "serving", "ph": "X",
+           "ts": time.perf_counter() * 1e6 - float(dur_us),
+           "dur": float(dur_us), "pid": 0, "tid": 0, "args": args})
 
 
 class _Named:

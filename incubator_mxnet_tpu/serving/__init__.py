@@ -1,0 +1,51 @@
+"""TPU-native inference serving: dynamic batching over a shape-bucketed
+program cache.
+
+The missing request path for the north star's "heavy traffic" goal: the
+repo could train, checkpoint, and analyze, but inference was a caller's
+`Module.forward` loop.  This package serves models the way the hardware
+wants to be driven — on TPU every NOVEL request shape stalls the stream
+behind a multi-second XLA compile, so shapes are restricted to a fixed
+bucket ladder compiled at warmup, and concurrent requests are coalesced
+into bucket-sized batches (the MXNet-paper engine's concurrent-executor
+role + the TensorFlow-paper production recipe of batched compiled
+subgraphs, arxiv 1512.01274 / 1605.08695).
+
+Layers:
+
+* `ServedModel` (model.py) — a loaded model (symbol JSON + params, from
+  classic prefix checkpoints or elastic ``checkpoint/`` dirs) compiled
+  over a bucket ladder via the shared `fused.FusedInference` program
+  cache; `infer()` is the synchronous single-request path (the C-predict
+  ABI routes here).
+* `MicroBatcher` (batcher.py) — bounded queue + coalescing worker:
+  ``max_batch_size`` / ``max_queue_latency_ms`` batching knobs, padding
+  to the nearest bucket, per-request deadlines, backpressure, graceful
+  drain.
+* `ModelServer` (server.py) — multi-model front end with hot
+  load/unload that never drops in-flight requests.
+* `ServingMetrics` (metrics.py) — QPS, p50/p99 latency, batch occupancy,
+  queue depth; batches land in the profiler trace when one is running.
+
+Minimal server::
+
+    import incubator_mxnet_tpu as mx
+    srv = mx.serving.ModelServer(max_queue_latency_ms=2.0)
+    srv.load_model("mnist", prefix="model", epoch=3,
+                   data_shapes=[("data", (1, 784))], buckets=(1, 8, 32))
+    out = srv.predict("mnist", {"data": x}, timeout_ms=50)[0]
+    srv.shutdown(drain=True)
+
+The recompile auditor (`analysis.recompile`) certifies the warmup
+contract: every bucket is registered before compiling, so any signature
+it reports afterwards is a real post-warmup recompile.
+"""
+from __future__ import annotations
+
+from .model import ServedModel, DEFAULT_BUCKETS
+from .batcher import MicroBatcher
+from .server import ModelServer
+from .metrics import ServingMetrics
+
+__all__ = ["ServedModel", "MicroBatcher", "ModelServer", "ServingMetrics",
+           "DEFAULT_BUCKETS"]

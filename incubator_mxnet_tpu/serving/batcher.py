@@ -1,0 +1,264 @@
+"""Dynamic micro-batching: coalesce concurrent requests into bucket-sized
+device batches.
+
+The scheduler is a bounded request queue plus one worker thread per model.
+The worker takes the oldest request, then keeps admitting more until either
+the batch would exceed ``max_batch_size`` or ``max_queue_latency_ms`` has
+elapsed since the FIRST request of the batch arrived — the classic
+latency/throughput knob: 0 serves every request alone, a few milliseconds
+lets a concurrency-N client fill whole buckets.  The coalesced rows are
+padded to the nearest bucket (`ServedModel.pad_rows`), executed as ONE
+compiled program, and scattered back to per-request futures by row range,
+so each caller sees exactly its own rows in submission order.
+
+Unhappy paths are first-class:
+
+* per-request deadlines — a request still queued past its deadline gets a
+  clean `MXNetError` naming the model and the timeout, and never reaches
+  the device;
+* backpressure — a full queue rejects `submit` immediately instead of
+  growing an unbounded backlog;
+* graceful drain — `close(drain=True)` stops admissions, completes every
+  queued request, then joins the worker (model unload/swap without
+  dropping in-flight work).
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+
+from concurrent.futures import Future
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["MicroBatcher"]
+
+
+class _Request:
+    __slots__ = ("arrs", "rows", "deadline", "timeout_ms", "future",
+                 "t_enqueue")
+
+    def __init__(self, arrs, rows, timeout_ms):
+        self.arrs = arrs
+        self.rows = rows
+        self.timeout_ms = timeout_ms
+        self.t_enqueue = time.monotonic()
+        self.deadline = (self.t_enqueue + timeout_ms / 1e3
+                         if timeout_ms is not None else None)
+        self.future = Future()
+
+
+class MicroBatcher:
+    """The per-model request queue + coalescing worker."""
+
+    def __init__(self, model, metrics, max_batch_size=None,
+                 max_queue_latency_ms=2.0, max_queue=256):
+        self._model = model
+        self._metrics = metrics
+        self.max_batch_size = min(int(max_batch_size or model.max_batch_size),
+                                  model.max_batch_size)
+        self.max_queue_latency_ms = float(max_queue_latency_ms)
+        self.max_queue = int(max_queue)
+        self._q = _queue.Queue(maxsize=self.max_queue)
+        self._carry = None         # request admitted but deferred to the
+                                   # next batch (would overflow this one)
+        self._outstanding = 0
+        self._lock = threading.Lock()
+        self._idle = threading.Condition(self._lock)
+        self._stop = threading.Event()
+        self._draining = threading.Event()
+        self._paused = threading.Event()
+        self._monitor = None       # a monitor.Monitor driven per batch
+        self._thread = threading.Thread(
+            target=self._worker, daemon=True,
+            name=f"mx-serving-{model.name}")
+        self._thread.start()
+
+    # -- client side ---------------------------------------------------------
+    def submit(self, inputs, timeout_ms=None):
+        """Enqueue one request; returns a Future resolving to the list of
+        per-output NDArrays for exactly this request's rows."""
+        if self._draining.is_set() or self._stop.is_set():
+            raise MXNetError(f"serving: model '{self._model.name}' is "
+                             "draining; not accepting requests")
+        rows, arrs = self._model.prepare_rows(inputs)
+        if rows > self.max_batch_size:
+            raise MXNetError(
+                f"serving: model '{self._model.name}' request batch {rows} "
+                f"exceeds max_batch_size {self.max_batch_size}")
+        req = _Request(arrs, rows, timeout_ms)
+        with self._lock:
+            self._outstanding += 1
+        try:
+            self._q.put_nowait(req)
+        except _queue.Full:
+            with self._lock:
+                self._outstanding -= 1
+            self._metrics.record_reject()
+            raise MXNetError(
+                f"serving: model '{self._model.name}' queue is full "
+                f"({self.max_queue} pending) — backpressure, retry later")
+        if self._stop.is_set():
+            # raced with close(): the worker may already be gone and the
+            # final failure sweep past — sweep again so no future is left
+            # unresolved (each request is dequeued exactly once)
+            self._sweep_failed()
+        self._metrics.record_request(self._q.qsize())
+        return req.future
+
+    def pause(self):
+        """Stop dispatching (queued requests wait); used while swapping
+        weights or in tests that need a deterministically full queue."""
+        self._paused.set()
+
+    def resume(self):
+        self._paused.clear()
+
+    def install_monitor(self, mon):
+        """Drive a `monitor.Monitor` tic/toc around every executed batch
+        (the fit loop's idiom, on the request path)."""
+        self._model.install_monitor(mon)
+        self._monitor = mon
+
+    def close(self, drain=True, timeout=None):
+        """Stop the batcher.  With ``drain`` every queued request is
+        completed first; without, queued requests fail fast with a
+        shutdown error."""
+        self._draining.set()
+        self._paused.clear()   # a paused worker could never drain
+        if drain:
+            with self._idle:
+                self._idle.wait_for(lambda: self._outstanding == 0,
+                                    timeout=timeout)
+        self._stop.set()
+        self._thread.join(timeout=10)
+        self._sweep_failed()   # non-drain shutdown: fail what is queued
+
+    def _sweep_failed(self):
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except _queue.Empty:
+                return
+            self._fail(req, MXNetError(
+                f"serving: model '{self._model.name}' shut down before "
+                "this request ran"))
+
+    # -- worker side ---------------------------------------------------------
+    def _done(self, req):
+        with self._idle:
+            self._outstanding -= 1
+            if self._outstanding == 0:
+                self._idle.notify_all()
+
+    def _fail(self, req, exc):
+        try:
+            req.future.set_exception(exc)
+        except Exception:   # caller cancelled it meanwhile; nothing to tell
+            pass
+        self._done(req)
+
+    def _take(self, timeout):
+        if self._carry is not None:
+            req, self._carry = self._carry, None
+            return req
+        return self._q.get(timeout=timeout)
+
+    def _worker(self):
+        while True:
+            try:
+                first = self._take(timeout=0.05)
+            except _queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            while self._paused.is_set() and not self._stop.is_set():
+                time.sleep(0.001)
+            batch = [first]
+            rows = first.rows
+            # coalesce until the bucket ladder is full or the oldest
+            # request has waited max_queue_latency_ms
+            t_close = first.t_enqueue + self.max_queue_latency_ms / 1e3
+            while rows < self.max_batch_size:
+                if self._carry is None and self._q.empty():
+                    with self._lock:
+                        quiescent = self._outstanding == len(batch)
+                    if quiescent:
+                        # every live request is already in hand: nothing
+                        # more can arrive until we respond (closed-loop
+                        # clients), so waiting out the latency window
+                        # would buy batch rows from nobody — dispatch now
+                        break
+                remaining = t_close - time.monotonic()
+                try:
+                    # a non-positive remainder still sweeps the queue once
+                    # without blocking, so a burst that is ALREADY queued
+                    # fills the bucket even at latency 0
+                    nxt = self._take(timeout=max(remaining, 0))
+                except _queue.Empty:
+                    break
+                if rows + nxt.rows > self.max_batch_size:
+                    self._carry = nxt   # heads the next batch
+                    break
+                batch.append(nxt)
+                rows += nxt.rows
+            self._metrics.set_queue_depth(self._q.qsize())
+            self._execute(batch)
+
+    def _execute(self, batch):
+        model = self._model
+        now = time.monotonic()
+        live = []
+        rows = 0
+        for req in batch:
+            # marking the future running makes later set_result safe: a
+            # cancelled future would otherwise raise InvalidStateError
+            # and kill this worker thread for every model client
+            if not req.future.set_running_or_notify_cancel():
+                self._done(req)
+            elif req.deadline is not None and now > req.deadline:
+                self._metrics.record_timeout()
+                self._fail(req, MXNetError(
+                    f"serving: request to model '{model.name}' exceeded "
+                    f"its {req.timeout_ms:g} ms deadline in the queue"))
+            else:
+                live.append(req)
+                rows += req.rows
+        if not live:
+            return
+        bucket = model.bucket_for(rows)
+        arrs = [_np.concatenate(parts) if len(parts) > 1 else parts[0]
+                for parts in zip(*(r.arrs for r in live))]
+        mon = self._monitor
+        t0 = time.monotonic()
+        try:
+            if mon is not None:
+                mon.tic()
+            outs = model.run_bucket(model.pad_rows(arrs, rows, bucket),
+                                    bucket)
+            import jax
+            jax.block_until_ready(outs)
+            if mon is not None:
+                mon.toc_print()
+        except Exception as exc:  # surface the failure on every future
+            err = exc if isinstance(exc, MXNetError) else MXNetError(
+                f"serving: model '{model.name}' batch execution failed: "
+                f"{exc}")
+            for req in live:
+                self._fail(req, err)
+            return
+        done = time.monotonic()
+        self._metrics.record_batch(rows, bucket, done - t0)
+        ctx = model._ctx
+        from ..ndarray.ndarray import NDArray
+        off = 0
+        for req in live:
+            lo, hi = off, off + req.rows
+            off = hi
+            req.future.set_result(
+                [NDArray(o[lo:hi], ctx=ctx) for o in outs])
+            self._metrics.record_response(done - req.t_enqueue)
+            self._done(req)

@@ -1,0 +1,97 @@
+"""Serving metrics: QPS, latency percentiles, batch occupancy, queue depth.
+
+One `ServingMetrics` per served model, updated by the micro-batching
+scheduler on the hot path (a lock + a few counter increments per batch).
+Snapshots are pull-based (`snapshot()` / `ModelServer.stats()`); each
+executed batch is also emitted into the profiler's chrome trace when a
+profile is running (`profiler.record_serving`), so serving load shows up
+in the same trace viewer as the XLA timeline.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+import numpy as _np
+
+__all__ = ["ServingMetrics"]
+
+
+class ServingMetrics:
+    """Counters and a sliding latency window for one served model."""
+
+    def __init__(self, model_name, window=4096):
+        self.model_name = model_name
+        self._lock = threading.Lock()
+        self._lat_ms = collections.deque(maxlen=window)
+        self._t0 = time.monotonic()
+        self.requests = 0        # accepted into the queue
+        self.responses = 0       # completed with a result
+        self.timeouts = 0        # deadline-exceeded
+        self.rejected = 0        # backpressure rejections
+        self.batches = 0         # executed device batches
+        self.rows = 0            # live request rows executed
+        self.capacity = 0        # bucket rows executed (rows + padding)
+        self.queue_depth = 0     # gauge, set by the batcher
+
+    # -- hot-path updates ----------------------------------------------------
+    def record_request(self, queue_depth):
+        with self._lock:
+            self.requests += 1
+            self.queue_depth = queue_depth
+
+    def record_batch(self, rows, bucket, dur_s):
+        with self._lock:
+            self.batches += 1
+            self.rows += rows
+            self.capacity += bucket
+        from .. import profiler as _profiler
+        _profiler.record_serving(f"serving:{self.model_name}",
+                                 dur_s * 1e6, rows=rows, bucket=bucket)
+
+    def record_response(self, latency_s):
+        with self._lock:
+            self.responses += 1
+            self._lat_ms.append(latency_s * 1e3)
+
+    def record_timeout(self):
+        with self._lock:
+            self.timeouts += 1
+
+    def record_reject(self):
+        with self._lock:
+            self.rejected += 1
+
+    def set_queue_depth(self, depth):
+        with self._lock:
+            self.queue_depth = depth
+
+    # -- reads ---------------------------------------------------------------
+    def snapshot(self):
+        """One coherent metrics dict: counts, QPS since start, p50/p99
+        latency (ms, over the sliding window), mean batch occupancy."""
+        with self._lock:
+            lat = _np.asarray(self._lat_ms, dtype=_np.float64)
+            elapsed = max(time.monotonic() - self._t0, 1e-9)
+            snap = {
+                "model": self.model_name,
+                "requests": self.requests,
+                "responses": self.responses,
+                "timeouts": self.timeouts,
+                "rejected": self.rejected,
+                "batches": self.batches,
+                "rows": self.rows,
+                "queue_depth": self.queue_depth,
+                "qps": self.responses / elapsed,
+                "batch_occupancy": (self.rows / self.capacity
+                                    if self.capacity else 0.0),
+                "avg_batch_rows": (self.rows / self.batches
+                                   if self.batches else 0.0),
+            }
+        if lat.size:
+            snap["p50_ms"] = float(_np.percentile(lat, 50))
+            snap["p99_ms"] = float(_np.percentile(lat, 99))
+        else:
+            snap["p50_ms"] = snap["p99_ms"] = None
+        return snap

@@ -1,0 +1,314 @@
+"""ServedModel: a loaded model with a fixed set of compiled shape buckets.
+
+The inference analogue of `fused.FusedTrainStep`: the whole Symbol is one
+XLA program per input signature (`fused.FusedInference`), parameters are
+device-resident constants, and the signatures are restricted to a FIXED
+bucket ladder so a production server pays every compile at `warmup()` and
+none afterwards — on TPU a novel request shape otherwise stalls the whole
+request stream behind a multi-second XLA compile.
+
+Requests that don't fill a bucket are padded up to the nearest one by
+replicating the final row (row-independent inference makes the pad rows
+garbage that the caller never sees: every read path slices them off).
+Both request paths share the one program cache:
+
+* `infer()` — the synchronous single-request path (the C-predict ABI and
+  `tools` drivers route here), and
+* the micro-batching scheduler (`serving.batcher`) — coalesces concurrent
+  requests into bucket-sized device batches.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import current_context
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["ServedModel", "DEFAULT_BUCKETS"]
+
+DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32)
+
+
+def _as_desc_list(data_shapes):
+    """Normalize [(name, shape)] / [DataDesc] -> [(name, tuple(shape))]."""
+    out = []
+    for d in data_shapes:
+        name, shape = (d.name, d.shape) if hasattr(d, "name") else \
+            (d[0], d[1])
+        out.append((str(name), tuple(int(s) for s in shape)))
+    return out
+
+
+class ServedModel:
+    """One model compiled over a bucket ladder, ready to serve.
+
+    Parameters
+    ----------
+    symbol : Symbol
+        The inference graph.
+    arg_params / aux_params : dict
+        Parameter values (NDArray or numpy).  Arguments the dicts omit
+        (e.g. a loss head's label input) are bound to zeros, matching the
+        `simple_bind` convention the C-predict ABI relies on.
+    data_shapes : list of (name, shape) or DataDesc
+        The request inputs.  ``shape[0]`` is the batch axis and is
+        replaced by each bucket size; the remaining dims are fixed.
+    buckets : tuple of int
+        Batch-size ladder, compiled at `warmup()`.  ``max(buckets)`` is
+        the server's `max_batch_size` for this model.
+    """
+
+    def __init__(self, symbol, arg_params, aux_params=None, data_shapes=None,
+                 buckets=DEFAULT_BUCKETS, ctx=None, name="model",
+                 dtype=_np.float32):
+        if not data_shapes:
+            raise MXNetError(f"ServedModel('{name}'): data_shapes required")
+        self.name = str(name)
+        self._ctx = ctx if ctx is not None else current_context()
+        self._symbol = symbol
+        self.buckets = tuple(sorted({int(b) for b in buckets}))
+        if not self.buckets or self.buckets[0] < 1:
+            raise MXNetError(f"ServedModel('{name}'): buckets must be "
+                             "positive ints")
+        descs = _as_desc_list(data_shapes)
+        self.data_names = [n for n, _ in descs]
+        self._declared_shapes = dict(descs)      # full, as given (C ABI)
+        self._sample_shapes = {n: s[1:] for n, s in descs}
+        self._dtype = _np.dtype(dtype)
+        self.output_names = symbol.list_outputs()
+
+        from .. import fused as _fused
+        self._infer = _fused.FusedInference(symbol, self._ctx,
+                                            self.data_names,
+                                            audit_key=f"serving/{self.name}")
+        self._extra_cache = {}   # input-shape key -> zero extras list
+        self.set_params(arg_params, aux_params)
+        self._monitor = None
+        self.warmed = False
+
+    # -- loading -------------------------------------------------------------
+    @classmethod
+    def load(cls, prefix, epoch=0, **kwargs):
+        """From the classic checkpoint pair ``prefix-symbol.json`` +
+        ``prefix-%04d.params`` (`model.load_checkpoint`)."""
+        from ..model import load_checkpoint
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        return cls(sym, args, auxs, **kwargs)
+
+    @classmethod
+    def from_checkpoint_dir(cls, symbol_file, checkpoint_path, **kwargs):
+        """From a symbol JSON file plus an elastic `checkpoint/` directory
+        (or a root of them — the newest VALID one is used; torn
+        checkpoints are never selected)."""
+        import os
+        from .. import symbol as _sym
+        from ..checkpoint import load as _load, latest as _latest
+        from ..checkpoint.state import split_params
+        sym = _sym.load(symbol_file)
+        path = checkpoint_path
+        if not os.path.exists(os.path.join(path, "manifest.json")):
+            found = _latest(path)
+            if found is None:
+                raise MXNetError(
+                    f"ServedModel: no valid checkpoint under {path!r}")
+            path = found
+        data = _load(path)
+        args, auxs = split_params(data.arrays)
+        return cls(sym, args, auxs, **kwargs)
+
+    # -- buckets -------------------------------------------------------------
+    @property
+    def max_batch_size(self):
+        return self.buckets[-1]
+
+    def bucket_for(self, n):
+        """Smallest bucket >= n, or None when n exceeds the ladder."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return None
+
+    def _input_shapes(self, bucket):
+        return {n: (bucket,) + self._sample_shapes[n]
+                for n in self.data_names}
+
+    def _extras(self, input_shapes):
+        """Zeros for argument slots the param dict left unfilled (a loss
+        head's labels), shaped by inference at these input shapes — their
+        shapes may follow the batch axis, so each bucket gets its own."""
+        key = tuple(sorted(input_shapes.items()))
+        got = self._extra_cache.get(key)
+        if got is None:
+            names = self._infer.extra_names
+            if not names:
+                got = ()
+            else:
+                arg_shapes, _, _ = self._symbol.infer_shape(**input_shapes)
+                by_name = dict(zip(self._symbol.list_arguments(),
+                                   arg_shapes))
+                got = tuple(_np.zeros(by_name[n], _np.float32)
+                            for n in names)
+            self._extra_cache[key] = got
+        return got
+
+    # -- execution -----------------------------------------------------------
+    def warmup(self):
+        """Compile every bucket up front.  Each bucket's signature is
+        REGISTERED with the recompile auditor before compiling, so the
+        warmup compiles never read as shape churn — after this, any new
+        signature the auditor sees is a real post-warmup recompile."""
+        for b in self.buckets:
+            inputs = [_np.zeros((b,) + self._sample_shapes[n], self._dtype)
+                      for n in self.data_names]
+            self._infer.register_warm(inputs)
+            self.run_bucket(inputs, b)
+        self.warmed = True
+
+    def run_bucket(self, arrs, bucket):
+        """Dispatch one bucket-shaped batch (already padded) through the
+        shared program cache."""
+        return self._run(arrs, self._extras(self._input_shapes(bucket)))
+
+    def _run(self, inputs, extras):
+        """Low-level dispatch; fires the monitor callback over the
+        batched outputs."""
+        outs = self._infer(inputs, extras)
+        mon = self._monitor
+        if mon is not None:
+            for out_name, arr in zip(self.output_names, outs):
+                mon(out_name, NDArray(arr, ctx=self._ctx))
+        return outs
+
+    def prepare_rows(self, inputs):
+        """Normalize a request's inputs to ``(rows, [np arrays])`` in
+        `data_names` order.  Accepts a dict or a positional list; a bare
+        sample (ndim == sample ndim) is promoted to a batch of one.  All
+        inputs must agree on the batch axis."""
+        if isinstance(inputs, dict):
+            missing = [n for n in self.data_names if n not in inputs]
+            if missing:
+                raise MXNetError(f"serving: model '{self.name}' request "
+                                 f"missing inputs {missing}")
+            vals = [inputs[n] for n in self.data_names]
+        else:
+            vals = list(inputs)
+            if len(vals) != len(self.data_names):
+                raise MXNetError(
+                    f"serving: model '{self.name}' expects "
+                    f"{len(self.data_names)} inputs, got {len(vals)}")
+        rows = None
+        arrs = []
+        for name, v in zip(self.data_names, vals):
+            # requests are host-normalized for coalescing/concat; an
+            # NDArray input is read once here by design
+            a = (v.asnumpy()  # mxlint: disable=host-sync-in-loop
+                 if isinstance(v, NDArray) else _np.asarray(v))
+            sample = self._sample_shapes[name]
+            if a.ndim == len(sample):
+                a = a[None]
+            if tuple(a.shape[1:]) != sample:
+                raise MXNetError(
+                    f"serving: model '{self.name}' input '{name}' has "
+                    f"sample shape {tuple(a.shape[1:])}, expected {sample}")
+            if a.dtype != self._dtype:
+                a = a.astype(self._dtype)
+            if rows is None:
+                rows = a.shape[0]
+            elif a.shape[0] != rows:
+                raise MXNetError(
+                    f"serving: model '{self.name}' inputs disagree on the "
+                    f"batch axis ({a.shape[0]} vs {rows})")
+            arrs.append(a)
+        if not rows:
+            # a zero-row batch cannot pad up to a bucket — it would
+            # compile a novel (0, ...) program and return nothing
+            raise MXNetError(
+                f"serving: model '{self.name}' request has no rows")
+        return rows, arrs
+
+    def pad_rows(self, arrs, rows, bucket):
+        """Pad each array from `rows` up to `bucket` by replicating the
+        final row (masking: the pad rows are never returned).  Same
+        padding `io.pad_to_bucket` gives `Module.predict` batches."""
+        if rows == bucket:
+            return arrs
+        from ..io import _pad_rows
+        return [_pad_rows(a, bucket - rows) for a in arrs]
+
+    def infer(self, inputs, block=True):
+        """The single-request path: pad to the nearest bucket, run the
+        shared compiled program, return per-output NDArrays with the pad
+        rows sliced off.  Safe from any thread."""
+        rows, arrs = self.prepare_rows(inputs)
+        bucket = self.bucket_for(rows)
+        if bucket is None:
+            raise MXNetError(
+                f"serving: model '{self.name}' request batch {rows} exceeds "
+                f"max bucket {self.max_batch_size}")
+        outs = self.run_bucket(self.pad_rows(arrs, rows, bucket), bucket)
+        if block:
+            import jax
+            jax.block_until_ready(outs)
+        return [NDArray(o[:rows], ctx=self._ctx) for o in outs]
+
+    def infer_exact(self, inputs):
+        """Run at EXACTLY the declared `data_shapes` — no batch-axis
+        semantics, no padding, outputs unsliced.  The C-predict ABI path:
+        its inputs may not share a batch axis at all (e.g. a (8, 784)
+        data input next to a (1, 256) state input), which the old
+        `simple_bind` contract allowed; still one program in the shared
+        cache."""
+        arrs = []
+        for n in self.data_names:
+            v = inputs[n] if isinstance(inputs, dict) else \
+                inputs[self.data_names.index(n)]
+            a = _np.asarray(v, self._dtype).reshape(
+                self._declared_shapes[n])
+            arrs.append(a)
+        outs = self._run(arrs, self._extras(dict(self._declared_shapes)))
+        return [NDArray(o, ctx=self._ctx) for o in outs]
+
+    # -- params / monitoring -------------------------------------------------
+    def set_params(self, arg_params, aux_params=None):
+        """(Hot-)swap the parameter set; in-flight dispatches finish
+        against the snapshot they captured, and the program cache is
+        untouched (same shapes, new constants)."""
+        # aux shapes are batch-independent; infer at the DECLARED shapes,
+        # which are always self-consistent — bucketizing every input's
+        # leading dim here would reject exact-mode (C ABI) models whose
+        # inputs legitimately do not share a batch axis
+        _, _, aux_shapes = self._symbol.infer_shape(
+            **dict(self._declared_shapes))
+        self._infer.set_params(
+            arg_params or {}, aux_params or {},
+            aux_shapes=dict(zip(self._symbol.list_auxiliary_states(),
+                                aux_shapes)))
+        self._extra_cache.clear()   # the extra partition may have moved
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        """`Monitor.install` entry point (the serving executor face of
+        `Executor.set_monitor_callback`): `callback(name, NDArray)` fires
+        per output over the BATCHED outputs of every executed bucket."""
+        del monitor_all
+        self._monitor = callback
+
+    def install_monitor(self, mon):
+        """Install a `monitor.Monitor` on the request path."""
+        mon.install(self)
+        return mon
+
+    # the Monitor drives tic/toc over installed "executors"; serving has
+    # no persistent arg arrays to wait on, so expose empty views
+    arg_arrays = ()
+
+    @property
+    def arg_dict(self):
+        return {}
+
+    @property
+    def audit_key(self):
+        return self._infer.audit_key
+
+    def program_count(self):
+        return self._infer.program_count()

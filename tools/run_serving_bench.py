@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Serving benchmark: QPS / p50 / p99 / batch occupancy vs. offered load.
+
+Builds a small MLP, exports it through the classic checkpoint pair, loads
+it into a `serving.ModelServer`, and drives it at increasing offered load
+(client-thread counts), measuring each level with fresh `ServingMetrics`.
+A sequential single-request baseline (the `ServedModel.infer` loop a
+caller without the server would write) anchors the dynamic-batching
+speedup claim.  Emits one JSON artifact so serving performance is
+checkable evidence in the repo, mirroring `run_tpu_parity.py`.
+
+Usage:
+  python tools/run_serving_bench.py [--out SERVING_BENCH.json] [--json]
+      [--requests N] [--loads 1,2,4,8] [--quick]
+
+``--json`` prints the artifact to stdout (the parity round's serving
+stage consumes this); ``--out`` writes it to a file.  ``--quick`` shrinks
+the run for CI embedding.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+
+def build_checkpoint(prefix, in_dim, hidden):
+    """Train-free model export: symbol JSON + params at `prefix`."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import sym, io
+    net = sym.Variable("data")
+    for i, h in enumerate(hidden):
+        net = sym.FullyConnected(net, num_hidden=h, name=f"fc{i}")
+        net = sym.Activation(net, act_type="tanh")
+    net = sym.FullyConnected(net, num_hidden=10, name="head")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[io.DataDesc("data", (4, in_dim))],
+             label_shapes=[io.DataDesc("softmax_label", (4,))],
+             for_training=False, grad_req="null")
+    mod.init_params(mx.initializer.Xavier())
+    mod.save_checkpoint(prefix, 0)
+
+
+def drive(server, name, n_threads, n_requests, in_dim, timeout_ms=None):
+    """Offered load: `n_threads` clients, `n_requests` each.  Returns
+    wall seconds; per-request stats land in the server's metrics."""
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((n_threads, 8, in_dim)).astype(np.float32)
+    errors = []
+
+    def client(t):
+        for i in range(n_requests):
+            x = xs[t, i % 8][None]
+            try:
+                server.predict(name, {"data": x}, timeout_ms=timeout_ms)
+            except Exception as exc:  # count, don't die mid-bench
+                errors.append(str(exc))
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(n_threads)]
+    t0 = time.monotonic()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    return time.monotonic() - t0, errors
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=None,
+                    help="write the JSON artifact here")
+    ap.add_argument("--json", action="store_true",
+                    help="print the JSON artifact to stdout")
+    ap.add_argument("--requests", type=int, default=64,
+                    help="requests per client thread")
+    ap.add_argument("--loads", default="1,2,4,8",
+                    help="comma-separated client-thread counts")
+    ap.add_argument("--latency-ms", type=float, default=2.0,
+                    help="max_queue_latency_ms batching knob")
+    ap.add_argument("--quick", action="store_true",
+                    help="small run for CI embedding")
+    args = ap.parse_args(argv)
+    if args.quick:
+        args.requests = min(args.requests, 16)
+        args.loads = "1,4"
+
+    import incubator_mxnet_tpu as mx
+    in_dim, hidden = 64, (128, 128)
+    loads = [int(x) for x in args.loads.split(",") if x]
+    artifact = {"model": f"mlp{in_dim}-" + "x".join(map(str, hidden)),
+                "requests_per_client": args.requests,
+                "max_queue_latency_ms": args.latency_ms,
+                "backend": None, "levels": [], "sequential": None}
+
+    with tempfile.TemporaryDirectory() as tmp:
+        prefix = os.path.join(tmp, "bench")
+        build_checkpoint(prefix, in_dim, hidden)
+        import jax
+        artifact["backend"] = jax.default_backend()
+
+        buckets = (1, 2, 4, 8, 16, 32)
+        model = mx.serving.ServedModel.load(
+            prefix, 0, data_shapes=[("data", (1, in_dim))],
+            buckets=buckets, name="bench")
+        t0 = time.monotonic()
+        model.warmup()
+        artifact["warmup_s"] = round(time.monotonic() - t0, 3)
+        artifact["buckets"] = list(buckets)
+
+        # sequential single-request baseline (shared program cache: no
+        # extra compiles)
+        n_seq = args.requests * max(loads)
+        x = np.random.default_rng(1).standard_normal(
+            (1, in_dim)).astype(np.float32)
+        t0 = time.monotonic()
+        for _ in range(n_seq):
+            model.infer({"data": x})
+        seq_s = time.monotonic() - t0
+        artifact["sequential"] = {"requests": n_seq,
+                                  "qps": round(n_seq / seq_s, 1)}
+
+        for level in loads:
+            server = mx.serving.ModelServer(
+                max_queue_latency_ms=args.latency_ms)
+            server.load_model("bench", model=model, warmup=False)
+            wall, errors = drive(server, "bench", level, args.requests,
+                                 in_dim)
+            snap = server.stats()["bench"]
+            server.shutdown(drain=True)
+            total = level * args.requests
+            artifact["levels"].append({
+                "offered_load": level,
+                "requests": total,
+                "wall_s": round(wall, 3),
+                "qps": round(total / wall, 1),
+                "p50_ms": (round(snap["p50_ms"], 3)
+                           if snap["p50_ms"] is not None else None),
+                "p99_ms": (round(snap["p99_ms"], 3)
+                           if snap["p99_ms"] is not None else None),
+                "batch_occupancy": round(snap["batch_occupancy"], 3),
+                "avg_batch_rows": round(snap["avg_batch_rows"], 2),
+                "errors": len(errors),
+            })
+
+        from incubator_mxnet_tpu.analysis import recompile
+        sigs = recompile.signatures(model.audit_key)
+        artifact["programs_compiled"] = len(sigs)
+        artifact["post_warmup_recompiles"] = max(len(sigs) - len(buckets), 0)
+
+    out = json.dumps(artifact, indent=1)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+        print("artifact:", args.out)
+    if args.json or not args.out:
+        print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -92,6 +92,26 @@ def mxlint_stage():
         return {"error": f"mxlint stage failed: {exc!r}"}
 
 
+def serving_stage():
+    """Serving-bench stage: run tools/run_serving_bench.py --quick in a
+    throwaway process and attach its JSON artifact (QPS, p50/p99, batch
+    occupancy per offered load, post-warmup recompile count) to the
+    round — serving-performance regressions become checkable evidence
+    next to the parity outcomes, mirroring the mxlint stage."""
+    cmd = [sys.executable, os.path.join(REPO, "tools",
+                                        "run_serving_bench.py"),
+           "--quick", "--json"]
+    try:
+        out = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                             timeout=900)
+        if out.returncode != 0:
+            return {"error": "serving bench rc=%d" % out.returncode,
+                    "tail": out.stderr.strip()[-500:]}
+        return json.loads(out.stdout)
+    except Exception as exc:
+        return {"error": f"serving stage failed: {exc!r}"}
+
+
 def main():
     rnd = "%02d" % (int(sys.argv[1]) if len(sys.argv) > 1 else next_round())
     t0 = time.time()
@@ -109,6 +129,7 @@ def main():
         "git_rev": git_revision(),
         "jax": probe_backend(),
         "mxlint": mxlint_stage(),
+        "serving": serving_stage(),
         "cmd": " ".join(cmd[2:]),
         "tests": tests[:500],
         "tail": "\n".join(output.strip().splitlines()[-12:])[-2000:],
