@@ -46,8 +46,8 @@ for _mod_name, _aliases in [
     ("parallel", ()), ("test_utils", ()), ("image", ()), ("operator", ()),
     ("contrib", ()), ("rnn", ()), ("compat", ()), ("dist", ()),
     ("subgraph", ()), ("storage", ()), ("libinfo", ()),
-    ("checkpoint", ()), ("serving", ()), ("kvstore_server", ()),
-    ("native", ()),
+    ("checkpoint", ()), ("serving", ()), ("resilience", ()),
+    ("kvstore_server", ()), ("native", ()),
 ]:
     try:
         _m = _importlib.import_module("." + _mod_name, __name__)

@@ -13,6 +13,17 @@ walking a script's AST:
   contexts: 'local' stages gradient reduction through host memory; on
   TPU the reduce should ride ICI collectives (``kvstore='device'`` or
   ``'tpu'``).
+* ``unbounded-retry`` — a ``while True`` loop whose try/except swallows
+  a connect/request/recv failure, with no deadline reference and no
+  `raise`: the classic "retry until the scheduler is up" loop that
+  spins forever against a PERMANENTLY dead peer.  Bound it with a
+  monotonic deadline or `resilience.RetryPolicy`.  (A bare call with no
+  try is fine — the exception escaping the loop is a bound.)
+* ``bare-except`` — a bare ``except:`` with no re-raise (or an
+  ``except Exception:`` whose body only passes/continues): it swallows
+  `MXNetError` — including structured failover signals like
+  `ServerLostError` — and the training script keeps "running" on a dead
+  cluster.
 
 Suppression: append ``# mxlint: disable`` (everything on the line) or
 ``# mxlint: disable=<code>[,<code>...]`` to the offending line.
@@ -30,7 +41,14 @@ _SYNC_METHODS = {"asnumpy", "asscalar", "item", "wait_to_read"}
 _SYNC_FREE = {"waitall"}
 _KV_KEYWORDS = {"kvstore", "kv_store"}
 _KV_SINKS = {"fit", "init_optimizer", "Trainer", "create"}
+_RETRY_CALLS = {"connect", "create_connection", "request", "recv_msg",
+                "send_msg", "urlopen"}
 _DISABLE_RE = re.compile(r"#\s*mxlint:\s*disable(?:=([\w\-, ]+))?")
+
+_PASS_BY_CODE = {"host-sync-in-loop": "source.hostsync",
+                 "kvstore-local-on-tpu": "source.kvstore",
+                 "unbounded-retry": "source.retry",
+                 "bare-except": "source.except"}
 
 
 def _suppressed(lines, lineno, code):
@@ -59,7 +77,78 @@ class _Visitor(ast.NodeVisitor):
         self.generic_visit(node)
         self.loop_depth -= 1
 
-    visit_For = visit_While = visit_AsyncFor = _loop
+    visit_For = visit_AsyncFor = _loop
+
+    def visit_While(self, node):
+        test = node.test
+        if isinstance(test, ast.Constant) and test.value in (True, 1):
+            self._check_unbounded_retry(node)
+        self._loop(node)
+
+    def _check_unbounded_retry(self, node):
+        """``while True`` around a TRIED connect/request (a try/except
+        that swallows the failure and loops again) with neither a
+        deadline reference nor a `raise`: nothing ever bounds the loop.
+        A bare call without a try is not a retry loop — a dead peer's
+        exception escapes it, which IS a bound."""
+        retry_line = None
+        bounded = False
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Try):
+                for handler in sub.handlers:
+                    # break/return IN THE HANDLER exits the loop on
+                    # failure — that is a bound (a read loop's
+                    # `except: break`); break in the TRY body is the
+                    # success path and bounds nothing
+                    for inner in ast.walk(handler):
+                        if isinstance(inner, (ast.Break, ast.Return)):
+                            bounded = True
+                for inner in ast.walk(sub):
+                    if isinstance(inner, ast.Call):
+                        func = inner.func
+                        name = func.attr \
+                            if isinstance(func, ast.Attribute) else \
+                            func.id if isinstance(func, ast.Name) else None
+                        if name in _RETRY_CALLS and retry_line is None:
+                            retry_line = inner.lineno
+            elif isinstance(sub, ast.Raise):
+                bounded = True
+            else:
+                ident = sub.id if isinstance(sub, ast.Name) else \
+                    sub.attr if isinstance(sub, ast.Attribute) else ""
+                if "deadline" in ident.lower():
+                    bounded = True
+        if retry_line is not None and not bounded:
+            self._add("unbounded-retry", node.lineno,
+                      "'while True' retry loop around a connect/request "
+                      f"call (line {retry_line}) with no deadline and no "
+                      "raise: a permanently dead peer spins this loop "
+                      "forever — bound it with a monotonic deadline or "
+                      "resilience.RetryPolicy")
+
+    # -- exception handling --------------------------------------------------
+    def visit_Try(self, node):
+        for handler in node.handlers:
+            bare = handler.type is None
+            broad = isinstance(handler.type, ast.Name) and \
+                handler.type.id in ("Exception", "BaseException")
+            if not bare and not broad:
+                continue
+            has_raise = any(isinstance(s, ast.Raise)
+                            for s in ast.walk(handler))
+            swallow_only = all(isinstance(s, (ast.Pass, ast.Continue))
+                               for s in handler.body)
+            if (bare and not has_raise) or (broad and swallow_only):
+                what = "bare 'except:'" if bare else \
+                    f"'except {handler.type.id}:' that only swallows"
+            else:
+                continue
+            self._add("bare-except", handler.lineno,
+                      f"{what} hides MXNetError — including structured "
+                      "failover signals (ServerLostError) — so the script "
+                      "keeps 'running' on a dead cluster; catch specific "
+                      "exceptions or re-raise")
+        self.generic_visit(node)
 
     # functions defined INSIDE a loop body don't run per-iteration at the
     # definition site; reset the loop context for their bodies
@@ -75,8 +164,7 @@ class _Visitor(ast.NodeVisitor):
         if _suppressed(self.lines, lineno, code):
             return
         self.findings.append(Finding(
-            "source.hostsync" if code == "host-sync-in-loop"
-            else "source.kvstore", code, WARN, message,
+            _PASS_BY_CODE.get(code, "source"), code, WARN, message,
             location=f"{self.filename}:{lineno}"))
 
     def visit_Call(self, node):

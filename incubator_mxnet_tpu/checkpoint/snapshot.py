@@ -31,6 +31,7 @@ import numpy as np
 
 from ..base import MXNetError
 from .. import storage
+from ..resilience import faults as _faults
 from . import manifest as _manifest
 
 ARRAYS_SHARD = "arrays.npk"
@@ -178,6 +179,20 @@ class SnapshotJob:
             # other processes wrote them; the manifest records what rank 0
             # expects so validate() still covers them after adoption
             shards.update(self._adopt_rank_shards(tmp))
+            try:
+                _faults.fire("checkpoint.commit", step=self.step)
+            except _faults.TornWrite:
+                # emulate the writer dying between the directory landing
+                # and the manifest write: the torn directory is committed
+                # WITHOUT a manifest and the write "succeeds" silently —
+                # exactly what a killed process leaves behind.  validate()
+                # must reject it and latest() must fall back one commit.
+                final = os.path.join(self.root,
+                                     _manifest.checkpoint_dirname(self.step))
+                if os.path.isdir(final):
+                    shutil.rmtree(final)
+                os.replace(tmp, final)
+                return
             _manifest.write_manifest(
                 tmp, step=self.step, epoch=self.epoch, nbatch=self.nbatch,
                 shards=shards, rng=self.rng, meta=self.meta,

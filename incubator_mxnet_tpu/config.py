@@ -116,6 +116,43 @@ KNOBS = {
     "MXNET_KVSTORE_COLLECTIVE": (_BOOL, True, "honored",
                                  "dist_sync gradients ride XLA collectives "
                                  "instead of the socket server"),
+    # -- resilience (this framework's own knobs) -----------------------------
+    "MXNET_FAULTS": (str, "", "honored",
+                     "resilience/faults.py: deterministic fault-injection "
+                     "spec, e.g. 'seed=7;transport.send:drop(at=3)'"),
+    "MXNET_FAULTS_LOG": (str, "", "honored",
+                         "append one JSON line per fired fault/retry event "
+                         "(chaos-run artifacts; tools/run_chaos.py)"),
+    "MXNET_PS_REQUEST_TIMEOUT": (float, 330.0, "honored",
+                                 "dist transport per-request timeout; must "
+                                 "exceed the server's 300s sync waits"),
+    "MXNET_PS_CONNECT_WAIT": (float, 90.0, "honored",
+                              "dist transport initial-connect window "
+                              "(covers the worker/server startup race)"),
+    "MXNET_PS_RECONNECT_WAIT": (float, 5.0, "honored",
+                                "dist transport mid-request reconnect "
+                                "window (failover diagnosis speed)"),
+    "MXNET_PS_MAX_RETRIES": (int, 3, "honored",
+                             "dist transport request attempts (backoff + "
+                             "jitter; resends are idempotent via seq)"),
+    "MXNET_PS_BREAKER_THRESHOLD": (int, 2, "honored",
+                                   "consecutive exhausted-retry failures "
+                                   "before a parameter server is declared "
+                                   "lost (ServerLostError)"),
+    "MXNET_PS_BREAKER_RESET_S": (float, 30.0, "honored",
+                                 "open->half-open window of the per-server "
+                                 "circuit breaker"),
+    "MXNET_SERVING_BREAKER_THRESHOLD": (int, 5, "honored",
+                                        "consecutive failed batches before "
+                                        "a served model's breaker opens "
+                                        "(fail fast, shed load)"),
+    "MXNET_SERVING_BREAKER_RESET_S": (float, 30.0, "honored",
+                                      "serving breaker open->half-open "
+                                      "probe window"),
+    "MXNET_FIT_MAX_RESTARTS": (int, 2, "honored",
+                               "Module.fit auto-restarts from the last "
+                               "checkpoint after ServerLostError at most "
+                               "this many times"),
     "MXNET_INTERNAL_CONV_LAYOUT": (str, "NCHW", "honored",
                                    "NHWC internal conv/pool/BN execution "
                                    "(ops/layout.py; measured ~parity on "

@@ -18,6 +18,7 @@ import pickle
 from ..base import MXNetError
 from ..kvstore import (KVStoreTPU, _normalize, _normalize_push, _key,
                        _updater_key)
+from ..resilience import CircuitBreaker, ServerLostError, faults as _faults
 from .transport import Channel
 
 
@@ -46,6 +47,23 @@ class KVStoreDist(KVStoreTPU):
             srv = _check(self._chan.request({"cmd": "server_list"}))
             self._chans += [Channel(h, p) for h, p in srv["servers"]]
         from .. import config as _config
+        # per-server health: a consecutive-failure circuit breaker per
+        # channel; a tripped breaker is the permanent-death diagnosis that
+        # becomes a structured ServerLostError (failover semantics)
+        self._breakers = [
+            CircuitBreaker(
+                failure_threshold=int(_config.get(
+                    "MXNET_PS_BREAKER_THRESHOLD")),
+                reset_timeout=float(_config.get("MXNET_PS_BREAKER_RESET_S")))
+            for _ in self._chans]
+        # a reconnected root channel re-handshakes (re-registers under the
+        # SAME rank) before the retried request is resent
+        rank = self._rank
+
+        def _rehandshake(chan, _rank=rank):
+            chan.bare_request({"cmd": "register", "role": "worker",
+                               "rank": _rank})
+        self._chan.on_reconnect = _rehandshake
         self._bigarray_bound = int(_config.get(
             "MXNET_KVSTORE_BIGARRAY_BOUND"))
         self._push_count = {}    # (srv, key) -> completed sync pushes
@@ -72,22 +90,89 @@ class KVStoreDist(KVStoreTPU):
                 self._collective = None
 
     def _request(self, srv, msg):
-        """One control-channel round trip with failure NAMING turned into
-        failure HANDLING a caller can act on: a dead or unreachable server
-        surfaces as MXNetError identifying WHICH server and what was being
-        asked, instead of a bare socket traceback (VERDICT Weak #6)."""
+        """One control-channel round trip with failover semantics.
+
+        The channel itself retries transient failures (backoff, reconnect,
+        idempotent resend — transport.Channel).  This layer tracks
+        per-server HEALTH: each exhausted channel-level attempt counts
+        against the server's circuit breaker; when the breaker trips the
+        server is diagnosed permanently dead and a structured
+        `ServerLostError` names the server, its address, and the keys
+        whose ranges it owned.  A server that answers but has LOST its
+        store (restarted empty) gets the same diagnosis — its state is
+        unrecoverable without a checkpoint resume either way."""
         chan = self._chans[srv]
-        try:
-            return _check(chan.request(msg))
-        except MXNetError:
-            raise
-        except (ConnectionError, EOFError, OSError, BrokenPipeError) as e:
-            raise MXNetError(
-                f"parameter server {srv} ({chan.host}:{chan.port}) is "
-                f"unreachable during {msg.get('cmd')!r} "
-                f"({type(e).__name__}: {e}); the server process died or "
-                "the network partitioned — restart it and resume from the "
-                "latest checkpoint (checkpoint.latest)") from e
+        breaker = self._breakers[srv]
+        addr = f"{chan.host}:{chan.port}"
+        if not breaker.allow():
+            raise ServerLostError(
+                srv, addr, keys=self._keys_on(srv),
+                reason=f"circuit breaker is {breaker.state} after "
+                       f"{breaker.failure_threshold} consecutive failures")
+        last = None
+        framed = False
+        while True:
+            try:
+                # retries resend the SAME frame (same seq) so a server
+                # that already applied it replays its cached reply
+                reply = chan.resend_last() if framed else chan.request(msg)
+                break
+            except TimeoutError as e:
+                # slow or wedged, not provably dead: the channel stayed
+                # consistent (stale reply discarded by seq).  Resend the
+                # SAME frame (the server's dedup/inflight shell absorbs
+                # it) until the breaker declares the server unresponsive
+                # — a partition with no RST must still reach failover.
+                last = e
+                framed = True
+                if breaker.record_failure():
+                    raise ServerLostError(
+                        srv, addr, keys=self._keys_on(srv),
+                        reason=f"unresponsive during {msg.get('cmd')!r}: "
+                               f"{breaker.failure_threshold} consecutive "
+                               f"timeouts ({e})") from e
+                _faults.note("retry", site="kvstore", server=srv,
+                             cmd=msg.get("cmd"), error="timeout")
+            except (ConnectionError, EOFError, OSError) as e:
+                last = e
+                framed = True
+                if breaker.record_failure():
+                    raise ServerLostError(
+                        srv, addr, keys=self._keys_on(srv),
+                        reason=f"unreachable during {msg.get('cmd')!r} "
+                               f"after {breaker.failure_threshold} "
+                               f"consecutive failures "
+                               f"({type(last).__name__}: {last})") from last
+                _faults.note("reconnect", site="kvstore", server=srv,
+                             cmd=msg.get("cmd"))
+        if "error" in reply:
+            err = reply["error"]
+            k = msg.get("key")
+            if "has not been initialized" in err and k is not None \
+                    and k in self._store:
+                # the server answered but forgot a key this worker DID
+                # initialize: it restarted empty — its range is gone
+                breaker.record_failure()
+                raise ServerLostError(
+                    srv, addr, keys=self._keys_on(srv),
+                    reason=f"server restarted without state ({err})")
+            # an application-level error over a WORKING transport still
+            # proves the server alive — close any half-open probe
+            breaker.record_success()
+            raise MXNetError(err)
+        breaker.record_success()
+        return reply
+
+    def _keys_on(self, srv):
+        """Keys whose shard routing places a range on server `srv`
+        (ServerLostError evidence: what data the lost server owned)."""
+        import numpy as _np
+        out = []
+        for sk, v in self._store.items():
+            size = int(_np.prod(v.shape)) if v.shape else 1
+            if any(s == srv for s, _ in self._shards(sk, size)):
+                out.append(sk)
+        return out
 
     # -- checkpoint plane ------------------------------------------------------
     def get_optimizer_states(self, dump_optimizer=False):
@@ -408,15 +493,22 @@ class KVStoreDist(KVStoreTPU):
     def _barrier(self):
         _check(self._chan.request({"cmd": "barrier"}))
 
-    def close(self):
+    def close(self, send_stop=True):
+        """Close every server channel.  ``send_stop=False`` skips the
+        protocol 'stop' — the failover teardown path, where counting
+        this worker as stopped would shut down HEALTHY servers running
+        `serve_forever` out from under the restarted run."""
         from .. import profiler as _profiler
         if _profiler._kvstore_handle[0] is self:
             _profiler.set_kvstore_handle(None)
         for chan in getattr(self, "_chans", [self._chan]):
-            try:
-                chan.request({"cmd": "stop"})
-            except Exception:
-                pass
+            if send_stop:
+                try:
+                    # best-effort, fail-fast: no reconnect/retry cycle
+                    # against a server that may already be dead
+                    chan.bare_request({"cmd": "stop"})
+                except Exception:
+                    pass
             try:
                 chan.close()
             except Exception:

@@ -30,6 +30,11 @@ import threading
 import numpy as np
 
 from .transport import recv_msg, send_msg
+from ..resilience import faults as _faults
+
+# idempotent reads: re-executing a resend is safe and cheaper than
+# caching replies that can carry whole key-range arrays
+_READ_CMDS = frozenset({"pull", "server_list", "get_optimizer_states"})
 
 
 class _State:
@@ -50,6 +55,16 @@ class _State:
         self.next_rank = 0
         self.stopped = 0
         self.servers = {}        # server_id (>=1) -> (host, port); root = 0
+        # at-most-once RPC shell: a channel's requests are serial, but a
+        # reconnect's re-handshake (register) can land BETWEEN a dropped
+        # reply and its resend, so each client keeps its last few
+        # (seq -> reply) entries — a resend after a mid-message drop
+        # replays the cached reply instead of re-applying the push
+        self.client_replies = {}   # client id -> {seq: reply} (last 4)
+        self.client_inflight = set()   # (client, seq) being processed —
+        # keyed by the PAIR: a reconnect's re-handshake (same client,
+        # new seq) must not clobber a still-executing request's marker
+        self.crashed = False       # fault-injected crash: refuse everything
 
 
 class ParameterServer:
@@ -72,8 +87,27 @@ class ParameterServer:
                         msg = recv_msg(self.request)
                     except (EOFError, ConnectionError, OSError):
                         break
-                    reply = outer._dispatch(msg)
-                    send_msg(self.request, reply)
+                    if state.crashed:
+                        break     # "dead" server: close without replying
+                    try:
+                        reply = outer._handle(msg)
+                    except _faults.FaultInjected as exc:
+                        if exc.kind == "crash":
+                            outer._simulate_crash()
+                        break     # connection dies mid-request, no reply
+                    except (ConnectionError, OSError):
+                        break     # injected/real drop: close, no reply
+                    except Exception as exc:
+                        # injected 'error' faults and real dispatch bugs
+                        # become error replies — a handler thread dying
+                        # with no reply would wedge the worker instead
+                        reply = {"error": f"server dispatch failed: "
+                                          f"{exc!r}",
+                                 "seq": msg.get("seq")}
+                    try:
+                        send_msg(self.request, reply)
+                    except (ConnectionError, OSError):
+                        break     # client dropped while we replied
                     if msg.get("cmd") == "stop":
                         break
 
@@ -114,7 +148,87 @@ class ParameterServer:
         self._server.shutdown()
         self._server.server_close()
 
+    def _simulate_crash(self):
+        """Fault-injected server death: stop accepting, close the listen
+        socket (new connects get refused), and let every live handler
+        thread break on its next request — the process-kill failure mode
+        without killing the test process."""
+        st = self._state
+        with st.cond:
+            if st.crashed:
+                return
+            st.crashed = True
+            st.cond.notify_all()
+        threading.Thread(target=self.shutdown, daemon=True).start()
+
     # -- request dispatch ----------------------------------------------------
+    def _handle(self, msg):
+        """The at-most-once shell around `_dispatch`: replays the cached
+        reply for a resent (client, seq) — a reconnect after a dropped
+        push must never apply the push twice — and echoes `seq` so the
+        client can discard stale frames from timed-out requests."""
+        st = self._state
+
+        def _cached(cache):
+            if cache is not None and seq in cache:
+                reply = dict(cache[seq])
+                reply["seq"] = seq
+                reply["duplicate"] = True
+                return reply
+            return None
+
+        client, seq = msg.get("client"), msg.get("seq")
+        cmd = msg.get("cmd")
+        # read-only commands are safely re-executable and their replies
+        # can carry large arrays: no dedup shell, no reply caching
+        dedup = client is not None and seq is not None \
+            and cmd not in _READ_CMDS
+        if dedup:
+            with st.cond:
+                dup = _cached(st.client_replies.get(client))
+                if dup is not None:
+                    return dup
+                if (client, seq) in st.client_inflight:
+                    # a handler thread on the DROPPED connection is still
+                    # processing this request: wait for its outcome as
+                    # long as the client itself would
+                    from .. import config as _config
+                    st.cond.wait_for(
+                        lambda: (client, seq) not in st.client_inflight,
+                        timeout=float(
+                            _config.get("MXNET_PS_REQUEST_TIMEOUT")))
+                    dup = _cached(st.client_replies.get(client))
+                    if dup is not None:
+                        return dup
+                    return {"error": f"request seq {seq} is still in "
+                                     "flight on another connection",
+                            "seq": seq}
+                st.client_inflight.add((client, seq))
+        reply = None
+        try:
+            _faults.fire("server.dispatch", cmd=cmd)
+            reply = self._dispatch(msg)
+        finally:
+            if dedup:
+                # caching the reply and clearing inflight must be ONE
+                # critical section: a resender woken by the notify must
+                # find the cached reply already there
+                with st.cond:
+                    if reply is not None:
+                        # 'stop' is cached too: a resent stop whose reply
+                        # was dropped must NOT double-increment the
+                        # shutdown quorum (the entry is a few bytes and
+                        # the client is gone anyway)
+                        cache = st.client_replies.setdefault(client, {})
+                        cache[seq] = reply
+                        while len(cache) > 4:
+                            del cache[min(cache)]
+                    st.client_inflight.discard((client, seq))
+                    st.cond.notify_all()
+        if isinstance(reply, dict) and seq is not None:
+            reply["seq"] = seq
+        return reply
+
     def _dispatch(self, msg):
         cmd = msg.get("cmd")
         st = self._state

@@ -20,8 +20,10 @@ import hashlib
 import hmac as _hmac
 import os
 import pickle
+import random as _random
 import socket
 import struct
+import time
 
 _LEN = struct.Struct(">Q")
 _TAG_LEN = 32
@@ -91,37 +93,184 @@ def recv_msg(sock: socket.socket):
 class Channel:
     """One request/response channel to the server (worker side).
 
-    Connection retries cover the server's startup window — workers and
-    server launch concurrently (the reference tracker has the same race and
-    the same answer: ps-lite nodes retry until the scheduler is up).
+    Requests ride sequence-numbered, client-tagged frames.  Three failure
+    modes are handled instead of surfaced raw:
+
+    * **startup race** — workers and server launch concurrently (ps-lite
+      nodes retry until the scheduler is up): connect retries with
+      exponential backoff + jitter under a ``connect_wait`` deadline;
+    * **slow (not dead) server** — a request that exceeds ``timeout``
+      raises, but the channel stays USABLE: when the stale reply finally
+      arrives it is discarded by sequence number on the next request,
+      instead of being misdelivered as that request's answer (the old
+      "timeout desyncs the channel" failure);
+    * **mid-message connection drop** — the request is resent over a
+      fresh connection under the retry policy.  The server deduplicates
+      by ``(client, seq)`` and replays its cached reply, so a resend can
+      never double-apply a push (at-most-once application, exactly-once
+      observation).
     """
 
-    def __init__(self, host: str, port: int, timeout: float | None = 330.0,
-                 connect_wait: float = 90.0):
-        import time
+    _CLIENT_COUNTER = [0]
+
+    def __init__(self, host: str, port: int, timeout: float | None = None,
+                 connect_wait: float | None = None, retry=None):
+        from .. import config as _config
+        from ..resilience import RetryPolicy, faults as _faults
+        self._faults = _faults
         self.host, self.port = host, int(port)  # for error reporting
-        deadline = time.monotonic() + connect_wait
+        # the timeout must exceed the server's longest internal wait (300s
+        # sync-round/barrier waits); it bounds a dead/partitioned server
+        self._timeout = float(timeout) if timeout is not None else \
+            float(_config.get("MXNET_PS_REQUEST_TIMEOUT"))
+        self._connect_wait = float(connect_wait) if connect_wait is not None \
+            else float(_config.get("MXNET_PS_CONNECT_WAIT"))
+        # mid-request reconnects use a SHORTER window than the startup
+        # race: at startup the server may legitimately not exist yet; a
+        # reconnect means it just died, and failover should be diagnosed
+        # in seconds, not minutes
+        self._reconnect_wait = min(
+            self._connect_wait, float(_config.get("MXNET_PS_RECONNECT_WAIT")))
+        self._retry = retry or RetryPolicy(
+            max_attempts=int(_config.get("MXNET_PS_MAX_RETRIES")),
+            base_delay=0.05, max_delay=2.0)
+        Channel._CLIENT_COUNTER[0] += 1
+        self.client_id = "%d.%d.%d" % (os.getpid(), id(self) & 0xffffff,
+                                       Channel._CLIENT_COUNTER[0])
+        self._seq = 0
+        self.resends = 0           # observability: idempotent resends
+        self.discarded_stale = 0   # stale replies dropped by seq
+        self.on_reconnect = None   # re-handshake hook (kvstore_dist sets it)
+        self._sock = None
+        self._closed = False
+        self._connect(self._connect_wait)
+
+    def _connect(self, wait):
+        rng = _random.Random(self._retry.seed)
+        deadline = time.monotonic() + wait
+        attempt = 0
         while True:
             try:
-                self._sock = socket.create_connection((host, port),
-                                                      timeout=10.0)
+                self._faults.fire("transport.connect", host=self.host,
+                                  port=self.port)
+                self._sock = socket.create_connection(
+                    (self.host, self.port), timeout=10.0)
                 break
-            except (ConnectionRefusedError, socket.timeout, OSError):
+            except (ConnectionRefusedError, socket.timeout, OSError) as exc:
                 if time.monotonic() >= deadline:
-                    raise
-                time.sleep(0.3)
-        # the timeout must exceed the server's longest internal wait (300s
-        # sync-round/barrier waits): shorter would cut a frame mid-stream
-        # and desync the channel; it still bounds a dead/partitioned server
-        self._sock.settimeout(timeout)
+                    raise ConnectionError(
+                        f"could not connect to {self.host}:{self.port} "
+                        f"within {wait:g}s ({type(exc).__name__}: {exc})"
+                        ) from exc
+                time.sleep(min(self._retry.delay(attempt, rng),
+                               max(deadline - time.monotonic(), 0.0)))
+                attempt += 1
+        self._sock.settimeout(self._timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
+    def _drop_sock(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _read_reply(self, expect):
+        """Next reply for sequence number `expect`; frames answering
+        other (timed-out) requests are discarded — they are the stale
+        bytes that used to poison the channel.  The expected seq is
+        explicit because a reconnect's re-handshake consumes newer
+        sequence numbers while the resent request keeps its original one
+        (the server dedups on the exact (client, seq) pair)."""
+        while True:
+            self._faults.fire("transport.recv", sock=self._sock)
+            reply = recv_msg(self._sock)
+            seq = reply.get("seq") if isinstance(reply, dict) else None
+            if seq is None or seq == expect:
+                return reply
+            self.discarded_stale += 1
+
     def request(self, obj):
-        send_msg(self._sock, obj)
-        return recv_msg(self._sock)
+        """One request/reply round trip.  Connection-level failures resend
+        under the retry policy (safe: the server dedups by client+seq);
+        a timeout raises but leaves the channel consistent."""
+        self._seq += 1
+        msg = dict(obj)
+        msg["seq"] = self._seq
+        msg["client"] = self.client_id
+        self._last_frame = msg
+        return self._send_framed(msg)
+
+    def resend_last(self):
+        """Retry the most recent request with its ORIGINAL sequence
+        number.  The failover layer's outer retries go through here so a
+        resend that reaches a server which already applied the request
+        hits the (client, seq) dedup cache — a fresh `request()` would
+        stamp a new seq and could double-apply a push."""
+        return self._send_framed(self._last_frame)
+
+    def _send_framed(self, msg):
+        if self._closed:
+            raise ConnectionError(
+                f"channel to {self.host}:{self.port} is closed")
+        delays = self._retry.delays()
+        while True:
+            try:
+                if self._sock is None:
+                    self._connect(self._reconnect_wait)
+                    if self.on_reconnect is not None:
+                        self.on_reconnect(self)
+                self._faults.fire("transport.send", cmd=msg.get("cmd"),
+                                  sock=self._sock)
+                send_msg(self._sock, msg)
+                return self._read_reply(msg["seq"])
+            except socket.timeout:
+                # the timeout may have fired MID-FRAME (partial reply
+                # read, partial send): the stream position is no longer
+                # trustworthy, so drop the socket — the next request
+                # reconnects, and resends stay safe because the server
+                # dedups by (client, seq)
+                self._drop_sock()
+                raise TimeoutError(
+                    f"request {msg.get('cmd')!r} to {self.host}:{self.port} "
+                    f"timed out after {self._timeout:g}s; the server is "
+                    "slow or wedged (socket dropped — the channel "
+                    "reconnects on the next request and resends are "
+                    "deduplicated by sequence number)")
+            except (ConnectionError, EOFError, OSError) as exc:
+                self._drop_sock()
+                delay = next(delays, None)
+                if delay is None:
+                    raise
+                self.resends += 1
+                self._faults.note("retry", site="transport.send",
+                                  cmd=msg.get("cmd"), attempt=self.resends,
+                                  error=type(exc).__name__)
+                time.sleep(delay)
+
+    def bare_request(self, obj):
+        """One un-retried round trip on the live socket (re-handshake
+        hooks use this — they run INSIDE the retry loop)."""
+        self._seq += 1
+        msg = dict(obj)
+        msg["seq"] = self._seq
+        msg["client"] = self.client_id
+        if self._closed or self._sock is None:
+            raise ConnectionError(
+                f"channel to {self.host}:{self.port} is closed")
+        send_msg(self._sock, msg)
+        return self._read_reply(msg["seq"])
 
     def close(self):
+        """Close for good: later requests fail fast instead of silently
+        reconnecting (and re-registering) against whatever now owns the
+        port."""
+        self._closed = True
+        if self._sock is None:
+            return
         try:
             self._sock.close()
         except OSError:
             pass
+        self._sock = None

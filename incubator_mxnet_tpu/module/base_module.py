@@ -188,7 +188,8 @@ class BaseModule:
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, sparse_row_id_fn=None, checkpoint_dir=None,
-            checkpoint_period=100, checkpoint_keep_last=5, resume=False):
+            checkpoint_period=100, checkpoint_keep_last=5, resume=False,
+            max_restarts=None):
         """THE classic training loop (reference `base_module.py:409 fit`).
 
         Elastic checkpointing (no reference analogue): with
@@ -201,7 +202,94 @@ class BaseModule:
         (train-metric accumulation restarts at the resumed batch).  A
         SIGTERM during fit triggers one final synchronous snapshot before
         exiting (checkpoint/manager.py preemption hook).
+
+        Failover (resilience layer): when a distributed run loses a
+        parameter server permanently (`ServerLostError` — crashed,
+        partitioned past the retry budget, or restarted empty) and a
+        ``checkpoint_dir`` is set, fit tears down the kvstore and
+        restarts from the last committed checkpoint instead of dying, up
+        to ``max_restarts`` times (default: MXNET_FIT_MAX_RESTARTS).  A
+        replacement server must be reachable at the configured address —
+        the restarted fit re-registers, re-pushes the checkpointed
+        params, and re-ships the optimizer exactly like a fresh launch.
+        The budget covers failures during the restart's own re-init too
+        (the replacement server dying mid-handshake consumes a restart,
+        not the whole run).
         """
+        from ..resilience import ServerLostError
+        if max_restarts is None:
+            from .. import config as _config
+            max_restarts = int(_config.get("MXNET_FIT_MAX_RESTARTS"))
+        failed_over = False
+        # every attempt gets the same fixed arguments; the restart loop
+        # below only flips resume/force flags (one dict, not a second
+        # copy of the parameter list to keep in sync)
+        fixed = dict(
+            eval_data=eval_data, eval_metric=eval_metric,
+            epoch_end_callback=epoch_end_callback,
+            batch_end_callback=batch_end_callback, kvstore=kvstore,
+            optimizer=optimizer, optimizer_params=optimizer_params,
+            eval_end_callback=eval_end_callback,
+            eval_batch_end_callback=eval_batch_end_callback,
+            initializer=initializer, arg_params=arg_params,
+            aux_params=aux_params, allow_missing=allow_missing,
+            begin_epoch=begin_epoch, num_epoch=num_epoch,
+            validation_metric=validation_metric, monitor=monitor,
+            sparse_row_id_fn=sparse_row_id_fn,
+            checkpoint_dir=checkpoint_dir,
+            checkpoint_period=checkpoint_period,
+            checkpoint_keep_last=checkpoint_keep_last)
+        while True:
+            try:
+                return self._fit_attempt(
+                    train_data, force_rebind=force_rebind,
+                    force_init=force_init, resume=resume, **fixed)
+            except (ServerLostError, ConnectionError, EOFError,
+                    TimeoutError) as e:
+                # raw connection/timeout errors are recoverable only on a
+                # RESTART attempt's re-init (handshake against the
+                # replacement server, before per-server breakers exist) —
+                # on a first attempt they are real configuration errors
+                if not isinstance(e, ServerLostError) and not failed_over:
+                    raise
+                if checkpoint_dir is None or max_restarts <= 0:
+                    raise
+                if not isinstance(kvstore, str):
+                    # a caller-provided kvstore INSTANCE cannot be
+                    # rebuilt; restarting would loop on its closed
+                    # channels — surface the loss instead
+                    raise
+                max_restarts -= 1
+                failed_over = True
+                self.logger.warning(
+                    "fit: %s — restarting from the last checkpoint in %r "
+                    "(%d restart(s) remaining)", e, checkpoint_dir,
+                    max_restarts)
+                self._teardown_kvstore()
+                # the next attempt resumes the checkpoints THIS run wrote
+                # (when one exists, its params override everything);
+                # caller-supplied arg_params stay in place as the
+                # fallback — a crash BEFORE the first commit must restart
+                # from the caller's (e.g. pretrained) weights, not from a
+                # fresh initializer draw
+                resume = True
+                force_rebind = True
+                force_init = True
+
+    def _fit_attempt(self, train_data, eval_data=None, eval_metric="acc",
+                     epoch_end_callback=None, batch_end_callback=None,
+                     kvstore="local", optimizer="sgd",
+                     optimizer_params=(("learning_rate", 0.01),),
+                     eval_end_callback=None, eval_batch_end_callback=None,
+                     initializer=None, arg_params=None, aux_params=None,
+                     allow_missing=False, force_rebind=False,
+                     force_init=False, begin_epoch=0, num_epoch=None,
+                     validation_metric=None, monitor=None,
+                     sparse_row_id_fn=None, checkpoint_dir=None,
+                     checkpoint_period=100, checkpoint_keep_last=5,
+                     resume=False):
+        """One fit attempt; `ServerLostError` propagates to `fit`'s
+        restart loop with the checkpoint manager already flushed/closed."""
         assert num_epoch is not None, "please specify number of epochs"
         from ..initializer import Uniform
         if initializer is None:
@@ -290,6 +378,8 @@ class BaseModule:
         if ckpt_mgr is not None:
             ckpt_mgr.install_preemption_hook()
         from .. import analysis as _analysis
+        from ..resilience import ServerLostError
+        server_lost = False
         try:
             with _analysis.hostsync.hot_loop("Module.fit"):
                 self._fit_epochs(
@@ -299,12 +389,38 @@ class BaseModule:
                     sparse_row_id_fn, begin_epoch, num_epoch, ckpt_mgr,
                     ckpt_resume, resume_nbatch, gstep, last_snap_step,
                     checkpoint_period)
+        except ServerLostError:
+            server_lost = True
+            raise
         finally:
             if ckpt_mgr is not None:
                 try:
                     ckpt_mgr.flush()
+                except MXNetError:
+                    # a deferred background-write error must not mask the
+                    # failover signal the restart loop keys on
+                    if not server_lost:
+                        raise
                 finally:
                     ckpt_mgr.close()
+
+    def _teardown_kvstore(self):
+        """Drop the current kvstore connection so the next
+        `init_optimizer` builds a fresh one (the failover restart path).
+        No protocol 'stop' is sent: this worker is RESTARTING, not
+        leaving — a 'stop' would count toward the surviving servers'
+        shutdown quorum and take them down under the resumed run."""
+        kv = getattr(self, "_kvstore", None)
+        if kv is not None:
+            try:
+                if getattr(kv, "_chans", None) is not None:
+                    kv.close(send_stop=False)
+                else:
+                    kv.close()
+            except Exception:
+                pass
+        self._kvstore = None
+        self.optimizer_initialized = False
 
     def _fit_epochs(self, train_data, eval_data, eval_metric,
                     validation_metric, epoch_end_callback,

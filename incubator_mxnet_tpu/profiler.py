@@ -177,6 +177,18 @@ def record_serving(name, dur_us, **args):
            "dur": float(dur_us), "pid": 0, "tid": 0, "args": args})
 
 
+def record_fault(site, kind, **args):
+    """Record one fired fault / resilience event (resilience.faults feeds
+    this) as an instant event in the chrome trace, so chaos-run failure
+    injections line up against the serving batches and XLA work they
+    disrupted.  A no-op unless a profile is running."""
+    if not _state["running"]:
+        return
+    _emit({"name": f"fault:{site}", "cat": "fault", "ph": "i", "s": "g",
+           "ts": time.perf_counter() * 1e6, "pid": 0, "tid": 0,
+           "args": dict(args, kind=kind)})
+
+
 class _Named:
     def __init__(self, name):
         self.name = name

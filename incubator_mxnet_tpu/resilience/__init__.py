@@ -1,0 +1,60 @@
+"""Resilience: deterministic fault injection + retry/failover primitives.
+
+Production-scale TPU training and serving die on the first unhandled
+transient — worker preemption, dead parameter servers, slow peers,
+half-written checkpoints are ROUTINE at pod scale, and none of them is
+testable unless failures can be made deterministic.  This package is
+both halves of that contract:
+
+* **fault injection** (`faults`) — seeded, reproducible faults at named
+  sites in the dist transport, parameter server, serving batcher, and
+  checkpoint writer, driven by the ``MXNET_FAULTS`` env spec or
+  `inject()`; every fired fault lands in a trace (`trace()`) so tests
+  assert exact sequences;
+* **failure handling** (`retry`, `breaker`) — `RetryPolicy`
+  (exponential backoff + jitter, per-attempt and overall deadlines,
+  retry budget), `CircuitBreaker` (consecutive-failure trip, half-open
+  probes), and the structured `ServerLostError` raised when a parameter
+  server is diagnosed permanently dead — the signal
+  ``Module.fit(checkpoint_dir=..., resume=True)`` turns into an
+  automatic restart from the last checkpoint.
+
+With ``MXNET_FAULTS`` unset, every site hook is a function call behind
+one global read — no locks, no syscalls, no behavior change.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from . import faults
+from .faults import (FaultInjected, TornWrite, configure, inject, clear,
+                     reset, trace, fire, active)
+from .retry import RetryPolicy, RetryBudget
+from .breaker import CircuitBreaker
+
+__all__ = ["faults", "FaultInjected", "TornWrite", "configure", "inject",
+           "clear", "reset", "trace", "fire", "active", "RetryPolicy",
+           "RetryBudget", "CircuitBreaker", "ServerLostError"]
+
+
+class ServerLostError(MXNetError):
+    """A parameter server is permanently gone (crashed, partitioned past
+    the retry budget, or restarted empty).  Structured so training glue
+    can act on it: `server` (index), `addr` ("host:port"), `keys` (the
+    keys whose ranges that server owned).  `Module.fit` with a
+    ``checkpoint_dir`` catches this and restarts from the last
+    checkpoint instead of dying."""
+
+    def __init__(self, server, addr, keys=(), reason=""):
+        self.server = int(server)
+        self.addr = str(addr)
+        self.keys = sorted(str(k) for k in keys)
+        shown = ", ".join(self.keys[:8])
+        if len(self.keys) > 8:
+            shown += f", ... ({len(self.keys)} keys)"
+        super().__init__(
+            f"parameter server {server} ({addr}) is lost"
+            + (f": {reason}" if reason else "")
+            + (f"; it owned key range(s) of [{shown}]" if self.keys else "")
+            + " — restart the server and resume from the latest checkpoint "
+              "(Module.fit(checkpoint_dir=..., resume=True) does this "
+              "automatically)")

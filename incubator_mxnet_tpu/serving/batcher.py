@@ -20,7 +20,20 @@ Unhappy paths are first-class:
   growing an unbounded backlog;
 * graceful drain — `close(drain=True)` stops admissions, completes every
   queued request, then joins the worker (model unload/swap without
-  dropping in-flight work).
+  dropping in-flight work); a ``drain timeout`` turns a wedged drain into
+  a structured error listing the still-pending request ids.
+
+Overload control (the resilience layer's serving half):
+
+* deadline-aware shedding — a request whose deadline cannot be met given
+  the current queue depth and recent batch times is rejected BEFORE it
+  queues (work that will time out anyway must not consume device time
+  other requests could meet their deadlines with);
+* a per-model circuit breaker — consecutive failed batches open it, and
+  while open `submit` fails fast; after the reset window one half-open
+  probe batch tests recovery;
+* bounded execution retries — transient batch failures retry under a
+  `RetryPolicy`, recorded in the metrics retry histogram.
 """
 from __future__ import annotations
 
@@ -33,18 +46,20 @@ from concurrent.futures import Future
 import numpy as _np
 
 from ..base import MXNetError
+from ..resilience import CircuitBreaker, faults as _faults
 
 __all__ = ["MicroBatcher"]
 
 
 class _Request:
     __slots__ = ("arrs", "rows", "deadline", "timeout_ms", "future",
-                 "t_enqueue")
+                 "t_enqueue", "rid")
 
-    def __init__(self, arrs, rows, timeout_ms):
+    def __init__(self, arrs, rows, timeout_ms, rid):
         self.arrs = arrs
         self.rows = rows
         self.timeout_ms = timeout_ms
+        self.rid = rid
         self.t_enqueue = time.monotonic()
         self.deadline = (self.t_enqueue + timeout_ms / 1e3
                          if timeout_ms is not None else None)
@@ -55,7 +70,10 @@ class MicroBatcher:
     """The per-model request queue + coalescing worker."""
 
     def __init__(self, model, metrics, max_batch_size=None,
-                 max_queue_latency_ms=2.0, max_queue=256):
+                 max_queue_latency_ms=2.0, max_queue=256,
+                 breaker_threshold=None, breaker_reset_s=None,
+                 retry_policy=None):
+        from .. import config as _config
         self._model = model
         self._metrics = metrics
         self.max_batch_size = min(int(max_batch_size or model.max_batch_size),
@@ -72,35 +90,91 @@ class MicroBatcher:
         self._draining = threading.Event()
         self._paused = threading.Event()
         self._monitor = None       # a monitor.Monitor driven per batch
+        self._breaker = CircuitBreaker(
+            failure_threshold=int(
+                breaker_threshold if breaker_threshold is not None
+                else _config.get("MXNET_SERVING_BREAKER_THRESHOLD")),
+            reset_timeout=float(
+                breaker_reset_s if breaker_reset_s is not None
+                else _config.get("MXNET_SERVING_BREAKER_RESET_S")))
+        self._retry = retry_policy     # None = batch failures don't retry
+        self._rid_counter = 0
+        self._pending = {}             # rid -> _Request (admitted, unresolved)
         self._thread = threading.Thread(
             target=self._worker, daemon=True,
             name=f"mx-serving-{model.name}")
         self._thread.start()
 
     # -- client side ---------------------------------------------------------
+    def estimated_wait_s(self):
+        """How long a newly queued request will wait before executing,
+        from the queue depth and the EWMA of recent batch times.  None
+        before the first executed batch (no estimate, no shedding)."""
+        batch_s = self._metrics.avg_batch_s()
+        if batch_s is None:
+            return None
+        batches_ahead = -(-(self._q.qsize() + 1) // self.max_batch_size)
+        return batch_s * batches_ahead
+
     def submit(self, inputs, timeout_ms=None):
         """Enqueue one request; returns a Future resolving to the list of
         per-output NDArrays for exactly this request's rows."""
         if self._draining.is_set() or self._stop.is_set():
             raise MXNetError(f"serving: model '{self._model.name}' is "
                              "draining; not accepting requests")
-        rows, arrs = self._model.prepare_rows(inputs)
-        if rows > self.max_batch_size:
+        if not self._breaker.allow():
+            self._metrics.record_breaker_reject()
+            self._metrics.set_breaker_state(self._breaker.state)
             raise MXNetError(
-                f"serving: model '{self._model.name}' request batch {rows} "
-                f"exceeds max_batch_size {self.max_batch_size}")
-        req = _Request(arrs, rows, timeout_ms)
-        with self._lock:
-            self._outstanding += 1
+                f"serving: model '{self._model.name}' circuit breaker is "
+                f"{self._breaker.state} after "
+                f"{self._breaker.failure_threshold} consecutive batch "
+                "failures — failing fast; recovery probes run every "
+                f"{self._breaker.reset_timeout:g}s")
+        # every rejection below must hand back a half-open probe token
+        # `allow()` may just have consumed, or the breaker wedges
+        queued = False
         try:
-            self._q.put_nowait(req)
-        except _queue.Full:
+            if timeout_ms is not None:
+                # deadline-aware shedding: a request that cannot make its
+                # deadline must be refused NOW, before it consumes queue
+                # slots and device time only to time out anyway
+                est = self.estimated_wait_s()
+                if est is not None and est > timeout_ms / 1e3:
+                    self._metrics.record_shed()
+                    raise MXNetError(
+                        f"serving: model '{self._model.name}' is "
+                        f"overloaded — estimated queue wait "
+                        f"{est * 1e3:.0f} ms exceeds this request's "
+                        f"{timeout_ms:g} ms deadline (shed before "
+                        "queueing)")
+            rows, arrs = self._model.prepare_rows(inputs)
+            if rows > self.max_batch_size:
+                raise MXNetError(
+                    f"serving: model '{self._model.name}' request batch "
+                    f"{rows} exceeds max_batch_size {self.max_batch_size}")
             with self._lock:
-                self._outstanding -= 1
-            self._metrics.record_reject()
-            raise MXNetError(
-                f"serving: model '{self._model.name}' queue is full "
-                f"({self.max_queue} pending) — backpressure, retry later")
+                self._rid_counter += 1
+                rid = f"{self._model.name}-{self._rid_counter}"
+                req = _Request(arrs, rows, timeout_ms, rid)
+                req.future.request_id = rid
+                self._outstanding += 1
+                self._pending[rid] = req
+            try:
+                self._q.put_nowait(req)
+            except _queue.Full:
+                with self._lock:
+                    self._outstanding -= 1
+                    self._pending.pop(rid, None)
+                self._metrics.record_reject()
+                raise MXNetError(
+                    f"serving: model '{self._model.name}' queue is full "
+                    f"({self.max_queue} pending) — backpressure, retry "
+                    "later")
+            queued = True
+        finally:
+            if not queued:
+                self._breaker.release_probe()
         if self._stop.is_set():
             # raced with close(): the worker may already be gone and the
             # final failure sweep past — sweep again so no future is left
@@ -123,19 +197,37 @@ class MicroBatcher:
         self._model.install_monitor(mon)
         self._monitor = mon
 
+    def pending_request_ids(self):
+        """Ids of admitted-but-unresolved requests (drain diagnostics)."""
+        with self._lock:
+            return sorted(self._pending)
+
     def close(self, drain=True, timeout=None):
         """Stop the batcher.  With ``drain`` every queued request is
         completed first; without, queued requests fail fast with a
-        shutdown error."""
+        shutdown error.  A drain that outlives ``timeout`` seconds stops
+        anyway and raises a structured error listing the request ids that
+        were still pending — a wedged request must not block an unload
+        forever."""
         self._draining.set()
         self._paused.clear()   # a paused worker could never drain
+        drained = True
         if drain:
             with self._idle:
-                self._idle.wait_for(lambda: self._outstanding == 0,
-                                    timeout=timeout)
+                drained = self._idle.wait_for(
+                    lambda: self._outstanding == 0, timeout=timeout)
+        stuck = self.pending_request_ids() if not drained else []
         self._stop.set()
         self._thread.join(timeout=10)
         self._sweep_failed()   # non-drain shutdown: fail what is queued
+        if stuck:
+            raise MXNetError(
+                f"serving: model '{self._model.name}' drain timed out "
+                f"after {timeout:g}s with {len(stuck)} request(s) still "
+                f"pending: {', '.join(stuck[:16])}"
+                + (" ..." if len(stuck) > 16 else "")
+                + " — queued ones were failed with a shutdown error; a "
+                  "request wedged in execution is abandoned to its future")
 
     def _sweep_failed(self):
         while True:
@@ -151,6 +243,7 @@ class MicroBatcher:
     def _done(self, req):
         with self._idle:
             self._outstanding -= 1
+            self._pending.pop(req.rid, None)
             if self._outstanding == 0:
                 self._idle.notify_all()
 
@@ -228,28 +321,54 @@ class MicroBatcher:
                 live.append(req)
                 rows += req.rows
         if not live:
+            # the whole batch died before executing (deadline-expired in
+            # queue / cancelled): a half-open probe among them never got
+            # its trial — hand the token back or the breaker wedges
+            self._breaker.release_probe()
             return
         bucket = model.bucket_for(rows)
         arrs = [_np.concatenate(parts) if len(parts) > 1 else parts[0]
                 for parts in zip(*(r.arrs for r in live))]
         mon = self._monitor
-        t0 = time.monotonic()
-        try:
-            if mon is not None:
-                mon.tic()
-            outs = model.run_bucket(model.pad_rows(arrs, rows, bucket),
-                                    bucket)
-            import jax
-            jax.block_until_ready(outs)
-            if mon is not None:
-                mon.toc_print()
-        except Exception as exc:  # surface the failure on every future
-            err = exc if isinstance(exc, MXNetError) else MXNetError(
-                f"serving: model '{model.name}' batch execution failed: "
-                f"{exc}")
-            for req in live:
-                self._fail(req, err)
-            return
+        delays = self._retry.delays() if self._retry is not None else iter(())
+        attempt = 0
+        while True:
+            # per-attempt clock: the EWMA that drives deadline shedding
+            # must reflect a successful execution, not backoff sleeps
+            t0 = time.monotonic()
+            try:
+                _faults.fire("serving.execute", model=model.name,
+                             attempt=attempt)
+                if mon is not None:
+                    mon.tic()
+                outs = model.run_bucket(model.pad_rows(arrs, rows, bucket),
+                                        bucket)
+                import jax
+                jax.block_until_ready(outs)
+                if mon is not None:
+                    mon.toc_print()
+                break
+            except Exception as exc:
+                # transient device/runtime failures retry under the policy
+                # (recorded in the retry histogram); exhausted retries fail
+                # every future and count one batch failure on the breaker
+                delay = next(delays, None)
+                if delay is None:
+                    self._breaker.record_failure()
+                    self._metrics.set_breaker_state(self._breaker.state)
+                    err = exc if isinstance(exc, MXNetError) else MXNetError(
+                        f"serving: model '{model.name}' batch execution "
+                        f"failed: {exc}")
+                    for req in live:
+                        self._fail(req, err)
+                    return
+                attempt += 1
+                self._metrics.record_retry(attempt)
+                _faults.note("retry", site="serving.execute",
+                             model=model.name, attempt=attempt)
+                time.sleep(delay)
+        self._breaker.record_success()
+        self._metrics.set_breaker_state(self._breaker.state)
         done = time.monotonic()
         self._metrics.record_batch(rows, bucket, done - t0)
         ctx = model._ctx

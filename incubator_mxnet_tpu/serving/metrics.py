@@ -34,6 +34,12 @@ class ServingMetrics:
         self.rows = 0            # live request rows executed
         self.capacity = 0        # bucket rows executed (rows + padding)
         self.queue_depth = 0     # gauge, set by the batcher
+        # degraded-mode stats (overload controller, resilience layer)
+        self.shed = 0            # deadline-unmeetable, rejected pre-queue
+        self.breaker_rejects = 0  # failed fast while the breaker was open
+        self.breaker_state = "closed"   # gauge, set by the batcher
+        self.retries = collections.Counter()   # attempt number -> count
+        self._ewma_batch_s = None    # recent batch execution time
 
     # -- hot-path updates ----------------------------------------------------
     def record_request(self, queue_depth):
@@ -46,9 +52,35 @@ class ServingMetrics:
             self.batches += 1
             self.rows += rows
             self.capacity += bucket
+            # EWMA of batch execution time: the overload controller's
+            # estimate of how fast the queue drains (shed decisions)
+            self._ewma_batch_s = dur_s if self._ewma_batch_s is None \
+                else 0.8 * self._ewma_batch_s + 0.2 * dur_s
         from .. import profiler as _profiler
         _profiler.record_serving(f"serving:{self.model_name}",
                                  dur_s * 1e6, rows=rows, bucket=bucket)
+
+    def avg_batch_s(self):
+        """Recent batch execution time (EWMA), or None before the first
+        executed batch (no shedding until there is an estimate)."""
+        with self._lock:
+            return self._ewma_batch_s
+
+    def record_shed(self):
+        with self._lock:
+            self.shed += 1
+
+    def record_breaker_reject(self):
+        with self._lock:
+            self.breaker_rejects += 1
+
+    def record_retry(self, attempt):
+        with self._lock:
+            self.retries[int(attempt)] += 1
+
+    def set_breaker_state(self, state):
+        with self._lock:
+            self.breaker_state = state
 
     def record_response(self, latency_s):
         with self._lock:
@@ -88,6 +120,12 @@ class ServingMetrics:
                                     if self.capacity else 0.0),
                 "avg_batch_rows": (self.rows / self.batches
                                    if self.batches else 0.0),
+                "shed": self.shed,
+                "breaker_rejects": self.breaker_rejects,
+                "breaker_state": self.breaker_state,
+                "retry_histogram": dict(self.retries),
+                "avg_batch_ms": (self._ewma_batch_s * 1e3
+                                 if self._ewma_batch_s is not None else None),
             }
         if lat.size:
             snap["p50_ms"] = float(_np.percentile(lat, 50))

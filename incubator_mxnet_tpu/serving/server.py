@@ -86,14 +86,17 @@ class ModelServer:
             old[1].close(drain=True)
         return model
 
-    def unload_model(self, name, drain=True):
+    def unload_model(self, name, drain=True, drain_timeout=None):
         """Remove `name`; with ``drain`` all queued requests complete
-        first (none dropped)."""
+        first (none dropped).  ``drain_timeout`` bounds the wait: when a
+        wedged request keeps the drain from finishing, the batcher stops
+        anyway and a structured `MXNetError` lists the still-pending
+        request ids instead of blocking the unload forever."""
         with self._lock:
             entry = self._models.pop(name, None)
         if entry is None:
             raise MXNetError(f"serving: no model named '{name}'")
-        entry[1].close(drain=drain)
+        entry[1].close(drain=drain, timeout=drain_timeout)
 
     def models(self):
         with self._lock:

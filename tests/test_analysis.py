@@ -280,6 +280,113 @@ def test_source_lints_detect_and_suppress():
     assert not analysis.check_source(fn_src, "f.py").findings
 
 
+def test_unbounded_retry_lint_fixtures():
+    """ISSUE-5 satellite: `while True` around connect/request with no
+    deadline and no raise is an unbounded retry loop."""
+    bad = (
+        "import socket, time\n"                          # 1
+        "while True:\n"                                  # 2
+        "    try:\n"                                     # 3
+        "        s = socket.create_connection(addr)\n"   # 4
+        "        break\n"                                # 5
+        "    except OSError:\n"                          # 6
+        "        time.sleep(0.3)\n"                      # 7
+        "def poll(chan):\n"                              # 8
+        "    while True:\n"                              # 9
+        "        try:\n"                                 # 10
+        "            r = chan.request({'cmd': 'x'})\n"   # 11
+        "        except OSError:\n"                      # 12
+        "            continue\n"                         # 13
+    )
+    report = analysis.check_source(bad, "retry.py")
+    locs = sorted(f.location for f in report
+                  if f.code == "unbounded-retry")
+    assert locs == ["retry.py:2", "retry.py:9"]
+    # a bare call with NO try is not a retry loop: a dead peer's
+    # exception escapes the loop (a server's read loop, for instance)
+    serve = ("while True:\n"
+             "    msg = recv_msg(sock)\n"
+             "    handle(msg)\n")
+    assert not analysis.check_source(serve, "srv.py").findings
+    # `except: break` exits the loop on peer death — a bound (the
+    # conventional connection-handler read loop)
+    read_loop = ("while True:\n"
+                 "    try:\n"
+                 "        msg = recv_msg(sock)\n"
+                 "    except (EOFError, OSError):\n"
+                 "        break\n"
+                 "    handle(msg)\n")
+    assert not analysis.check_source(read_loop, "rl.py").findings
+
+    # a deadline reference OR a raise bounds the loop -> clean
+    good = (
+        "import time\n"
+        "deadline = time.monotonic() + 5\n"
+        "while True:\n"
+        "    try:\n"
+        "        s = socket.create_connection(addr)\n"
+        "        break\n"
+        "    except OSError:\n"
+        "        if time.monotonic() >= deadline:\n"
+        "            raise\n"
+    )
+    assert not analysis.check_source(good, "g.py").findings
+    raises = ("while True:\n"
+              "    try:\n"
+              "        return chan.request(m)\n"
+              "    except OSError:\n"
+              "        raise RuntimeError('dead')\n")
+    assert not [f for f in analysis.check_source(raises, "r.py")
+                if f.code == "unbounded-retry"]
+    # a while-True loop with no connect/request call is not a retry loop
+    assert not analysis.check_source("while True:\n    step()\n",
+                                     "w.py").findings
+    # suppression on the loop line
+    sup = ("while True:  # mxlint: disable=unbounded-retry\n"
+           "    chan.request(m)\n")
+    assert not analysis.check_source(sup, "s.py").findings
+
+
+def test_bare_except_lint_fixtures():
+    """ISSUE-5 satellite: bare `except` swallowing MXNetError in
+    training scripts."""
+    bad = (
+        "try:\n"                                 # 1
+        "    mod.fit(it, num_epoch=2)\n"         # 2
+        "except:\n"                              # 3
+        "    print('oh well')\n"                 # 4
+        "try:\n"                                 # 5
+        "    kv.push(k, v)\n"                    # 6
+        "except Exception:\n"                    # 7
+        "    pass\n"                             # 8
+    )
+    report = analysis.check_source(bad, "swallow.py")
+    locs = sorted(f.location for f in report if f.code == "bare-except")
+    assert locs == ["swallow.py:3", "swallow.py:7"]
+    assert "ServerLostError" in next(
+        f.message for f in report if f.code == "bare-except")
+
+    # re-raising, or catching something specific, is fine
+    ok = (
+        "try:\n"
+        "    mod.fit(it, num_epoch=2)\n"
+        "except:\n"
+        "    cleanup()\n"
+        "    raise\n"
+        "try:\n"
+        "    kv.push(k, v)\n"
+        "except ValueError:\n"
+        "    pass\n"
+        "try:\n"
+        "    f()\n"
+        "except Exception as e:\n"
+        "    log(e)\n"                # broad but does real handling
+    )
+    assert not analysis.check_source(ok, "ok.py").findings
+    sup = "try:\n    f()\nexcept:  # mxlint: disable\n    pass\n"
+    assert not analysis.check_source(sup, "s.py").findings
+
+
 def test_mxlint_cli_examples_zero_findings_and_seeded_defects(tmp_path,
                                                               capsys):
     import importlib

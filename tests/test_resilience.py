@@ -1,0 +1,664 @@
+"""Resilience: deterministic fault injection + retry/failover (ISSUE-5).
+
+Covers: seeded fault schedules bit-for-bit reproducible; `at=`/`n=` firing
+controls produce exact trace sequences; RetryPolicy backoff determinism,
+deadline and budget exhaustion; CircuitBreaker scripted
+open/half-open/close; a slow (not dead) server no longer poisons the
+channel (seq-framing regression for the old 330s-timeout desync); push
+survives a mid-message connection drop — both frame-torn-on-send and
+reply-lost-after-apply (idempotent resend, no double apply) — with values
+identical to a no-fault run; a dead server surfaces as a structured
+ServerLostError naming server and keys; the overloaded batcher sheds only
+requests whose deadlines cannot be met; the serving circuit breaker
+opens, fails fast, half-open probes, and closes; execution retries land
+in the metrics histogram; unload drain_timeout lists pending request ids;
+a torn checkpoint write is never resumed from; and a killed-server
+`Module.fit` run auto-resumes from checkpoint to the same final params as
+an uninterrupted run.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, resilience, sym
+from incubator_mxnet_tpu.base import MXNetError
+from incubator_mxnet_tpu.io import NDArrayIter
+from incubator_mxnet_tpu.resilience import (CircuitBreaker, RetryBudget,
+                                            RetryPolicy, ServerLostError)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    resilience.clear()
+    yield
+    resilience.clear()
+
+
+@pytest.fixture()
+def fast_failover(monkeypatch):
+    """Failover diagnosis in well under a second (prod defaults wait
+    seconds per reconnect so a GC pause is not declared a death)."""
+    monkeypatch.setenv("MXNET_PS_RECONNECT_WAIT", "0.2")
+    monkeypatch.setenv("MXNET_PS_MAX_RETRIES", "2")
+    monkeypatch.setenv("MXNET_PS_BREAKER_THRESHOLD", "2")
+
+
+def _dist_env(monkeypatch, port):
+    for k, v in {"DMLC_PS_ROOT_URI": "127.0.0.1",
+                 "DMLC_PS_ROOT_PORT": str(port), "DMLC_RANK": "0",
+                 "DMLC_NUM_WORKER": "1", "DMLC_NUM_SERVER": "1",
+                 "MXNET_KVSTORE_COLLECTIVE": "0"}.items():
+        monkeypatch.setenv(k, v)
+
+
+# -- fault injection engine ---------------------------------------------------
+
+def test_seeded_fault_schedule_bit_for_bit_reproducible():
+    spec = "seed=42;demo.site:error(p=0.4,n=5)"
+
+    def run():
+        resilience.configure(spec)
+        fired = []
+        for i in range(40):
+            try:
+                resilience.fire("demo.site", cmd="x")
+            except MXNetError:
+                fired.append(i)
+        return fired, [(e["site"], e["kind"], e["hit"], e["seq"])
+                       for e in resilience.trace()]
+    first = run()
+    second = run()
+    assert first == second
+    assert first[0], "seeded schedule fired nothing"
+    assert len(first[1]) == 5    # n=5 cap respected
+    # reset() (same clauses, counters rewound) reproduces it too
+    resilience.reset()
+    fired = []
+    for i in range(40):
+        try:
+            resilience.fire("demo.site", cmd="x")
+        except MXNetError:
+            fired.append(i)
+    assert fired == first[0]
+
+
+def test_at_and_count_controls_exact_sequence():
+    resilience.inject("a.b", "error", at=3)
+    resilience.inject("c.d", "error", n=2)
+    log = []
+    for i in range(1, 6):
+        for site in ("a.b", "c.d"):
+            try:
+                resilience.fire(site)
+            except MXNetError:
+                log.append((site, i))
+    assert log == [("c.d", 1), ("c.d", 2), ("a.b", 3)]
+    tr = resilience.trace()
+    assert [(e["site"], e["hit"]) for e in tr] == \
+        [("c.d", 1), ("c.d", 2), ("a.b", 3)]
+
+
+def test_spec_parse_grammar_and_errors():
+    from incubator_mxnet_tpu.resilience import faults
+    clauses, seed = faults.parse_spec(
+        "seed=7;transport.send:drop(at=3,cmd=push);server.dispatch:"
+        "slow(ms=50,p=0.1)")
+    assert seed == 7
+    assert clauses[0] == ("transport.send", "drop",
+                          {"at": "3", "cmd": "push"})
+    assert clauses[1][1] == "slow"
+    with pytest.raises(MXNetError, match="cannot parse"):
+        faults.parse_spec("not a clause")
+    with pytest.raises(MXNetError, match="unknown fault kind"):
+        faults.configure("a.b:explode")
+
+
+def test_cmd_filter_scopes_the_fault():
+    resilience.inject("s.x", "error", cmd="push", at=1)
+    resilience.fire("s.x", cmd="pull")       # filtered out, no fire
+    with pytest.raises(MXNetError):
+        resilience.fire("s.x", cmd="push")
+    assert [e["ctx"]["cmd"] for e in resilience.trace()] == ["push"]
+
+
+# -- retry policy / circuit breaker -------------------------------------------
+
+def test_retry_policy_backoff_deterministic_and_bounded():
+    a = RetryPolicy(max_attempts=5, base_delay=0.1, max_delay=0.5,
+                    multiplier=2.0, jitter=0.5, seed=9)
+    b = RetryPolicy(max_attempts=5, base_delay=0.1, max_delay=0.5,
+                    multiplier=2.0, jitter=0.5, seed=9)
+    da, db = list(a.delays()), list(b.delays())
+    assert da == db and len(da) == 4
+    # geometric growth capped at max_delay, jitter never exceeds +50%
+    assert 0.1 <= da[0] <= 0.15 and 0.2 <= da[1] <= 0.3
+    assert all(d <= 0.5 * 1.5 + 1e-9 for d in da)
+
+    # overall deadline cuts the schedule short
+    t = [0.0]
+    p = RetryPolicy(max_attempts=10, base_delay=1.0, jitter=0.0,
+                    deadline=2.5, clock=lambda: t[0])
+    out = []
+    for d in p.delays():
+        out.append(d)
+        t[0] += d
+    assert out == [1.0, 2.0]   # at t=3.0 the 2.5s deadline has passed
+
+    # budget exhaustion stops retries across policies sharing it
+    budget = RetryBudget(capacity=3, refill_per_s=0.0, clock=lambda: 0.0)
+    p = RetryPolicy(max_attempts=10, base_delay=0.0, jitter=0.0,
+                    budget=budget)
+    assert len(list(p.delays())) == 3
+    assert len(list(p.delays())) == 0   # bucket is dry
+
+
+def test_retry_policy_call_retries_then_raises():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("flaky")
+        return "ok"
+    p = RetryPolicy(max_attempts=4, base_delay=0.0, jitter=0.0)
+    seen = []
+    assert p.call(flaky, on_retry=lambda a, e: seen.append(a)) == "ok"
+    assert calls["n"] == 3 and seen == [1, 2]
+    calls["n"] = -100
+    with pytest.raises(ConnectionError):
+        RetryPolicy(max_attempts=2, base_delay=0.0).call(flaky)
+
+
+def test_circuit_breaker_scripted_sequence():
+    t = [0.0]
+    br = CircuitBreaker(failure_threshold=3, reset_timeout=5.0,
+                        clock=lambda: t[0])
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed"          # 2 < threshold
+    br.record_success()                  # consecutive count resets
+    br.record_failure()
+    br.record_failure()
+    assert br.record_failure() is True   # third consecutive: trips
+    assert br.state == "open" and not br.allow()
+    t[0] += 4.9
+    assert not br.allow()                # still inside the open window
+    t[0] += 0.2
+    assert br.state == "half_open"
+    assert br.allow()                    # the one probe
+    assert not br.allow()                # everyone else fails fast
+    br.record_failure()                  # probe failed -> open again
+    assert br.state == "open"
+    t[0] += 5.1
+    assert br.allow()                    # next probe
+    br.record_success()
+    assert br.state == "closed" and br.allow()
+
+
+# -- transport: slow server / mid-message drops -------------------------------
+
+def test_slow_server_no_longer_poisons_the_channel():
+    """Regression for the timeout desync: a request that times out against
+    a SLOW (not dead) server leaves the channel usable; the late reply is
+    discarded by sequence number instead of being misdelivered."""
+    from incubator_mxnet_tpu.dist.server import ParameterServer
+    from incubator_mxnet_tpu.dist.transport import Channel
+
+    server = ParameterServer(num_workers=1).start()
+    resilience.inject("server.dispatch", "slow", ms=400, at=2)
+    chan = Channel("127.0.0.1", server.port, timeout=0.15)
+    try:
+        r = chan.request({"cmd": "init", "keys": ["a"],
+                          "values": [np.ones(2, "f4")]})
+        assert r.get("ok")
+        with pytest.raises(TimeoutError, match="slow or wedged"):
+            chan.request({"cmd": "pull", "key": "a"})   # hit 2: 400ms stall
+        # the socket was dropped (a mid-frame timeout cannot be told
+        # apart from a boundary one); the channel reconnects on the next
+        # request and serves the RIGHT replies — no poisoning, no stale
+        # delivery
+        time.sleep(0.5)   # let the wedged handler finish with the old conn
+        r = chan.request({"cmd": "init", "keys": ["b"],
+                          "values": [np.full(3, 5, "f4")]})
+        assert r.get("ok") and r["seq"] == chan._seq
+        r = chan.request({"cmd": "pull", "key": "b"})
+        np.testing.assert_array_equal(np.asarray(r["value"]),
+                                      np.full(3, 5, "f4"))
+    finally:
+        chan.close()
+        server.shutdown()
+
+
+def _push_pull_run(monkeypatch, fault=None):
+    """One single-worker dist round: 3 pushes then a pull.  Returns the
+    pulled values + the server-side version counter."""
+    from incubator_mxnet_tpu.dist.server import ParameterServer
+    from incubator_mxnet_tpu.dist.kvstore_dist import KVStoreDist
+
+    server = ParameterServer(num_workers=1).start()
+    _dist_env(monkeypatch, server.port)
+    kv = KVStoreDist("dist_sync")
+    try:
+        kv.init("w", nd.zeros((4,)))
+        if fault is not None:
+            resilience.inject(*fault[0], **fault[1])
+        for i in range(3):
+            kv.push("w", nd.ones((4,)) * (i + 1))
+        out = nd.zeros((4,))
+        kv.pull("w", out=out)
+        values = out.asnumpy().copy()
+        version = server._state.version["w"]
+        resends = kv._chan.resends
+        fault_trace = [e for e in resilience.trace()
+                       if e["event"] == "fault"]
+    finally:
+        resilience.clear()
+        kv.close()
+        server.shutdown()
+    return values, version, resends, fault_trace
+
+
+def test_push_survives_mid_message_drop_on_send(monkeypatch, fast_failover):
+    """The 2nd push's frame is torn mid-send (partial length prefix +
+    socket close): the channel reconnects and resends; final values and
+    round count are identical to the no-fault run."""
+    clean_vals, clean_ver, _, _ = _push_pull_run(monkeypatch)
+    vals, ver, resends, faults_fired = _push_pull_run(
+        monkeypatch, fault=(("transport.send", "drop"),
+                            {"cmd": "push", "at": 2}))
+    np.testing.assert_array_equal(vals, clean_vals)
+    assert ver == clean_ver
+    assert resends >= 1
+    # exactly one fault fired, at the declared site, on the push cmd
+    assert [(e["site"], e["ctx"]["cmd"]) for e in faults_fired] == \
+        [("transport.send", "push")]
+
+
+def test_push_survives_reply_drop_without_double_apply(monkeypatch,
+                                                       fast_failover):
+    """The drop lands AFTER the server applied the push (reply lost):
+    the resend must hit the server's (client, seq) dedup cache and replay
+    the reply — a double-applied push would add a spurious round and
+    change both the version counter and the pulled values."""
+    clean_vals, clean_ver, _, _ = _push_pull_run(monkeypatch)
+    # the clause is injected after init, so recv hits count from push1:
+    # at=2 drops the connection while awaiting push2's reply
+    vals, ver, resends, _ = _push_pull_run(
+        monkeypatch, fault=(("transport.recv", "drop"), {"at": 2}))
+    np.testing.assert_array_equal(vals, clean_vals)
+    assert ver == clean_ver, "resend double-applied a push round"
+    assert resends >= 1
+
+
+def test_dead_server_raises_structured_server_lost_error(monkeypatch,
+                                                         fast_failover):
+    from incubator_mxnet_tpu.dist.server import ParameterServer
+    from incubator_mxnet_tpu.dist.kvstore_dist import KVStoreDist
+
+    server = ParameterServer(num_workers=1).start()
+    _dist_env(monkeypatch, server.port)
+    kv = KVStoreDist("dist_sync")
+    try:
+        kv.init("w", nd.ones((6,)))
+        server._simulate_crash()     # listener closed, handlers refuse
+        time.sleep(0.1)
+        with pytest.raises(ServerLostError) as err:
+            kv.push("w", nd.ones((6,)))
+        assert err.value.server == 0
+        assert "w" in err.value.keys
+        assert f"127.0.0.1:{server.port}" in err.value.addr
+        # breaker now open: the next call fails fast without wire time
+        t0 = time.monotonic()
+        with pytest.raises(ServerLostError, match="circuit breaker"):
+            kv.push("w", nd.ones((6,)))
+        assert time.monotonic() - t0 < 0.1
+    finally:
+        kv.close()
+        server.shutdown()
+
+
+def test_shadowed_clause_keeps_its_budget():
+    """Two clauses matching the same site: the one shadowed on a hit must
+    not burn its n= budget — it fires on the next hit instead."""
+    resilience.inject("s.t", "error", at=1)
+    resilience.inject("s.t", "slow", ms=1, n=1)
+    with pytest.raises(MXNetError):
+        resilience.fire("s.t")        # hit 1: error wins, slow shadowed
+    resilience.fire("s.t")            # hit 2: slow's budget is intact
+    assert [e["kind"] for e in resilience.trace()] == ["error", "slow"]
+
+
+def test_breaker_probe_released_on_pre_execution_rejection():
+    """A half-open probe admitted by allow() but rejected before it
+    executes must be handed back, not leaked (a leaked probe wedges the
+    breaker in half_open forever)."""
+    t = [0.0]
+    br = CircuitBreaker(failure_threshold=1, reset_timeout=5.0,
+                        clock=lambda: t[0])
+    br.record_failure()
+    t[0] += 5.1
+    assert br.allow()        # the probe
+    assert not br.allow()    # probe out: everyone else fails fast
+    br.release_probe()       # admission-time rejection hands it back
+    assert br.allow()        # probe available again
+    br.record_success()
+    assert br.state == "closed"
+
+
+def test_resend_last_replays_cached_reply_same_seq(monkeypatch):
+    """The failover layer's outer retries resend the SAME frame: the
+    server's dedup cache replays the reply instead of re-applying."""
+    from incubator_mxnet_tpu.dist.server import ParameterServer
+    from incubator_mxnet_tpu.dist.transport import Channel
+
+    server = ParameterServer(num_workers=1).start()
+    chan = Channel("127.0.0.1", server.port)
+    try:
+        chan.request({"cmd": "init", "keys": ["k"],
+                      "values": [np.zeros(2, "f4")]})
+        r1 = chan.request({"cmd": "push", "key": "k", "sync": True,
+                           "rank": 0, "value": np.ones(2, "f4")})
+        assert r1["version"] == 1
+        r2 = chan.resend_last()
+        assert r2.get("duplicate") and r2["version"] == 1
+        assert server._state.version["k"] == 1, "resend re-applied the push"
+    finally:
+        chan.close()
+        server.shutdown()
+
+
+def test_three_server_drop_mid_push_then_permanent_crash(monkeypatch,
+                                                         fast_failover):
+    """The acceptance schedule on a 3-server run: one kvstore shard push
+    is dropped mid-message (recovered transparently, values correct),
+    then one server crashes permanently (structured failover: the error
+    names the dead server and the keys whose ranges it owned)."""
+    from incubator_mxnet_tpu.dist.server import (ParameterServer,
+                                                 register_with_root)
+    from incubator_mxnet_tpu.dist.kvstore_dist import KVStoreDist
+
+    root = ParameterServer(num_workers=1, num_servers=3).start()
+    secondaries = []
+    for sid in (1, 2):
+        srv = ParameterServer(num_workers=1, num_servers=3, port=0).start()
+        register_with_root("127.0.0.1", root.port, sid, "127.0.0.1",
+                           srv.port)
+        secondaries.append(srv)
+    _dist_env(monkeypatch, root.port)
+    monkeypatch.setenv("DMLC_NUM_SERVER", "3")
+    monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "16")
+    kv = KVStoreDist("dist_sync")
+    try:
+        assert len(kv._chans) == 3
+        big = np.arange(40, dtype="f4")
+        kv.init("big", nd.zeros((40,)))
+        # a push fans out one shard per server; drop the 2nd shard's send
+        # mid-message — the resend must land exactly once
+        resilience.inject("transport.send", "drop", cmd="push", at=2)
+        kv.push("big", nd.array(big))
+        out = nd.zeros((40,))
+        kv.pull("big", out=out)
+        np.testing.assert_array_equal(out.asnumpy(), big)
+        fired = [e for e in resilience.trace() if e["event"] == "fault"]
+        assert [(e["site"], e["ctx"]["cmd"]) for e in fired] == \
+            [("transport.send", "push")]
+        # now server 1 dies for good: the next round trips its breaker
+        secondaries[0]._simulate_crash()
+        time.sleep(0.1)
+        with pytest.raises(ServerLostError) as err:
+            kv.push("big", nd.array(big))
+            kv.pull("big", out=out)
+        assert err.value.server == 1
+        assert "big" in err.value.keys
+    finally:
+        kv.close()
+        root.shutdown()
+        for srv in secondaries:
+            srv.shutdown()
+
+
+# -- serving: overload controller ---------------------------------------------
+
+def _serving_model(in_dim=6, n_out=3, batch=4, seed=0):
+    np.random.seed(seed)
+    mx.random.seed(seed)
+    net = sym.Variable("data")
+    net = sym.FullyConnected(net, num_hidden=8, name="fc0")
+    net = sym.FullyConnected(net, num_hidden=n_out, name="head")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[mx.io.DataDesc("data", (batch, in_dim))],
+             label_shapes=[mx.io.DataDesc("softmax_label", (batch,))],
+             for_training=False, grad_req="null")
+    mod.init_params(mx.initializer.Xavier())
+    args, auxs = mod.get_params()
+    return net, args, auxs
+
+
+def test_overloaded_batcher_sheds_only_past_deadline_requests():
+    net, args, auxs = _serving_model()
+    with mx.serving.ModelServer(max_queue_latency_ms=0.0) as srv:
+        srv.load_model("ovl", symbol=net, arg_params=args, aux_params=auxs,
+                       data_shapes=[("data", (1, 6))], buckets=(1, 2, 4))
+        batcher = srv.batcher("ovl")
+        # prime the controller's estimate: recent batches took 50 ms
+        batcher._metrics.record_batch(4, 4, 0.05)
+        batcher.pause()
+        x = np.zeros((1, 6), np.float32)
+        futs = [srv.submit("ovl", {"data": x}) for _ in range(8)]
+        # 8 queued 1-row requests, max batch 4, 50ms/batch -> ~150ms wait:
+        # a 20ms deadline cannot be met and must be shed BEFORE queueing
+        with pytest.raises(MXNetError, match="overloaded.*shed"):
+            srv.submit("ovl", {"data": x}, timeout_ms=20)
+        # a 10s deadline CAN be met: accepted, not shed
+        ok = srv.submit("ovl", {"data": x}, timeout_ms=10000)
+        batcher.resume()
+        for f in futs + [ok]:
+            assert len(f.result(30)) == 1
+        snap = srv.stats()["ovl"]
+        assert snap["shed"] == 1
+        assert snap["responses"] == 9
+
+
+def test_serving_circuit_breaker_opens_half_opens_closes():
+    net, args, auxs = _serving_model()
+    with mx.serving.ModelServer(max_queue_latency_ms=0.0) as srv:
+        srv.load_model("brk", symbol=net, arg_params=args, aux_params=auxs,
+                       data_shapes=[("data", (1, 6))], buckets=(1, 2),
+                       breaker_threshold=2, breaker_reset_s=0.25)
+        x = np.zeros((1, 6), np.float32)
+        resilience.inject("serving.execute", "error", n=2)
+        for _ in range(2):   # two consecutive failed batches trip it
+            with pytest.raises(MXNetError, match="fault-injected"):
+                srv.predict("brk", {"data": x})
+        with pytest.raises(MXNetError, match="circuit breaker is open"):
+            srv.submit("brk", {"data": x})
+        snap = srv.stats()["brk"]
+        assert snap["breaker_state"] == "open"
+        assert snap["breaker_rejects"] == 1
+        time.sleep(0.3)      # open window elapses -> half-open probe
+        assert len(srv.predict("brk", {"data": x})) == 1
+        assert srv.stats()["brk"]["breaker_state"] == "closed"
+        assert len(resilience.trace()) == 2
+
+
+def test_serving_execution_retries_land_in_histogram():
+    net, args, auxs = _serving_model()
+    with mx.serving.ModelServer(max_queue_latency_ms=0.0) as srv:
+        srv.load_model("rty", symbol=net, arg_params=args, aux_params=auxs,
+                       data_shapes=[("data", (1, 6))], buckets=(1, 2),
+                       retry_policy=RetryPolicy(max_attempts=3,
+                                                base_delay=0.01,
+                                                jitter=0.0))
+        resilience.inject("serving.execute", "error", n=2)
+        x = np.zeros((1, 6), np.float32)
+        out = srv.predict("rty", {"data": x})   # fails twice, 3rd succeeds
+        assert len(out) == 1
+        snap = srv.stats()["rty"]
+        assert snap["retry_histogram"] == {1: 1, 2: 1}
+        assert snap["breaker_state"] == "closed"
+        assert snap["responses"] == 1
+
+
+def test_unload_drain_timeout_lists_pending_request_ids():
+    net, args, auxs = _serving_model()
+    srv = mx.serving.ModelServer(max_queue_latency_ms=0.0)
+    try:
+        srv.load_model("wdg", symbol=net, arg_params=args, aux_params=auxs,
+                       data_shapes=[("data", (1, 6))], buckets=(1, 2))
+        # wedge the worker: the first batch stalls 1s inside execution
+        resilience.inject("serving.execute", "slow", ms=1000, at=1)
+        x = np.zeros((1, 6), np.float32)
+        f1 = srv.submit("wdg", {"data": x})
+        f2 = srv.submit("wdg", {"data": x})
+        assert f1.request_id == "wdg-1" and f2.request_id == "wdg-2"
+        with pytest.raises(MXNetError, match=r"drain timed out .* "
+                                             r"pending: wdg-"):
+            srv.unload_model("wdg", drain_timeout=0.2)
+        assert "wdg" not in srv.models()   # unloaded despite the wedge
+    finally:
+        srv.shutdown(drain=False)
+
+
+# -- checkpoint: torn writes --------------------------------------------------
+
+def test_torn_checkpoint_write_is_never_resumed_from(tmp_path):
+    from incubator_mxnet_tpu import checkpoint as ckpt
+
+    resilience.inject("checkpoint.commit", "torn", at=2)
+    mgr = ckpt.CheckpointManager(str(tmp_path), async_snapshots=False)
+    for step in (1, 2):
+        mgr.snapshot(arrays={"w": np.full((4,), step, "f4")}, step=step,
+                     sync=True)
+    # step 2's write tore (directory landed without a manifest) and the
+    # run NOTICED NOTHING — exactly a killed writer's disk state
+    assert os.path.isdir(os.path.join(tmp_path, "ckpt-0000000002"))
+    assert ckpt.latest(str(tmp_path)).endswith("ckpt-0000000001")
+    mgr.snapshot(arrays={"w": np.full((4,), 3, "f4")}, step=3, sync=True)
+    mgr.close()
+    data = ckpt.load(ckpt.latest(str(tmp_path)))
+    assert data.step == 3
+    np.testing.assert_array_equal(data.arrays["w"], np.full((4,), 3, "f4"))
+    assert [e["kind"] for e in resilience.trace()] == ["torn"]
+
+
+# -- end to end: killed-server training auto-resume ---------------------------
+
+def _mlp():
+    d = sym.Variable("data")
+    f1 = sym.FullyConnected(d, num_hidden=8, name="fc1")
+    a1 = sym.Activation(f1, act_type="relu")
+    f2 = sym.FullyConnected(a1, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(f2, name="softmax")
+
+
+def _fit_dist(port, ckpt_dir=None, kill_at=None, num_epoch=2):
+    """One single-worker dist_sync training run against the server on
+    `port`.  With `kill_at`, the server is crashed at that batch-end and
+    a replacement (EMPTY) server is started on the same port — fit must
+    diagnose ServerLostError and auto-resume from the checkpoint."""
+    from incubator_mxnet_tpu.dist.server import ParameterServer
+
+    mx.random.seed(11)
+    np.random.seed(11)
+    X = np.random.RandomState(2).randn(48, 10).astype("f4")
+    y = (np.arange(48) % 4).astype("f4")
+    it = NDArrayIter(X, y, batch_size=8, shuffle=False)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+
+    replacement = []
+    cb = None
+    if kill_at is not None:
+        hits = {"n": 0}
+
+        def cb(param):
+            hits["n"] += 1
+            if hits["n"] == kill_at:
+                _fit_dist.server._simulate_crash()
+                for _ in range(200):   # rebind as soon as the port frees
+                    try:
+                        srv = ParameterServer(host="127.0.0.1", port=port,
+                                              num_workers=1)
+                        break
+                    except OSError:
+                        time.sleep(0.05)
+                replacement.append(srv.start())
+    mod.fit(it, kvstore="dist_sync", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1}, num_epoch=num_epoch,
+            checkpoint_dir=ckpt_dir, checkpoint_period=1,
+            batch_end_callback=cb)
+    args, auxs = mod.get_params()
+    params = {k: v.asnumpy().copy() for k, v in args.items()}
+    kv = getattr(mod, "_kvstore", None)
+    if kv is not None:
+        kv.close()
+    return params, replacement
+
+
+def test_killed_server_fit_auto_resumes_bit_identical(monkeypatch,
+                                                      tmp_path,
+                                                      fast_failover):
+    """The acceptance gate: crash the parameter server mid-epoch (its
+    replacement comes back EMPTY on the same address), and
+    Module.fit(checkpoint_dir=...) restarts from the last checkpoint —
+    final params bit-identical to an uninterrupted run."""
+    from incubator_mxnet_tpu.dist.server import ParameterServer
+
+    clean_server = ParameterServer(num_workers=1).start()
+    _dist_env(monkeypatch, clean_server.port)
+    clean_params, _ = _fit_dist(clean_server.port)
+    clean_server.shutdown()
+
+    server = ParameterServer(num_workers=1).start()
+    _dist_env(monkeypatch, server.port)
+    _fit_dist.server = server
+    faulted_params, replacement = _fit_dist(
+        server.port, ckpt_dir=str(tmp_path / "ckpts"), kill_at=7)
+    assert replacement, "the kill callback never ran"
+    assert sorted(faulted_params) == sorted(clean_params)
+    for k in clean_params:
+        np.testing.assert_array_equal(faulted_params[k], clean_params[k],
+                                      err_msg=f"param {k} diverged")
+    for srv in replacement:
+        srv.shutdown()
+    server.shutdown()
+
+
+def test_fit_without_checkpoint_dir_still_dies_on_server_loss(monkeypatch,
+                                                              fast_failover):
+    """No checkpoint, no silent restart: ServerLostError propagates."""
+    from incubator_mxnet_tpu.dist.server import ParameterServer
+
+    server = ParameterServer(num_workers=1).start()
+    _dist_env(monkeypatch, server.port)
+    mx.random.seed(3)
+    np.random.seed(3)
+    X = np.random.randn(16, 10).astype("f4")
+    y = (np.arange(16) % 4).astype("f4")
+    it = NDArrayIter(X, y, batch_size=8, shuffle=False)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+
+    def cb(param):
+        server._simulate_crash()
+    with pytest.raises(ServerLostError):
+        mod.fit(it, kvstore="dist_sync", optimizer="sgd", num_epoch=2,
+                batch_end_callback=cb)
+    kv = getattr(mod, "_kvstore", None)
+    if kv is not None:
+        kv.close()
+    server.shutdown()
+
+
+def test_no_faults_means_zero_schedule_and_clean_trace():
+    """With no schedule configured the hot-path gate stays off and the
+    trace stays empty — the MXNET_FAULTS-unset contract."""
+    from incubator_mxnet_tpu.resilience import faults
+    resilience.clear()
+    for _ in range(100):
+        resilience.fire("transport.send", cmd="push")
+    assert resilience.trace() == []
+    assert faults.ACTIVE is False

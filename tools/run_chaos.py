@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Chaos runner: the tier-1 dist + serving tests under canned fault
+schedules, with a JSON artifact of what was injected and what survived.
+
+Each schedule sets ``MXNET_FAULTS`` (a seeded, deterministic fault spec —
+see resilience/faults.py) and ``MXNET_FAULTS_LOG`` for the pytest process
+AND every worker subprocess it spawns, runs the selected tests, then
+aggregates the fault log: faults fired by site/kind, retries, reconnects,
+and the final pass/fail counts.  The tests are the SAME tests that gate
+normal PRs — the chaos claim is exactly "the functional contract holds
+while the transport is being actively sabotaged".
+
+Usage: python tools/run_chaos.py [--quick] [--json] [--out PATH]
+    --quick   bounded test selection (the run_tpu_parity.py stage)
+    --json    print only the JSON artifact on stdout
+    --out     also write the artifact to PATH (default CHAOS_REPORT.json)
+
+Exit status: 0 when every schedule's tests passed.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# seeded schedules: same seed -> same per-process fault sequence, so a
+# red chaos run reproduces locally with the spec string alone
+SCHEDULES = {
+    "flaky-connect": "seed=11;transport.connect:refuse(n=2)",
+    "dropped-pushes": "seed=12;transport.send:drop(p=0.3,cmd=push,n=4)",
+    "slow-peers": ("seed=13;server.dispatch:slow(ms=30,p=0.05);"
+                   "serving.execute:slow(ms=10,p=0.2)"),
+}
+
+QUICK_TESTS = [
+    "tests/test_dist.py::test_dist_sync_multiprocess[2-0]",
+    "tests/test_dist.py::test_dist_sync_sharded_servers",
+    "tests/test_serving.py::test_concurrent_clients_correct_and_ordered",
+    "tests/test_serving.py::test_unload_drains_without_dropping",
+]
+
+FULL_TESTS = QUICK_TESTS + [
+    "tests/test_dist.py::test_dist_sync_multiprocess[4-0]",
+    "tests/test_dist.py::test_dist_sync_three_servers_uneven_ranges",
+    "tests/test_dist.py::test_dist_compression_packs_the_wire",
+    "tests/test_serving.py::test_drain_on_shutdown_completes_in_flight",
+    "tests/test_serving.py::test_backpressure_bounded_queue",
+]
+
+
+def _counts(output):
+    counts = {"passed": 0, "failed": 0, "errors": 0}
+    for key, word in (("passed", "passed"), ("failed", "failed"),
+                      ("errors", "errors?")):
+        m = re.search(r"(\d+) %s\b" % word, output)
+        if m:
+            counts[key] = int(m.group(1))
+    return counts
+
+
+def _read_fault_log(path):
+    """Aggregate one schedule's MXNET_FAULTS_LOG (all processes append)."""
+    agg = {"faults": 0, "by_site_kind": {}, "retries": 0, "reconnects": 0}
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    event = json.loads(line)
+                except ValueError:
+                    continue
+                kind = event.get("event")
+                if kind == "fault":
+                    agg["faults"] += 1
+                    key = "%s:%s" % (event.get("site"), event.get("kind"))
+                    agg["by_site_kind"][key] = \
+                        agg["by_site_kind"].get(key, 0) + 1
+                elif kind == "retry":
+                    agg["retries"] += 1
+                elif kind == "reconnect":
+                    agg["reconnects"] += 1
+    except OSError:
+        pass
+    return agg
+
+
+def run_schedule(name, spec, tests, quiet=False):
+    log_fd, log_path = tempfile.mkstemp(prefix="chaos-%s-" % name,
+                                        suffix=".jsonl")
+    os.close(log_fd)
+    env = dict(os.environ, MXNET_FAULTS=spec, MXNET_FAULTS_LOG=log_path,
+               JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep +
+               os.environ.get("PYTHONPATH", ""))
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", "-q", "--tb=line",
+             "-p", "no:cacheprovider"] + tests,
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=1200)
+        rc, output = proc.returncode, proc.stdout + proc.stderr
+    except subprocess.TimeoutExpired as exc:
+        # a hung schedule is a RESULT (the worst one) — record it with
+        # whatever the fault log captured instead of crashing the run
+        rc = -1
+        output = "TIMEOUT after %ds\n%s" % (exc.timeout,
+                                            (exc.stdout or "")[-1200:])
+    result = {
+        "schedule": name,
+        "spec": spec,
+        "rc": rc,
+        **_counts(output),
+        "duration_s": round(time.time() - t0, 1),
+        **_read_fault_log(log_path),
+        "tail": "\n".join(output.strip().splitlines()[-6:])[-1200:],
+    }
+    os.unlink(log_path)
+    if not quiet:
+        print("chaos[%s]: rc=%d passed=%d failed=%d faults=%d retries=%d "
+              "reconnects=%d (%.1fs)" %
+              (name, result["rc"], result["passed"], result["failed"],
+               result["faults"], result["retries"], result["reconnects"],
+               result["duration_s"]), file=sys.stderr)
+    return result
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="run_chaos", description=__doc__)
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--out", default=os.path.join(REPO,
+                                                  "CHAOS_REPORT.json"))
+    args = ap.parse_args(argv)
+    tests = QUICK_TESTS if args.quick else FULL_TESTS
+
+    runs = [run_schedule(name, spec, tests, quiet=args.as_json)
+            for name, spec in SCHEDULES.items()]
+    artifact = {
+        "quick": args.quick,
+        "tests": tests,
+        "schedules": runs,
+        "total_faults": sum(r["faults"] for r in runs),
+        "total_retries": sum(r["retries"] for r in runs),
+        "all_passed": all(r["rc"] == 0 for r in runs),
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=1)
+    if args.as_json:
+        slim = dict(artifact)
+        for r in slim["schedules"]:
+            r.pop("tail", None)
+        print(json.dumps(slim))
+    else:
+        print("chaos: %d schedule(s), %d faults fired, %d retries, "
+              "all_passed=%s -> %s" %
+              (len(runs), artifact["total_faults"],
+               artifact["total_retries"], artifact["all_passed"], args.out))
+    return 0 if artifact["all_passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

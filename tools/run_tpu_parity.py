@@ -112,6 +112,27 @@ def serving_stage():
         return {"error": f"serving stage failed: {exc!r}"}
 
 
+def chaos_stage():
+    """Fault-injection stage: run tools/run_chaos.py --quick in a
+    throwaway process — the tier-1 dist + serving tests under three
+    seeded fault schedules — and attach its JSON artifact (faults fired,
+    retries, reconnects, pass/fail per schedule) to the round, so the
+    resilience layer's recovery claims are checkable evidence next to
+    the parity outcomes."""
+    cmd = [sys.executable, os.path.join(REPO, "tools", "run_chaos.py"),
+           "--quick", "--json", "--out", ""]
+    try:
+        # budget: every schedule may legitimately use run_chaos's full
+        # per-schedule pytest timeout under heavy injected latency
+        out = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                             timeout=3900)
+        summary = json.loads(out.stdout)
+        summary["rc"] = out.returncode
+        return summary
+    except Exception as exc:
+        return {"error": f"chaos stage failed: {exc!r}"}
+
+
 def main():
     rnd = "%02d" % (int(sys.argv[1]) if len(sys.argv) > 1 else next_round())
     t0 = time.time()
@@ -130,6 +151,7 @@ def main():
         "jax": probe_backend(),
         "mxlint": mxlint_stage(),
         "serving": serving_stage(),
+        "chaos": chaos_stage(),
         "cmd": " ".join(cmd[2:]),
         "tests": tests[:500],
         "tail": "\n".join(output.strip().splitlines()[-12:])[-2000:],
