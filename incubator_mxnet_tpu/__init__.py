@@ -47,7 +47,7 @@ for _mod_name, _aliases in [
     ("contrib", ()), ("rnn", ()), ("compat", ()), ("dist", ()),
     ("subgraph", ()), ("storage", ()), ("libinfo", ()),
     ("checkpoint", ()), ("serving", ()), ("resilience", ()),
-    ("kvstore_server", ()), ("native", ()),
+    ("kvstore_server", ()), ("native", ()), ("compile", ()),
 ]:
     try:
         _m = _importlib.import_module("." + _mod_name, __name__)

@@ -87,10 +87,16 @@ def check_source_file(path):
 
 def runtime_report():
     """Everything the runtime trace passes collected so far (host syncs
-    in hot loops, recompilation churn) as one Report."""
+    in hot loops, recompilation churn, program-cache traffic) as one
+    Report."""
     report = Report(target="runtime")
     report.extend(hostsync.findings())
     report.extend(recompile.findings())
+    try:
+        from .. import compile as _compile
+        report.extend(_compile.findings())
+    except Exception:
+        pass
     return report
 
 

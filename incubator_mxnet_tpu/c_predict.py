@@ -19,12 +19,18 @@ __all__ = ["Predictor", "create"]
 
 class Predictor:
     def __init__(self, symbol_json, param_bytes, dev_type, dev_id,
-                 input_shapes):
+                 input_shapes, programs_dir=None):
         from . import context as ctx_mod
         from . import symbol as sym_mod
         from .compat.mxnet_params import load_params
         from .serving.model import ServedModel
 
+        if programs_dir:
+            # pre-compiled program payload (compile/ subsystem): the
+            # first forward loads its executable from disk instead of
+            # paying the XLA compile — the mobile/embedded cold-start fix
+            from . import compile as _compile
+            _compile.add_source(programs_dir)
         ctx = (ctx_mod.cpu(dev_id) if dev_type == 1 else
                ctx_mod.tpu(dev_id))
         self._ctx = ctx
@@ -95,7 +101,9 @@ class Predictor:
 
 
 def create(symbol_json, param_bytes, dev_type, dev_id, input_names,
-           input_shapes):
-    """ABI entry: input_names list[str], input_shapes list[tuple]."""
+           input_shapes, programs_dir=None):
+    """ABI entry: input_names list[str], input_shapes list[tuple];
+    `programs_dir` optionally names a pre-compiled program payload."""
     return Predictor(symbol_json, param_bytes, dev_type, dev_id,
-                     dict(zip(input_names, [tuple(s) for s in input_shapes])))
+                     dict(zip(input_names, [tuple(s) for s in input_shapes])),
+                     programs_dir=programs_dir)

@@ -166,6 +166,28 @@ KNOBS = {
     "MXNET_COMPILATION_CACHE_DIR": (str, "", "honored",
                                     "persistent XLA compilation cache "
                                     "directory (bench.py)"),
+    # -- unified program cache (compile/) ------------------------------------
+    "MXNET_PROGRAM_CACHE": (_BOOL, True, "honored",
+                            "unified program cache (compile/): fused "
+                            "train/inference/CachedOp programs share one "
+                            "per-signature cache with AOT build + stats; "
+                            "0 restores plain per-site jax.jit"),
+    "MXNET_PROGRAM_CACHE_DIR": (str, "", "honored",
+                                "persistent disk tier: XLA serialized "
+                                "executables keyed by graph-hash x shapes "
+                                "x dtypes x donation x device fingerprint "
+                                "(CRC'd, atomic-rename entries); a second "
+                                "process loads instead of recompiling"),
+    "MXNET_PROGRAM_CACHE_LIMIT_MB": (int, 2048, "honored",
+                                     "disk-tier size cap; stalest entries "
+                                     "evicted (LRU by mtime) past it"),
+    "MXNET_PROGRAM_CACHE_CHECKPOINT": (_BOOL, True, "honored",
+                                       "ship a programs/ payload with "
+                                       "elastic checkpoints so resumed "
+                                       "jobs skip XLA compilation "
+                                       "(checkpoint dir gains serialized "
+                                       "executables; resume adds them as "
+                                       "a cache source)"),
     "MXNET_ANALYSIS": (_BOOL, False, "honored",
                        "analysis/: runtime trace passes — per-parameter "
                        "donation tracking, host-sync attribution inside "

@@ -271,6 +271,17 @@ class _TracedCore:
         self._in_tree = in_tree
         self._out_tree = jax.tree_util.tree_structure(out_shape)
         self.out_shape = out_shape   # (inner, step_out) ShapeDtypeStructs
+        self._graph_hash = None
+
+    @property
+    def graph_hash(self):
+        """Stable identity of the traced step for the program cache's
+        disk tier (the jaxpr print with addresses scrubbed — shapes,
+        dtypes, optimizer math and metric set are all in it)."""
+        if self._graph_hash is None:
+            from .compile import graph_hash_of_jaxpr
+            self._graph_hash = graph_hash_of_jaxpr(self._closed)
+        return self._graph_hash
 
     def __call__(self, *args):
         import jax
@@ -341,37 +352,131 @@ def create_states_on_device(opt, indices, weights_raw, ctx):
     return [_state_wrap(v, ctx) for v in vals]
 
 
-def _one_step_jit(traced):
-    """1-step program over a traced core; the inner carry is donated."""
-    import jax
+def _one_step_jit(traced, label=""):
+    """1-step program over a traced core; the inner carry is donated.
+    Compiled through the unified program cache (compile/): a process
+    that traced an identical core loads the executable from the disk
+    tier instead of paying the XLA compile."""
+    from .compile import cached_jit
 
     def step1(inner, x, *extras):
         return traced(inner, x, *extras)
 
-    return jax.jit(step1, donate_argnums=(0,))
+    return cached_jit(step1, donate_argnums=(0,),
+                      graph_key=("step1", traced.graph_hash),
+                      label=label or "fused/step1")
 
 
-def _scan_block_jit(traced):
+def _scan_block_jit(traced, mcarry_index=None, label=""):
     """K-step program: `lax.scan` of the traced core over K stacked
-    per-step inputs.  Returns (new_inner, ys, last): `ys` stacks every
-    step's outputs (so callers can expose batch j's outputs to a batch-j
-    callback), `last` is step K-1's outputs sliced IN-PROGRAM (no extra
-    host dispatch for the common "latest outputs" read)."""
+    per-step inputs.  Returns (new_inner, ys, mys, last): `ys` stacks
+    every step's outputs (so callers can expose batch j's outputs to a
+    batch-j callback), `mys` stacks the metric carry BEFORE each step
+    when `mcarry_index` names its slot in the inner carry (entries
+    C_{-1}..C_{K-2}; together with the final carry that is every
+    per-step metric state — stacked as scan OUTPUTS, i.e. fresh
+    buffers, because the inner carry itself is donated and its entry
+    tuples are dead after the dispatch), and `last` is step K-1's
+    outputs sliced IN-PROGRAM (no extra host dispatch for the common
+    "latest outputs" read)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
+    from .compile import cached_jit
 
     def stepk(inner, xs_list, *extras):
         xs = jax.tree_util.tree_map(lambda *vs: jnp.stack(vs), *xs_list)
 
         def body(inn, x):
-            return traced(inn, x, *extras)
+            new_inn, out = traced(inn, x, *extras)
+            y = (out, inn[mcarry_index]) if mcarry_index is not None \
+                else (out, None)
+            return new_inn, y
 
-        new_inner, ys = lax.scan(body, inner, xs)
+        new_inner, (ys, mys) = lax.scan(body, inner, xs)
         last = jax.tree_util.tree_map(lambda y: y[-1], ys)
-        return new_inner, ys, last
+        return new_inner, ys, mys, last
 
-    return jax.jit(stepk, donate_argnums=(0,))
+    return cached_jit(stepk, donate_argnums=(0,),
+                      graph_key=("scan2", mcarry_index, traced.graph_hash),
+                      label=label or "fused/scan")
+
+
+class _BlockMetricView:
+    """Per-logical-step metric exposure for a K-step fused block.
+
+    A K-step scan applies the whole block before any callback fires, so
+    a batch-j callback would otherwise observe block-FINAL metric totals
+    — and a callback that resets the metric mid-burst (Speedometer
+    auto_reset) would silently lose the rest of the block from its next
+    window.  The scan stacks the metric carry BEFORE every step (`mys`
+    from `_scan_block_jit`: C_{-1}..C_{K-2}, fresh scan outputs — the
+    inner carry's own tuples are donated and dead); with the final
+    carry C_{K-1} that is every per-step state.  `expose(j)` installs
+    batch-j totals before the batch-j callback, reset-aware:
+
+    the visible total must always equal host-materialized state plus the
+    installed device tuple.  `A` tracks the cumulative carry already
+    absorbed into host state (by a `get()` materialize) or discarded (by
+    a `reset()`): an untouched metric gets the cumulative carry C_j - A;
+    a touched one re-bases at the previous step (A = C_{j-1}) so only
+    step j's delta lands on whatever the callback left behind.  All
+    arithmetic is lazy device scalars — no host sync."""
+
+    def __init__(self, metric_objs, prestep_carries, finals):
+        self._metrics = list(metric_objs)
+        self._pre = prestep_carries       # per metric (sum_K, num_K)
+        self._finals = list(finals)       # per metric tuple: C_{K-1}
+        self._k = None if prestep_carries is None else \
+            len(finals) and int(prestep_carries[0][1].shape[0])
+        self._installed = {}              # id(m) -> tuple we set
+        self._absorbed = {}               # id(m) -> A (None = zero)
+
+    def arm(self):
+        """Record the dispatch-time install (block-final totals) so the
+        first `expose` can tell 'untouched' from 'callback consumed'."""
+        for m, f in zip(self._metrics, self._finals):
+            self._installed[id(m)] = f
+
+    def _after(self, mi, j):
+        """Cumulative carry AFTER step j (C_j)."""
+        if j + 1 >= self._k:
+            return self._finals[mi]
+        s_stack, n_stack = self._pre[mi]
+        return (s_stack[j + 1], n_stack[j + 1])
+
+    def _before(self, mi, j):
+        """Cumulative carry BEFORE step j (C_{j-1}; j=0 -> block entry)."""
+        s_stack, n_stack = self._pre[mi]
+        return (s_stack[j], n_stack[j])
+
+    def expose(self, j):
+        if self._pre is None:
+            return
+        for mi, m in enumerate(self._metrics):
+            if m._device_totals is not self._installed.get(id(m)):
+                # a callback materialized (get) or reset the metric —
+                # everything it consumed is accounted for in its host
+                # state; only deltas past that point may land on device.
+                # Mid-burst the consumed value was step j-1's install, so
+                # re-base at C_{j-1}.  BEFORE the first expose the armed
+                # value was the block-FINAL totals: a materialize
+                # absorbed C_{K-1} (host totals nonzero -> re-base
+                # there); a reset discarded everything (host zeroed ->
+                # re-base at block entry)
+                if j > 0:
+                    self._absorbed[id(m)] = self._before(mi, j)
+                elif getattr(m, "num_inst", 0) or \
+                        getattr(m, "sum_metric", 0.0):
+                    self._absorbed[id(m)] = self._finals[mi]
+                else:
+                    self._absorbed[id(m)] = self._before(mi, 0)
+            a = self._absorbed.get(id(m))
+            cur = self._after(mi, j)
+            if a is not None:
+                cur = (cur[0] - a[0], cur[1] - a[1])
+            m._device_totals = cur
+            self._installed[id(m)] = cur
 
 
 # ---------------------------------------------------------------------------
@@ -570,6 +675,7 @@ class FusedTrainStep:
         self._block_outs = None   # scan ys: per-batch outputs of a block
         self.broken = False
         self._carry = None  # steady-state fast-path cache (see _dispatch)
+        self._block_view = None  # per-step metric exposure for bursts
         self._derive_ws = False  # set by _build_core (see _master_positions)
         FusedTrainStep._seq = getattr(FusedTrainStep, "_seq", 0) + 1
         self._audit_key = f"FusedTrainStep#{FusedTrainStep._seq}"
@@ -808,13 +914,16 @@ class FusedTrainStep:
         self._core_closed = _TracedCore(core, example)
 
     def _build1(self):
-        self._jit = _one_step_jit(self._core_closed)
+        self._jit = _one_step_jit(self._core_closed, label=self._audit_key)
 
     def _buildk(self, k):
         # one scan-jit serves every K (xs arity keys the jit's own cache);
-        # the per-K dict entry is the "this block size has run" record
+        # the per-K dict entry is the "this block size has run" record.
+        # mcarry_index=3: the metric accumulator's slot in the inner
+        # carry — the scan stacks it per step for the callback burst
         jitk = self._scan_jit if getattr(self, "_scan_jit", None) is not None \
-            else _scan_block_jit(self._core_closed)
+            else _scan_block_jit(self._core_closed, mcarry_index=3,
+                                 label=self._audit_key)
         self._scan_jit = jitk
         self._jit_block[k] = jitk
         return jitk
@@ -1062,13 +1171,13 @@ class FusedTrainStep:
                         self._build1()
                     new_inner, outs = self._jit(inner, xs[0], fixed,
                                                 rescale_dev)
-                    ys = None
+                    ys = mys = None
                 else:
                     jitk = self._jit_block.get(k)
                     if jitk is None:
                         jitk = self._buildk(k)
-                    new_inner, ys, outs = jitk(inner, tuple(xs), fixed,
-                                               rescale_dev)
+                    new_inner, ys, mys, outs = jitk(inner, tuple(xs), fixed,
+                                                    rescale_dev)
         except Exception as e:
             opt._index_update_count = counts_before
             opt.num_update = num_update_before
@@ -1079,10 +1188,12 @@ class FusedTrainStep:
                 self.broken = True
                 self._carry = None
                 self._t_vec = None
+                self._block_view = None
                 raise
             self.flush()   # pending results from prior steps are intact
             self._carry = None
             self._t_vec = None
+            self._block_view = None
             self.broken = True
             _log.warning("fused train step unavailable (%s); Module.fit "
                          "falls back to forward_backward+update",
@@ -1090,8 +1201,19 @@ class FusedTrainStep:
             return False
 
         new_ws, new_ss, new_aux, new_mcarry, new_key, new_t = new_inner
+        finals = []
         for (fn, m), pend in zip(metric_fns, new_mcarry):
-            m._device_totals = tuple(pend)
+            t = tuple(pend)
+            m._device_totals = t
+            finals.append(t)
+        # per-step metric exposure for the callback burst: batch-j
+        # callbacks must see batch-j metric state, not block-final state
+        if mys is not None:
+            self._block_view = _BlockMetricView(
+                [m for _, m in metric_fns], mys, finals)
+            self._block_view.arm()
+        else:
+            self._block_view = None
         self._key = new_key
         self._t_vec = new_t
         ctx0 = self._contexts[0]
@@ -1172,6 +1294,35 @@ class FusedTrainStep:
             self._prestaged = (data_batch, self._stage_inputs(data))
         except Exception:
             self._prestaged = None
+
+    def set_block_cursor(self, j):
+        """Point `get_outputs()` AND the in-graph metrics at logical
+        step j of the last block — the fit loop calls this as it fires
+        the batch-j callback burst, so each batch-end callback observes
+        per-step state (outputs + metric totals), not block-final
+        state."""
+        self.block_cursor = j
+        if self._block_view is not None:
+            self._block_view.expose(j)
+
+    def cached_programs(self):
+        """The live CachedPrograms this step compiled (current signature
+        plus every cached alternate) — the checkpoint ``programs/``
+        payload's source."""
+        progs = {}
+        for p in (self._jit, getattr(self, "_scan_jit", None)):
+            if p is not None and hasattr(p, "export_to"):
+                progs[id(p)] = p
+        for entry in getattr(self, "_core_cache", {}).values():
+            for p in entry[1:3]:
+                if p is not None and hasattr(p, "export_to"):
+                    progs[id(p)] = p
+        return list(progs.values())
+
+    def export_programs(self, directory):
+        """Serialize this step's compiled executables into `directory`
+        as program-cache entries (checkpoint payload); returns count."""
+        return sum(p.export_to(directory) for p in self.cached_programs())
 
     def current_outputs(self):
         """Outputs of the batch `block_cursor` points at (per-batch view
@@ -1302,6 +1453,7 @@ class FusedInference:
         # so a concurrent dispatch never pairs a rebuilt program with the
         # previous partition's param list (or new params with old aux)
         self._state = None
+        self._graph_hash = None   # lazy symbol-JSON hash (disk-tier key)
         self._key = jax.random.PRNGKey(0)   # inference path draws nothing
         FusedInference._seq = getattr(FusedInference, "_seq", 0) + 1
         self.audit_key = audit_key or f"FusedInference#{FusedInference._seq}"
@@ -1352,7 +1504,7 @@ class FusedInference:
         return self._state[1] if self._state is not None else []
 
     def _build(self, param_names, extra_names):
-        import jax
+        from .compile import cached_jit, graph_hash_of_text
         gfn = self._gfn
         param_pos = {n: k for k, n in enumerate(param_names)}
         input_pos = {n: k for k, n in enumerate(self._input_names)}
@@ -1371,7 +1523,15 @@ class FusedInference:
             outs, _ = gfn(tuple(args), tuple(aux), key)
             return outs
 
-        return jax.jit(run)
+        # symbol JSON (not object identity) keys the disk tier: a fresh
+        # process loading the same graph hits the serialized executables
+        if self._graph_hash is None:
+            self._graph_hash = graph_hash_of_text(self._symbol.tojson())
+        return cached_jit(
+            run,
+            graph_key=("infer", self._graph_hash, tuple(param_names),
+                       tuple(extra_names), tuple(self._input_names)),
+            label=self.audit_key)
 
     def signature(self, inputs):
         """(shape, dtype) per data input — the recompile auditor's
@@ -1382,6 +1542,18 @@ class FusedInference:
         """Compiled programs so far (one per signature)."""
         return self._state[0]._cache_size() if self._state is not None \
             else 0
+
+    def cached_programs(self):
+        """The live CachedProgram behind the current partition."""
+        state = self._state
+        if state is not None and hasattr(state[0], "export_to"):
+            return [state[0]]
+        return []
+
+    def export_programs(self, directory):
+        """Serialize the compiled bucket programs into `directory` as
+        program-cache entries (warmed-image / payload export)."""
+        return sum(p.export_to(directory) for p in self.cached_programs())
 
     def register_warm(self, inputs):
         """Declare `inputs`' signature as an expected bucket BEFORE

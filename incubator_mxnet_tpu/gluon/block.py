@@ -334,10 +334,15 @@ class _CachedGraph:
             return tuple(outs) if len(outs) > 1 else outs[0]
 
         from ..ops.registry import register_opdef
+        from ..compile import graph_hash_of_text
         self.op = register_opdef(OpDef(
             name=f"_cached_op{uid}", fn=op_fn, nin=-1,
             nout=n_out, naux=len(self.aux_names),
-            params={}, mode_dependent=True, needs_rng=n_rng > 0))
+            params={}, mode_dependent=True, needs_rng=n_rng > 0,
+            # symbol-JSON hash (NOT the process-local uid) keys the
+            # unified program cache's disk tier: the same hybridized
+            # block in a fresh process loads its compiled executable
+            cache_key=graph_hash_of_text(symbol.tojson())))
 
     def __call__(self, inputs, param_lookup):
         """inputs: list[NDArray]; param_lookup: name -> NDArray."""

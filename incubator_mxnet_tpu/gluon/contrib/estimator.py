@@ -290,7 +290,12 @@ class Estimator:
                     if len(block) == want and want > 1 and \
                             fused.call_block(block, block[0][0].shape[0]):
                         self._applied_batches = self.batch_idx + len(block)
-                        for _dl in block:
+                        for _bi, _dl in enumerate(block):
+                            # batch-_bi handlers observe batch-_bi metric
+                            # state (per-logical-step semantics), not the
+                            # block-final totals — exposed before
+                            # batch_begin so no handler sees the future
+                            fused.set_block_cursor(_bi)
                             for h in handlers:
                                 h.batch_begin(self)
                             for h in handlers:

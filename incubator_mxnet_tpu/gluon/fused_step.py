@@ -40,7 +40,8 @@ from ..ndarray.ndarray import NDArray
 from .. import autograd as _autograd
 from ..fused import (_apply_traced, _no_rng, _state_data,
                      _state_write_back, _raise_if_unrecoverable,
-                     _TracedCore, _one_step_jit, _scan_block_jit)
+                     _TracedCore, _one_step_jit, _scan_block_jit,
+                     _BlockMetricView)
 
 __all__ = ["GluonFusedStep"]
 
@@ -117,6 +118,7 @@ class GluonFusedStep:
         self.broken = False
         self._carry = None
         self._t_vec = None
+        self._block_view = None   # per-step metric exposure for bursts
         self.last_loss = None
         self.last_outputs = None
         GluonFusedStep._seq = getattr(GluonFusedStep, "_seq", 0) + 1
@@ -192,11 +194,15 @@ class GluonFusedStep:
         self._core_closed = _TracedCore(core, example)
 
     def _build1(self):
-        self._jit = _one_step_jit(self._core_closed)
+        self._jit = _one_step_jit(self._core_closed, label=self._audit_key)
 
     def _buildk(self, k):
+        # mcarry_index=3: the metric accumulator's slot in the gluon
+        # inner carry (ws, auxs, ss, mcarry, t_vec) — stacked per step
+        # so the handler burst can observe per-batch metric state
         jitk = self._scan_jit if getattr(self, "_scan_jit", None) is not None \
-            else _scan_block_jit(self._core_closed)
+            else _scan_block_jit(self._core_closed, mcarry_index=3,
+                                 label=self._audit_key)
         self._scan_jit = jitk
         self._jit_block[k] = jitk
         return jitk
@@ -360,18 +366,21 @@ class GluonFusedStep:
                         self._build1()
                     new_inner, (mean_loss, out) = self._jit(
                         inner, xs[0], rescale_dev)
+                    mys = None
                 else:
                     jitk = self._jit_block.get(k) or self._buildk(k)
                     # ys (all K steps' losses/outputs) are available from
                     # the scan; handlers only read the latest, so expose
-                    # the in-program last slice
-                    new_inner, _ys, (mean_loss, out) = jitk(
+                    # the in-program last slice — mys (per-step metric
+                    # carries) feeds the per-batch handler burst
+                    new_inner, _ys, mys, (mean_loss, out) = jitk(
                         inner, tuple(xs), rescale_dev)
         except Exception as e:
             opt._index_update_count = counts_before
             opt.num_update = num_update_before
             self._carry = None
             self._t_vec = None
+            self._block_view = None
             self.broken = True
             _raise_if_unrecoverable("gluon fused step", e,
                                     self._donation_groups(ws, ss, auxs))
@@ -388,8 +397,17 @@ class GluonFusedStep:
             p._data[0]._set_data(na)
         for s, ns in zip(states, new_ss):
             _state_write_back(s, ns)
+        finals = []
         for m, pend in zip(self._metrics, new_mcarry):
-            m._device_totals = tuple(pend)
+            t = tuple(pend)
+            m._device_totals = t
+            finals.append(t)
+        if mys is not None:
+            # per-step metric exposure for the Estimator handler burst
+            self._block_view = _BlockMetricView(self._metrics, mys, finals)
+            self._block_view.arm()
+        else:
+            self._block_view = None
         self._t_vec = new_t
         self.last_loss = NDArray(mean_loss, ctx=self._ctx)
         self.last_outputs = NDArray(out, ctx=self._ctx)
@@ -403,3 +421,26 @@ class GluonFusedStep:
             self._core_cache[in_sig] = (self._core_closed, self._jit,
                                         self._scan_jit, self._jit_block)
         return True
+
+    def set_block_cursor(self, j):
+        """Expose logical step j's metric state to the Estimator's
+        batch-j handler burst (per-step semantics for K>1 blocks)."""
+        if self._block_view is not None:
+            self._block_view.expose(j)
+
+    def cached_programs(self):
+        """Live CachedPrograms across every cached signature set."""
+        progs = {}
+        for p in (self._jit, getattr(self, "_scan_jit", None)):
+            if p is not None and hasattr(p, "export_to"):
+                progs[id(p)] = p
+        for entry in self._core_cache.values():
+            for p in entry[1:3]:
+                if p is not None and hasattr(p, "export_to"):
+                    progs[id(p)] = p
+        return list(progs.values())
+
+    def export_programs(self, directory):
+        """Serialize compiled executables into `directory` (checkpoint
+        ``programs/`` payload); returns entries written."""
+        return sum(p.export_to(directory) for p in self.cached_programs())

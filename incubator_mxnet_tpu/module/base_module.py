@@ -301,6 +301,15 @@ class BaseModule:
         gstep = 0
         if checkpoint_dir is not None:
             from .. import checkpoint as _ckpt
+            from .. import config as _config
+            if _config.get("MXNET_PROGRAM_CACHE"):
+                # a prior run's programs/ payload: compiled executables
+                # this attempt can load instead of recompiling (the
+                # cold-start half of elastic restart; compile/ subsystem)
+                import os as _os
+                from .. import compile as _compile
+                _compile.add_source(_os.path.join(checkpoint_dir,
+                                                  "programs"))
             if resume:
                 # read-only: the manager (writer, retention, rank layout)
                 # is built AFTER init_optimizer, when the kvstore — and
@@ -608,6 +617,30 @@ class BaseModule:
             meta["optimizer"] = optimizer.state_dict()
         mgr.snapshot(arrays=arrays, blobs=blobs, step=step, epoch=epoch,
                      nbatch=nbatch, sync=sync, meta=meta)
+        self._export_checkpoint_programs(mgr)
+
+    def _export_checkpoint_programs(self, mgr):
+        """Ship the fused step's compiled executables as a ``programs/``
+        payload next to the checkpoints, so a resumed (or freshly
+        served) process loads programs from disk instead of recompiling
+        (compile/ subsystem).  Entries are individually CRC'd and
+        atomically published — a torn payload degrades to a recompile,
+        never to a bad resume — and already-exported entries are
+        skipped, so the steady-state cost is a directory stat."""
+        from .. import config as _config
+        if not _config.get("MXNET_PROGRAM_CACHE") or \
+                not _config.get("MXNET_PROGRAM_CACHE_CHECKPOINT"):
+            return
+        fs = getattr(self, "_fused_step", None)
+        if fs is None or getattr(fs, "broken", False):
+            return
+        import os
+        try:
+            fs.export_programs(os.path.join(mgr.directory, "programs"))
+        except Exception as e:
+            # payload is an optimization, never worth failing a snapshot
+            self.logger.debug("program payload export skipped (%s)",
+                              str(e)[:200])
 
     # -- properties / abstract -------------------------------------------------
     @property

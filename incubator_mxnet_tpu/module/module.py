@@ -388,11 +388,12 @@ class Module(BaseModule):
         return fs is not None and fs.call_block(data_batches, eval_metric)
 
     def _fit_block_cursor(self, j):
-        """Point get_outputs() at batch j of the last block while the fit
-        loop fires that batch's callbacks."""
+        """Point get_outputs() AND the in-graph metric totals at batch j
+        of the last block while the fit loop fires that batch's
+        callbacks (per-logical-step callback semantics for K>1)."""
         fs = self._fused_step
         if fs is not None:
-            fs.block_cursor = j
+            fs.set_block_cursor(j)
 
     # -- forward/backward ------------------------------------------------------
     def prepare(self, data_batch, sparse_row_id_fn=None):

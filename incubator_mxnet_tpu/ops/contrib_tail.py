@@ -344,12 +344,20 @@ def _deformable_psroi_pooling(params, data, rois, *rest):
             + ty * roi_h                                        # (od,ps,ps)
         wstart = start_w + phs.astype(data.dtype)[None, None, :] * bin_w \
             + tx * roi_w
-        iy = (jnp.arange(spp) + 0.5) * sub_h                     # (spp,)
-        ix = (jnp.arange(spp) + 0.5) * sub_w
+        # reference kernel (deformable_psroi_pooling.cu:144) samples at
+        # wstart + i*sub_bin_size — NO half-bin offset; adding one shifts
+        # every sample half a sub-bin and diverges from reference-trained
+        # Deformable R-FCN checkpoints
+        iy = jnp.arange(spp) * sub_h                             # (spp,)
+        ix = jnp.arange(spp) * sub_w
         hh = hstart[..., None, None] + iy[:, None]               # od,ps,ps,spp,1
         ww = wstart[..., None, None] + ix[None, :]
         hh, ww = jnp.broadcast_arrays(hh, ww)                    # od,ps,ps,spp,spp
-        valid = (hh > -0.5) & (hh < H - 0.5) & (ww > -0.5) & (ww < W - 0.5)
+        # reference skips only when h < -0.5 or h > H-0.5: the bounds are
+        # INCLUSIVE (a sample exactly at -0.5 counts), which matters now
+        # that the grid starts at hstart itself
+        valid = (hh >= -0.5) & (hh <= H - 0.5) & \
+            (ww >= -0.5) & (ww <= W - 0.5)
         hc = jnp.clip(hh, 0, H - 1)
         wc = jnp.clip(ww, 0, W - 1)
         img = data[b]                                            # (C,H,W)

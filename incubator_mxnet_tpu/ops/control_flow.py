@@ -75,8 +75,29 @@ def _sub_eval(json_str, train):
 
 
 def _binder(arg_names, arg_map):
-    """Positions of each subgraph argument: (kind, index) per name."""
-    amap = dict(arg_map)
+    """Positions of each subgraph argument: (kind, index) per name.
+
+    `arg_map` entries are emitted in the subgraph's topo order over
+    variable nodes (`symbol/contrib.py _classify_args`) — the SAME order
+    `list_arguments()` yields after the JSON round trip — so binding is
+    POSITIONAL.  Binding through a name->tag dict would collapse two
+    distinct outer Variables that share a name (legal in the symbol API,
+    and common in nested foreach/while_loop bodies reusing inner names)
+    onto one slot, silently computing with the wrong input."""
+    entries = [(n, t) for n, t in arg_map]
+    if len(entries) == len(arg_names) and \
+            all(n == en for n, (en, _t) in zip(arg_names, entries)):
+        return [(t[0], int(t[1:])) for _n, t in entries]
+    # name order disagrees (a hand-edited graph JSON): fall back to
+    # name-keyed binding, refusing ambiguity instead of mis-binding
+    amap = {}
+    for n, t in entries:
+        if n in amap and amap[n] != t:
+            raise MXNetError(
+                f"control-flow subgraph has two inputs named {n!r} with "
+                "different slots and a reordered arg_map; cannot bind "
+                "unambiguously — give loop-body inputs unique names")
+        amap[n] = t
     slots = []
     for n in arg_names:
         tag = amap.get(n)

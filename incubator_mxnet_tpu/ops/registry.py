@@ -70,12 +70,14 @@ class OpDef:
 
     __slots__ = ("name", "fn", "nin", "nout", "naux", "params", "param_types",
                  "needs_rng", "mode_dependent", "stop_grad", "aliases",
-                 "variadic_param", "dynamic_params", "input_names", "doc")
+                 "variadic_param", "dynamic_params", "input_names", "doc",
+                 "cache_key")
 
     def __init__(self, name, fn, nin=1, nout=1, naux=0, params=None,
                  param_types=None, needs_rng=False, mode_dependent=False,
                  stop_grad=False, aliases=(), variadic_param=None,
-                 dynamic_params=(), input_names=None, doc=None):
+                 dynamic_params=(), input_names=None, doc=None,
+                 cache_key=None):
         self.name = name
         self.fn = fn
         self.nin = nin
@@ -98,6 +100,12 @@ class OpDef:
         # Symbol composition, e.g. fc1_weight/fc1_bias)
         self.input_names = input_names
         self.doc = doc or (fn.__doc__ if fn else None)
+        # cache_key: a process-stable graph identity (e.g. a symbol-JSON
+        # hash for CachedOp graphs) routing this op's eager dispatch
+        # through the unified program cache's disk tier; None (all
+        # primitive ops) keeps the plain per-(op, params) jit — tiny
+        # programs that are not worth a disk round trip.
+        self.cache_key = cache_key
 
     # -- parameter handling ---------------------------------------------------
     def canonicalize_params(self, kwargs):
@@ -231,6 +239,15 @@ def _jitted(op_name, frozen_params):
     def run(*arrays):
         return op.fn(params, *arrays)
 
+    if op.cache_key is not None:
+        # whole-graph ops (Gluon CachedOp) compile through the unified
+        # program cache: a fresh process loads the serialized executable
+        # from the disk tier instead of re-paying the XLA compile
+        from ..compile import cached_jit
+        return cached_jit(run,
+                          graph_key=("cachedop", op.cache_key,
+                                     frozen_params),
+                          label="cachedop/" + op_name)
     return jax.jit(run)
 
 

@@ -113,6 +113,13 @@ class ServedModel:
                 raise MXNetError(
                     f"ServedModel: no valid checkpoint under {path!r}")
             path = found
+        # a training run's programs/ payload (compile/ subsystem): the
+        # serialized executables its fused graphs compiled — and, when a
+        # server exported its own warmup, the bucket ladder too — load
+        # from disk here instead of recompiling at warmup
+        from .. import compile as _compile
+        for root in (checkpoint_path, os.path.dirname(path)):
+            _compile.add_source(os.path.join(root, "programs"))
         data = _load(path)
         args, auxs = split_params(data.arrays)
         return cls(sym, args, auxs, **kwargs)
@@ -312,3 +319,10 @@ class ServedModel:
 
     def program_count(self):
         return self._infer.program_count()
+
+    def export_programs(self, directory):
+        """Serialize the compiled bucket ladder into `directory` as
+        program-cache entries — ship them with a checkpoint
+        (``programs/``) or a container image and the next server's
+        `warmup()` performs zero XLA compilations."""
+        return self._infer.export_programs(directory)

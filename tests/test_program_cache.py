@@ -1,0 +1,324 @@
+"""Unified program cache (compile/): disk-tier round trips, corruption
+safety, key discrimination (donation/dtype/graph), concurrent writers,
+AOT warmup, and the checkpoint ``programs/`` payload.
+
+The acceptance story: a SECOND process that builds the same programs
+must perform zero XLA compilations — every executable loads from the
+disk tier (serialized by the first process, CRC'd, atomically
+published)."""
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import compile as mxc
+from incubator_mxnet_tpu.compile import ProgramCache, cached_jit
+from incubator_mxnet_tpu.compile.cache import _unframe
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _entry_files(root):
+    from incubator_mxnet_tpu.compile.cache import FORMAT_VERSION
+    vdir = os.path.join(str(root), "v%d" % FORMAT_VERSION)
+    if not os.path.isdir(vdir):
+        return []
+    return sorted(os.path.join(vdir, f) for f in os.listdir(vdir)
+                  if f.endswith(".xprog"))
+
+
+def _fn(x, y):
+    import jax.numpy as jnp
+    return jnp.tanh(x @ y) + jnp.float32(1.0)
+
+
+def test_disk_round_trip_bit_identical(tmp_path):
+    """Compile once, then reload from disk in a FRESH wrapper (the
+    in-memory tier gone, as after a process restart): zero compiles and
+    bit-identical outputs."""
+    a = np.random.RandomState(0).rand(8, 8).astype("f4")
+    b = np.random.RandomState(1).rand(8, 8).astype("f4")
+
+    c1 = cached_jit(_fn, graph_key="round-trip",
+                    cache=ProgramCache(tmp_path))
+    out1 = np.asarray(c1(a, b))
+    assert c1.compile_count == 1 and c1.disk_hits == 0
+    assert len(_entry_files(tmp_path)) == 1
+
+    cache2 = ProgramCache(tmp_path)      # fresh memory tier
+    c2 = cached_jit(_fn, graph_key="round-trip", cache=cache2)
+    out2 = np.asarray(c2(a, b))
+    assert c2.compile_count == 0, "second build must not compile"
+    assert c2.disk_hits == 1
+    assert cache2.counters["disk_hits"] == 1
+    np.testing.assert_array_equal(out1, out2)   # bit-identical
+
+
+def test_corrupt_and_torn_entries_fall_back(tmp_path):
+    """A bit-flipped or truncated entry fails its CRC, is deleted, and
+    the caller transparently recompiles."""
+    a = np.ones((4, 4), "f4")
+    c1 = cached_jit(_fn, graph_key="corrupt", cache=ProgramCache(tmp_path))
+    want = np.asarray(c1(a, a))
+    (path,) = _entry_files(tmp_path)
+
+    # bit-flip mid-payload
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(blob))
+    cache2 = ProgramCache(tmp_path)
+    c2 = cached_jit(_fn, graph_key="corrupt", cache=cache2)
+    np.testing.assert_array_equal(np.asarray(c2(a, a)), want)
+    assert cache2.counters["corrupt"] == 1
+    assert c2.compile_count == 1          # recompiled
+    assert not os.path.exists(path) or _unframe(
+        open(path, "rb").read()) is not None   # bad entry gone/replaced
+
+    # torn write: truncate the (re-stored) entry
+    (path,) = _entry_files(tmp_path)
+    blob = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(blob[:len(blob) // 3])
+    cache3 = ProgramCache(tmp_path)
+    c3 = cached_jit(_fn, graph_key="corrupt", cache=cache3)
+    np.testing.assert_array_equal(np.asarray(c3(a, a)), want)
+    assert cache3.counters["corrupt"] == 1
+    assert c3.compile_count == 1
+
+
+def test_key_discriminates_donation_dtype_and_graph(tmp_path):
+    """No false hits: donation spec, input dtype, and graph key all feed
+    the entry key — a program compiled without donation (or at another
+    dtype) must never satisfy a donating (or re-dtyped) lookup."""
+    a32 = np.ones((4, 4), "f4")
+
+    cache = ProgramCache(tmp_path)
+    plain = cached_jit(_fn, graph_key="disc", cache=cache)
+    plain(a32, a32)
+    assert len(_entry_files(tmp_path)) == 1
+
+    donating = cached_jit(_fn, donate_argnums=(0,), graph_key="disc",
+                          cache=ProgramCache(tmp_path))
+    import jax
+    donating(jax.numpy.asarray(a32), a32)
+    assert donating.disk_hits == 0 and donating.compile_count == 1
+    assert len(_entry_files(tmp_path)) == 2   # distinct entry
+
+    a16 = np.ones((4, 4), np.float16)
+    redtyped = cached_jit(_fn, graph_key="disc",
+                          cache=ProgramCache(tmp_path))
+    redtyped(a16, a16)
+    assert redtyped.disk_hits == 0 and redtyped.compile_count == 1
+    assert len(_entry_files(tmp_path)) == 3
+
+    other = cached_jit(_fn, graph_key="other-graph",
+                       cache=ProgramCache(tmp_path))
+    other(a32, a32)
+    assert other.disk_hits == 0
+    assert len(_entry_files(tmp_path)) == 4
+
+
+def test_versioned_eviction(tmp_path):
+    """Entries from another device topology / jax version are evicted at
+    load, never deserialized."""
+    a = np.ones((4, 4), "f4")
+    c1 = cached_jit(_fn, graph_key="fp", cache=ProgramCache(tmp_path))
+    c1(a, a)
+    (path,) = _entry_files(tmp_path)
+    raw = open(path, "rb").read()
+    header, payload = _unframe(raw)
+    header["fingerprint"] = "tpu|TPU v9|d4096|p512|jax=99.0"
+    from incubator_mxnet_tpu.compile.cache import _frame
+    with open(path, "wb") as f:
+        f.write(_frame(header, payload))
+
+    cache2 = ProgramCache(tmp_path)
+    c2 = cached_jit(_fn, graph_key="fp", cache=cache2)
+    c2(a, a)
+    assert cache2.counters["evicted"] == 1
+    assert c2.compile_count == 1
+
+
+def test_concurrent_writers_do_not_clobber(tmp_path):
+    """Racing writers of the same key (atomic-rename publication): the
+    surviving entry must be whole and loadable."""
+    a = np.ones((6, 6), "f4")
+    errs = []
+
+    def worker():
+        try:
+            c = cached_jit(_fn, graph_key="race",
+                           cache=ProgramCache(tmp_path))
+            c(a, a)
+        except Exception as e:   # pragma: no cover - the assertion below
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    files = _entry_files(tmp_path)
+    assert len(files) == 1
+    assert _unframe(open(files[0], "rb").read()) is not None
+    # and the published entry round-trips into a working executable
+    cache2 = ProgramCache(tmp_path)
+    c2 = cached_jit(_fn, graph_key="race", cache=cache2)
+    c2(a, a)
+    assert c2.disk_hits == 1 and c2.compile_count == 0
+
+
+def test_export_and_source_payload(tmp_path):
+    """export_to writes standard entries a read-only source can serve
+    (the checkpoint programs/ payload mechanism) — the consumer has NO
+    writable directory and still skips the compile."""
+    a = np.ones((5, 5), "f4")
+    c1 = cached_jit(_fn, graph_key="payload", cache=ProgramCache())
+    c1(a, a)                      # memory-only compile (no disk tier)
+    payload = tmp_path / "programs"
+    assert c1.export_to(payload) == 1
+
+    consumer = ProgramCache(sources=[str(payload)])
+    c2 = cached_jit(_fn, graph_key="payload", cache=consumer)
+    c2(a, a)
+    assert c2.compile_count == 0 and c2.disk_hits == 1
+
+
+def test_second_process_serving_ladder_zero_compiles(tmp_path):
+    """The acceptance gate: a second process warming the same serving
+    bucket ladder performs ZERO XLA compilations, and the recompile
+    auditor records no post-warmup churn (every signature was declared
+    by warmup)."""
+    cache = str(tmp_path / "cache")
+    script = (
+        "import json\n"
+        "from incubator_mxnet_tpu.compile.warmup import selftest\n"
+        "from incubator_mxnet_tpu import analysis\n"
+        "out = selftest(%r)\n"
+        "out['churn_findings'] = len(analysis.recompile.findings())\n"
+        "print(json.dumps(out))\n" % cache)
+    results = []
+    for _ in range(2):
+        r = subprocess.run([sys.executable, "-c", script], cwd=REPO,
+                           capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, r.stderr[-2000:]
+        results.append(json.loads(r.stdout.strip().splitlines()[-1]))
+    cold, warm = results
+    assert cold["compiles"] == len(cold["buckets"])
+    assert cold["churn_findings"] == 0
+    assert warm["compiles"] == 0, warm       # certifiably zero compiles
+    assert warm["disk_hits"] == len(warm["buckets"])
+    assert warm["churn_findings"] == 0
+
+
+def test_checkpoint_programs_payload_and_resume(tmp_path):
+    """Module.fit(checkpoint_dir=) ships a programs/ payload; the resumed
+    process's fused step loads its executable from it (zero compiles).
+    Runs in subprocesses because the memory tier of THIS process would
+    mask the disk hit."""
+    ckpt = str(tmp_path / "ckpt")
+    cache = str(tmp_path / "cache")
+    script = r'''
+import os, sys, json
+os.environ["MXNET_PROGRAM_CACHE_DIR"] = %r
+os.environ["MXNET_FUSED_STEP_BLOCK"] = "4"
+import numpy as np
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import io, compile as mxc
+np.random.seed(0); mx.random.seed(0)
+X = np.random.rand(64, 16).astype("f4")
+Y = np.random.randint(0, 4, 64).astype("f4")
+it = io.NDArrayIter(X, Y, batch_size=8, label_name="softmax_label")
+data = mx.sym.Variable("data")
+out = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+out = mx.sym.Activation(out, act_type="relu")
+out = mx.sym.FullyConnected(out, num_hidden=4, name="fc2")
+out = mx.sym.SoftmaxOutput(out, name="softmax")
+mod = mx.mod.Module(out, label_names=("softmax_label",))
+resume = os.path.isdir(os.path.join(%r, "programs"))
+mod.fit(it, num_epoch=2 if resume else 1, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1}, eval_metric="acc",
+        checkpoint_dir=%r, checkpoint_period=8, resume=resume,
+        kvstore=None)
+assert mod._fused_step is not None and not mod._fused_step.broken
+print(json.dumps(mxc.stats()["counters"]))
+''' % (cache, ckpt, ckpt)
+    counters = []
+    for _ in range(2):
+        r = subprocess.run([sys.executable, "-c", script], cwd=REPO,
+                           capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, r.stderr[-2000:]
+        counters.append(json.loads(r.stdout.strip().splitlines()[-1]))
+    first, resumed = counters
+    assert first["compiles"] >= 1 and first["stores"] >= 1
+    payload = _entry_files(os.path.join(ckpt, "programs"))
+    assert payload, "checkpoint must carry a programs/ payload"
+    for p in payload:
+        assert _unframe(open(p, "rb").read()) is not None
+    assert resumed["compiles"] == 0, resumed   # restart skips XLA entirely
+    assert resumed["disk_hits"] >= 1
+
+
+def test_cache_report_tool(tmp_path):
+    """mxlint --cache-report aggregates the stats sidecar."""
+    a = np.ones((4, 4), "f4")
+    cache = ProgramCache(tmp_path)
+    c = cached_jit(_fn, graph_key="report", label="report-prog",
+                   cache=cache)
+    c(a, a)
+    cache.write_stats()
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "mxlint_cli", os.path.join(REPO, "tools", "mxlint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.cache_report(str(tmp_path), as_json=True) == 0
+
+
+def test_disabled_knob_restores_plain_jit(tmp_path, monkeypatch):
+    """MXNET_PROGRAM_CACHE=0: wrappers degrade to plain jax.jit — no
+    disk traffic, results unchanged."""
+    monkeypatch.setattr(mxc, "_enabled", False)
+    a = np.ones((3, 3), "f4")
+    cache = ProgramCache(tmp_path)
+    c = cached_jit(_fn, graph_key="off", cache=cache)
+    out = np.asarray(c(a, a))
+    np.testing.assert_allclose(out, np.tanh(a @ a) + 1.0, rtol=1e-6)
+    assert not _entry_files(tmp_path)
+    assert cache.counters["compiles"] == 0  # accounting off with the layer
+    assert cache.counters["stores"] == 0
+
+
+def test_corrupt_source_payload_is_repaired_on_export(tmp_path):
+    """A torn entry in a read-only source (checkpoint programs/ payload)
+    cannot be deleted there — but the next export of that key must
+    REWRITE it instead of skipping the existing bad file, or every
+    future consumer pays the compile forever."""
+    a = np.ones((5, 5), "f4")
+    payload = tmp_path / "programs"
+    c1 = cached_jit(_fn, graph_key="repair", cache=ProgramCache())
+    c1(a, a)
+    assert c1.export_to(payload) == 1
+    (path,) = _entry_files(payload)
+    blob = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(blob[:len(blob) // 2])       # torn copy
+
+    consumer = ProgramCache(sources=[str(payload)])
+    c2 = cached_jit(_fn, graph_key="repair", cache=consumer)
+    c2(a, a)
+    assert consumer.counters["corrupt"] == 1
+    assert c2.compile_count == 1             # fell back to compile
+    assert c2.export_to(payload) == 1        # rewrites the bad entry
+
+    fresh = ProgramCache(sources=[str(payload)])
+    c3 = cached_jit(_fn, graph_key="repair", cache=fresh)
+    c3(a, a)
+    assert c3.compile_count == 0 and c3.disk_hits == 1   # repaired

@@ -70,10 +70,74 @@ def _parse_shapes(items):
     return shapes
 
 
+def cache_report(cache_dir, as_json=False):
+    """Program-cache report over a cache directory's ``stats.json``
+    (written by the compile/ subsystem at process exit and by the
+    warmup CLI): aggregate hit rates across recorded runs, per-program
+    compile counts, and compiles attributed to churned signatures — a
+    program compiled under more than one distinct signature paid a full
+    XLA compile for each one, which is the shape-churn cost the
+    recompile auditor diagnoses at runtime."""
+    stats_path = os.path.join(cache_dir, "stats.json")
+    try:
+        with open(stats_path) as f:
+            runs = json.load(f).get("runs", [])
+    except (OSError, ValueError) as e:
+        print(f"mxlint: no readable stats at {stats_path} ({e})",
+              file=sys.stderr)
+        return 1
+    total = {"compiles": 0, "disk_hits": 0, "mem_hits": 0, "stores": 0,
+             "corrupt": 0, "evicted": 0}
+    by_label = {}
+    sigs_by_label = {}
+    for run in runs:
+        for k in total:
+            total[k] += run.get("counters", {}).get(k, 0)
+        for ev in run.get("events", []):
+            lab = ev.get("label", "?")
+            by_label[lab] = by_label.get(lab, 0) + 1
+            sigs_by_label.setdefault(lab, set()).add(ev.get("signature"))
+    lookups = total["compiles"] + total["disk_hits"] + total["mem_hits"]
+    churned = {lab: {"compiles": n,
+                     "distinct_signatures": len(sigs_by_label[lab])}
+               for lab, n in by_label.items()
+               if len(sigs_by_label.get(lab, ())) > 1}
+    report = {
+        "runs": len(runs),
+        **total,
+        "hit_rate": round((total["disk_hits"] + total["mem_hits"]) /
+                          lookups, 4) if lookups else None,
+        "compiles_by_program": dict(sorted(by_label.items(),
+                                           key=lambda kv: -kv[1])[:50]),
+        "churned_signature_programs": churned,
+    }
+    if as_json:
+        print(json.dumps(report, indent=1))
+    else:
+        print("program cache report (%d run(s)): %d compiles, %d disk "
+              "hits, %d memory hits, hit rate %s"
+              % (report["runs"], total["compiles"], total["disk_hits"],
+                 total["mem_hits"],
+                 "n/a" if report["hit_rate"] is None
+                 else "%.1f%%" % (100 * report["hit_rate"])))
+        if total["corrupt"] or total["evicted"]:
+            print("  %d corrupt entries dropped, %d evicted"
+                  % (total["corrupt"], total["evicted"]))
+        for lab, n in sorted(by_label.items(), key=lambda kv: -kv[1]):
+            mark = ""
+            if lab in churned:
+                mark = "  <- %d distinct signatures, one full XLA " \
+                    "compile each (declared buckets or shape churn; " \
+                    "MXNET_ANALYSIS=1 runtime report separates them)" \
+                    % churned[lab]["distinct_signatures"]
+            print("  %4d compile(s)  %s%s" % (n, lab, mark))
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="mxlint", description=__doc__,
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
-    ap.add_argument("paths", nargs="+")
+    ap.add_argument("paths", nargs="*")
     ap.add_argument("--hints", action="store_true",
                     help="include perf hints (tpu-layout)")
     ap.add_argument("--shape", action="append", default=[],
@@ -81,7 +145,15 @@ def main(argv=None):
     ap.add_argument("--suppress", default="",
                     metavar="CODE[,CODE...]")
     ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--cache-report", metavar="CACHE_DIR",
+                    help="report program-cache hit rates and churn-"
+                         "attributed compiles from CACHE_DIR/stats.json")
     args = ap.parse_args(argv)
+
+    if args.cache_report:
+        return cache_report(args.cache_report, as_json=args.as_json)
+    if not args.paths:
+        ap.error("paths required (or --cache-report DIR)")
 
     from incubator_mxnet_tpu import analysis
     shapes = _parse_shapes(args.shape)

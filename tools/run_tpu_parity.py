@@ -133,6 +133,22 @@ def chaos_stage():
         return {"error": f"chaos stage failed: {exc!r}"}
 
 
+def coldstart_stage():
+    """Cold-start stage: the warmup CLI's built-in probe, run cold then
+    warm in fresh subprocesses (tools/warmup.py coldstart_probe) — the
+    second process must load every executable from the disk tier (zero
+    compiles).  The artifact records cold vs warm compile_s and the
+    warm/cold ratio, so program-cache regressions (a key that stops
+    matching across processes, a serialization break) become checkable
+    evidence next to the parity outcomes."""
+    try:
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        from warmup import coldstart_probe
+        return coldstart_probe()
+    except Exception as exc:
+        return {"error": f"coldstart stage failed: {exc!r}"}
+
+
 def main():
     rnd = "%02d" % (int(sys.argv[1]) if len(sys.argv) > 1 else next_round())
     t0 = time.time()
@@ -152,6 +168,7 @@ def main():
         "mxlint": mxlint_stage(),
         "serving": serving_stage(),
         "chaos": chaos_stage(),
+        "coldstart": coldstart_stage(),
         "cmd": " ".join(cmd[2:]),
         "tests": tests[:500],
         "tail": "\n".join(output.strip().splitlines()[-12:])[-2000:],

@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""AOT warmup CLI for the unified program cache (compile/ subsystem).
+
+Compile a model's program set ahead of traffic and persist the XLA
+executables into the on-disk program cache, so the NEXT process — a
+serving replica, a resumed training job, a c_predict embedder — loads
+compiled programs instead of paying the 28–105 s cold-start compile.
+
+Usage:
+
+  # warm one model's bucket ladder into a cache dir
+  python tools/warmup.py --cache-dir /var/cache/mxnet-programs \\
+      --symbol model-symbol.json --params model-0000.params \\
+      --data-shape data:1,3,224,224 --buckets 1,2,4,8,16,32
+
+  # drive a whole manifest (several models + program payload dirs)
+  python tools/warmup.py --cache-dir DIR --manifest warmup.json
+
+  # write the manifest for later instead of (only) warming now
+  python tools/warmup.py ... --emit-manifest warmup.json
+
+  # built-in cold-start probe (run twice: cold then warm)
+  python tools/warmup.py --cache-dir DIR --selftest --json
+
+Parameters are optional: the compiled program depends on shapes only,
+so zeros at the inferred parameter shapes produce the identical
+executable production weights will load.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def coldstart_probe(timeout=600):
+    """Run the built-in warmup selftest TWICE in fresh subprocesses
+    against a throwaway cache dir: the first pays the XLA compiles, the
+    second must load every executable from the disk tier.  Returns
+    {cold_compile_s, warm_compile_s, *_compiles, *_disk_hits,
+    warm_cold_ratio, zero_compile_warm_start} or {"error": ...}.
+
+    Shared by bench.py's coldstart lane and run_tpu_parity.py's
+    coldstart stage.  Each phase is its OWN process, so the caller must
+    not be holding an exclusively-locked accelerator (on TPU, run this
+    before the parent initializes jax — libtpu locks the chip)."""
+    import json as _json
+    import shutil
+    import subprocess
+    import tempfile
+    cache = tempfile.mkdtemp(prefix="mxnet-coldstart-")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--cache-dir", cache, "--selftest", "--json"]
+    out = {}
+    try:
+        for phase in ("cold", "warm"):
+            r = subprocess.run(cmd, cwd=repo, capture_output=True,
+                               text=True, timeout=timeout)
+            if r.returncode != 0:
+                return {"error": "%s warmup rc=%d" % (phase, r.returncode),
+                        "tail": r.stderr.strip()[-500:]}
+            d = _json.loads(r.stdout.strip().splitlines()[-1])
+            out[phase + "_compile_s"] = d["compile_s"]
+            out[phase + "_compiles"] = d["compiles"]
+            out[phase + "_disk_hits"] = d["disk_hits"]
+        if out["cold_compile_s"]:
+            out["warm_cold_ratio"] = round(
+                out["warm_compile_s"] / out["cold_compile_s"], 3)
+        out["zero_compile_warm_start"] = out["warm_compiles"] == 0 and \
+            out["warm_disk_hits"] > 0
+        return out
+    except Exception as exc:
+        return {"error": f"coldstart probe failed: {exc!r}"}
+    finally:
+        shutil.rmtree(cache, ignore_errors=True)
+
+
+def _parse_shape(spec):
+    name, _, dims = spec.partition(":")
+    if not dims:
+        raise SystemExit(f"--data-shape {spec!r}: expected name:d0,d1,...")
+    return [name, [int(d) for d in dims.split(",")]]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--cache-dir", required=True,
+                    help="program cache directory (the disk tier; also "
+                         "settable via MXNET_PROGRAM_CACHE_DIR)")
+    ap.add_argument("--manifest", help="warmup manifest JSON to drive")
+    ap.add_argument("--symbol", help="model symbol JSON file")
+    ap.add_argument("--params", help="model .params file (optional: "
+                                     "zeros at inferred shapes otherwise)")
+    ap.add_argument("--data-shape", action="append", default=[],
+                    metavar="name:d0,d1,...",
+                    help="request input shape (repeatable); d0 is the "
+                         "batch axis the buckets replace")
+    ap.add_argument("--buckets", default="1,2,4,8,16,32",
+                    help="batch-size ladder to compile")
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--name", default="model")
+    ap.add_argument("--emit-manifest", metavar="PATH",
+                    help="also write the equivalent manifest JSON")
+    ap.add_argument("--selftest", action="store_true",
+                    help="warm the built-in probe model (cold/warm "
+                         "compile-time measurement)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="print the summary as one JSON line")
+    args = ap.parse_args(argv)
+
+    from incubator_mxnet_tpu import compile as mxc
+
+    if args.selftest:
+        summary = mxc.warmup.selftest(args.cache_dir)
+    elif args.manifest:
+        summary = mxc.warm(args.manifest, cache_dir=args.cache_dir)
+    else:
+        if not (args.symbol and args.data_shape):
+            ap.error("need --manifest, --selftest, or --symbol with "
+                     "--data-shape")
+        manifest = {
+            "version": mxc.warmup.MANIFEST_VERSION,
+            "models": [{
+                "name": args.name,
+                "symbol": os.path.abspath(args.symbol),
+                "params": os.path.abspath(args.params) if args.params
+                else None,
+                "data_shapes": [_parse_shape(s) for s in args.data_shape],
+                "buckets": [int(b) for b in args.buckets.split(",")],
+                "dtype": args.dtype,
+            }],
+        }
+        if args.emit_manifest:
+            mxc.write_manifest(args.emit_manifest, manifest["models"])
+        summary = mxc.warm(manifest, cache_dir=args.cache_dir)
+
+    if args.as_json:
+        print(json.dumps(summary))
+    else:
+        print("warmed: %d compiles, %d disk hits, %.2fs"
+              % (summary.get("compiles", 0), summary.get("disk_hits", 0),
+                 summary.get("compile_s", 0.0)))
+        for m in summary.get("models", []):
+            print("  %(name)s buckets=%(buckets)s compiles=%(compiles)d "
+                  "disk_hits=%(disk_hits)d %(compile_s).2fs" % m)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
