@@ -87,8 +87,8 @@ def check_source_file(path):
 
 def runtime_report():
     """Everything the runtime trace passes collected so far (host syncs
-    in hot loops, recompilation churn, program-cache traffic) as one
-    Report."""
+    in hot loops, recompilation churn, program-cache traffic, supervisor
+    straggler/host-loss events) as one Report."""
     report = Report(target="runtime")
     report.extend(hostsync.findings())
     report.extend(recompile.findings())
@@ -97,9 +97,19 @@ def runtime_report():
         report.extend(_compile.findings())
     except Exception:
         pass
+    try:
+        from ..resilience import supervisor as _supervisor
+        report.extend(_supervisor.findings())
+    except Exception:
+        pass
     return report
 
 
 def reset_runtime():
     hostsync.reset()
     recompile.reset()
+    try:
+        from ..resilience import supervisor as _supervisor
+        _supervisor.reset_findings()
+    except Exception:
+        pass

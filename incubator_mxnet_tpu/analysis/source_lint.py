@@ -24,6 +24,15 @@ walking a script's AST:
   `MXNetError` — including structured failover signals like
   `ServerLostError` — and the training script keeps "running" on a dead
   cluster.
+* ``unsupervised-collective`` — a host-level cross-host collective
+  dispatch (`collectives.all_reduce` / `all_gather` / `reduce_scatter` /
+  `ppermute` / a collective plane's `allreduce`) outside a supervisor/
+  watchdog scope: on a pod, one lost host hangs that call forever with
+  no error.  Wrap it with `parallel.collectives.supervised(...)`, run it
+  under a `JobSupervisor`, or put it in a ``with``-scope whose manager
+  names the supervisor/watchdog.  In-graph uses (inside a
+  jit/pjit/shard_map-decorated function) are XLA's business and are not
+  flagged.
 
 Suppression: append ``# mxlint: disable`` (everything on the line) or
 ``# mxlint: disable=<code>[,<code>...]`` to the offending line.
@@ -43,12 +52,31 @@ _KV_KEYWORDS = {"kvstore", "kv_store"}
 _KV_SINKS = {"fit", "init_optimizer", "Trainer", "create"}
 _RETRY_CALLS = {"connect", "create_connection", "request", "recv_msg",
                 "send_msg", "urlopen"}
+# the host-level cross-host collective verbs (parallel.collectives API +
+# the kvstore collective plane's methods); a lost host hangs any of them
+# forever when dispatched outside a watchdog scope
+_COLLECTIVE_CALLS = {"all_reduce", "all_gather", "reduce_scatter",
+                     "ppermute", "psum_scatter", "allreduce",
+                     "allreduce_many"}
+# decorators marking device code, where collectives are XLA-scheduled
+_DEVICE_DECORATORS = {"jit", "pjit", "pmap", "shard_map", "custom_vjp"}
+# identifiers that mark a with-scope (or wrapper call) as supervised.
+# Token-wise on word boundaries (snake_case AND camelCase): "supervised",
+# "JobSupervisor", "watchdog" qualify; "unsupervised"/"run_unsupervised"
+# must NOT — a name that says it is not supervised cannot silence the lint
+_NAME_TOKEN_RE = re.compile(r"[A-Za-z][a-z]*")
+
+
+def _supervised_name(ident):
+    return any(tok.lower().startswith(("supervis", "watchdog"))
+               for tok in _NAME_TOKEN_RE.findall(ident))
 _DISABLE_RE = re.compile(r"#\s*mxlint:\s*disable(?:=([\w\-, ]+))?")
 
 _PASS_BY_CODE = {"host-sync-in-loop": "source.hostsync",
                  "kvstore-local-on-tpu": "source.kvstore",
                  "unbounded-retry": "source.retry",
-                 "bare-except": "source.except"}
+                 "bare-except": "source.except",
+                 "unsupervised-collective": "source.supervisor"}
 
 
 def _suppressed(lines, lineno, code):
@@ -70,6 +98,8 @@ class _Visitor(ast.NodeVisitor):
         self.findings = []
         self.uses_tpu = False
         self.kv_local_sites = []   # (lineno, sink name)
+        self.supervised_depth = 0  # inside a supervisor/watchdog `with`
+        self.device_depth = 0      # inside a jit/pjit/shard_map function
 
     # -- loops ---------------------------------------------------------------
     def _loop(self, node):
@@ -154,10 +184,43 @@ class _Visitor(ast.NodeVisitor):
     # definition site; reset the loop context for their bodies
     def _fresh_scope(self, node):
         saved, self.loop_depth = self.loop_depth, 0
+        device = any(
+            _DEVICE_DECORATORS & self._idents(d)
+            for d in getattr(node, "decorator_list", ()))
+        if device:
+            self.device_depth += 1
         self.generic_visit(node)
+        if device:
+            self.device_depth -= 1
         self.loop_depth = saved
 
     visit_FunctionDef = visit_AsyncFunctionDef = visit_Lambda = _fresh_scope
+
+    @staticmethod
+    def _idents(node):
+        """Every Name/Attribute identifier inside `node` (decorator or
+        with-item expressions — 'does this expression mention X?')."""
+        out = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name):
+                out.add(sub.id)
+            elif isinstance(sub, ast.Attribute):
+                out.add(sub.attr)
+        return out
+
+    # -- supervised scopes ---------------------------------------------------
+    def _visit_with(self, node):
+        supervised = any(
+            any(_supervised_name(ident) for ident in
+                self._idents(item.context_expr))
+            for item in node.items)
+        if supervised:
+            self.supervised_depth += 1
+        self.generic_visit(node)
+        if supervised:
+            self.supervised_depth -= 1
+
+    visit_With = visit_AsyncWith = _visit_with
 
     # -- calls ---------------------------------------------------------------
     def _add(self, code, lineno, message):
@@ -192,6 +255,21 @@ class _Visitor(ast.NodeVisitor):
                         isinstance(kw.value, ast.Constant) and \
                         kw.value.value == "local":
                     self.kv_local_sites.append((node.lineno, name))
+        if name in _COLLECTIVE_CALLS and isinstance(func, ast.Attribute) \
+                and self.supervised_depth == 0 and self.device_depth == 0:
+            self._add("unsupervised-collective", node.lineno,
+                      f"cross-host collective .{name}() dispatched outside "
+                      "a supervisor/watchdog scope: one lost host hangs it "
+                      "forever with no error — wrap it with "
+                      "parallel.collectives.supervised(...) or run under "
+                      "a resilience.JobSupervisor")
+        if name is not None and _supervised_name(name):
+            # arguments of supervised(...)/watchdog wrappers ARE the
+            # supervised scope (the lambda handed to the watchdog)
+            self.supervised_depth += 1
+            self.generic_visit(node)
+            self.supervised_depth -= 1
+            return
         self.generic_visit(node)
 
 

@@ -151,8 +151,42 @@ KNOBS = {
                                       "probe window"),
     "MXNET_FIT_MAX_RESTARTS": (int, 2, "honored",
                                "Module.fit auto-restarts from the last "
-                               "checkpoint after ServerLostError at most "
-                               "this many times"),
+                               "checkpoint after ServerLostError or "
+                               "CollectiveTimeoutError at most this many "
+                               "times"),
+    # -- elastic multi-host supervisor (resilience/supervisor.py) -----------
+    "MXNET_SUPERVISOR": (_BOOL, True, "honored",
+                         "JobSupervisor around multi-worker Module.fit: "
+                         "heartbeat/membership, hung-collective watchdog, "
+                         "straggler detection, shrink-and-resume"),
+    "MXNET_SUPERVISOR_HEARTBEAT_S": (float, 2.0, "honored",
+                                     "heartbeat interval to the pod "
+                                     "coordinator (the root parameter "
+                                     "server)"),
+    "MXNET_SUPERVISOR_DEADLINE_S": (float, 10.0, "honored",
+                                    "heartbeat silence before a host is "
+                                    "declared dead in the membership "
+                                    "view"),
+    "MXNET_SUPERVISOR_COLLECTIVE_TIMEOUT_S": (float, 120.0, "honored",
+                                              "watchdog deadline turning "
+                                              "a hung cross-host "
+                                              "collective into a "
+                                              "CollectiveTimeoutError "
+                                              "naming the absent hosts"),
+    "MXNET_SUPERVISOR_STRAGGLER_K": (float, 3.0, "honored",
+                                     "k-sigma divergence of a host's "
+                                     "step-time EWMA from the pod median "
+                                     "flagged as a straggler finding"),
+    "MXNET_SUPERVISOR_SHRINK_BARRIER_S": (float, 30.0, "honored",
+                                          "deadline of the epoch-fenced "
+                                          "shrink barrier (survivors "
+                                          "agreeing on the new world "
+                                          "size)"),
+    "MXNET_SUPERVISOR_EPOCH": (int, 0, "honored",
+                               "membership epoch a (re)starting worker "
+                               "registers at — set by the shrink-and-"
+                               "resume path, not by hand; a stale epoch "
+                               "is fenced out by the coordinator"),
     "MXNET_INTERNAL_CONV_LAYOUT": (str, "NCHW", "honored",
                                    "NHWC internal conv/pool/BN execution "
                                    "(ops/layout.py; measured ~parity on "

@@ -10,20 +10,34 @@ sharded train step itself comes from `incubator_mxnet_tpu.parallel`.
 Env: DMLC_PS_ROOT_URI/PORT double as the JAX coordinator address when
 JAX_COORDINATOR_ADDRESS is unset, so one launcher config drives both the
 socket control plane and the XLA data plane.
+
+Elasticity: the group is no longer set-once.  `shutdown()` tears it down
+and a later `init_process_group` re-initializes at a (possibly smaller)
+world size — the shrink-and-resume path after a host loss, where the
+survivors re-form the process group at the new world size before
+`parallel.mesh.rebuild()` re-derives the dp mesh.  `init_process_group`
+returns the ACTUAL ``(coordinator, world_size, rank)`` tuple so the
+supervisor and tests can assert on what was joined, not just that
+something was.
 """
 from __future__ import annotations
 
 import os
 
-_initialized = False
+# the live group: None when no group is initialized; otherwise the
+# (coordinator, world_size, rank) tuple init_process_group returned
+_group = None
 
 
 def init_process_group(coordinator=None, num_processes=None, process_id=None):
-    """Idempotent `jax.distributed.initialize` from the dmlc-style env."""
-    global _initialized
-    if _initialized:
-        return True
-    import jax
+    """Idempotent `jax.distributed.initialize` from the dmlc-style env.
+
+    Returns the ``(coordinator, world_size, rank)`` tuple actually joined
+    (while a group is live, the EXISTING group's tuple — call `shutdown`
+    first to re-init at a different world size)."""
+    global _group
+    if _group is not None:
+        return _group
     coordinator = coordinator or os.environ.get(
         "JAX_COORDINATOR_ADDRESS",
         "%s:%s" % (os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
@@ -33,22 +47,42 @@ def init_process_group(coordinator=None, num_processes=None, process_id=None):
     process_id = int(process_id if process_id is not None
                      else os.environ.get("DMLC_RANK", 0))
     if num_processes <= 1:
-        _initialized = True
-        return True
+        # single process: nothing to bootstrap, but identity is still real
+        _group = (coordinator, 1, 0)
+        return _group
+    import jax
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=num_processes,
                                process_id=process_id)
-    _initialized = True
-    return True
+    _group = (coordinator, num_processes, process_id)
+    return _group
 
 
-def finalize():
-    global _initialized
-    if not _initialized:
+def initialized():
+    """Whether a process group is currently live."""
+    return _group is not None
+
+
+def group():
+    """The live group's (coordinator, world_size, rank), or None."""
+    return _group
+
+
+def shutdown():
+    """Tear the process group down so a new one can form — the epoch
+    boundary of shrink-and-resume (survivors re-init at the smaller world
+    size, typically against an epoch-specific coordinator port)."""
+    global _group
+    if _group is None:
         return
-    import jax
-    try:
-        jax.distributed.shutdown()
-    except Exception:
-        pass
-    _initialized = False
+    if _group[1] > 1:
+        import jax
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+    _group = None
+
+
+# historical name (pre-elastic); shutdown() is the re-init-capable spelling
+finalize = shutdown

@@ -30,9 +30,14 @@ class KVStoreDist(KVStoreTPU):
         port = int(os.environ.get("DMLC_PS_ROOT_PORT", 9091))
         self._chan = Channel(host, port)
         env_rank = os.environ.get("DMLC_RANK")
-        reply = self._chan.request(
-            {"cmd": "register", "role": "worker",
-             "rank": int(env_rank) if env_rank is not None else None})
+        from .. import config as _config
+        # membership epoch fence: a worker restarted by shrink-and-resume
+        # carries the post-shrink epoch (MXNET_SUPERVISOR_EPOCH); a stale
+        # host registering with an old epoch is refused by the server
+        self._epoch = int(_config.get("MXNET_SUPERVISOR_EPOCH"))
+        reply = _check(self._chan.request(
+            {"cmd": "register", "role": "worker", "epoch": self._epoch,
+             "rank": int(env_rank) if env_rank is not None else None}))
         self._rank = reply["rank"]
         self._num_workers = reply["num_workers"]
         # key-range sharding over N servers (reference kvstore_dist.h:44 +
@@ -60,9 +65,9 @@ class KVStoreDist(KVStoreTPU):
         # SAME rank) before the retried request is resent
         rank = self._rank
 
-        def _rehandshake(chan, _rank=rank):
+        def _rehandshake(chan, _rank=rank, _epoch=self._epoch):
             chan.bare_request({"cmd": "register", "role": "worker",
-                               "rank": _rank})
+                               "rank": _rank, "epoch": _epoch})
         self._chan.on_reconnect = _rehandshake
         self._bigarray_bound = int(_config.get(
             "MXNET_KVSTORE_BIGARRAY_BOUND"))
@@ -147,6 +152,16 @@ class KVStoreDist(KVStoreTPU):
                              cmd=msg.get("cmd"))
         if "error" in reply:
             err = reply["error"]
+            if "epoch fenced" in err:
+                # a shrink committed while this request waited (our own
+                # watchdog had not fired yet): surface the recoverable
+                # signal, not a generic error — fit's restart loop then
+                # drives this worker through the shrink/fence path
+                from ..resilience.supervisor import CollectiveTimeoutError
+                breaker.record_success()   # the server is alive and sane
+                raise CollectiveTimeoutError(
+                    f"kvstore.{msg.get('cmd')}", axis="workers",
+                    detail=err)
             k = msg.get("key")
             if "has not been initialized" in err and k is not None \
                     and k in self._store:
@@ -162,6 +177,32 @@ class KVStoreDist(KVStoreTPU):
             raise MXNetError(err)
         breaker.record_success()
         return reply
+
+    def _supervised(self, name, fn):
+        """Route a blocking cross-host exchange through the active
+        `JobSupervisor`'s hung-collective watchdog (plain call when no
+        supervisor is active).  A sync push/pull that a dead host's
+        missing contribution can stall forever becomes a structured
+        `CollectiveTimeoutError` naming the absent hosts instead."""
+        from ..resilience.supervisor import supervised
+        return supervised(name, fn, axis="workers")
+
+    def stats(self):
+        """PR 5 retry/failover counters, one dict — exported through
+        `JobSupervisor.stats()` into the chaos / run_tpu_parity
+        artifacts: per-channel idempotent resends, stale replies
+        discarded by sequence number, and every per-server breaker's
+        state."""
+        return {
+            "resends": sum(c.resends for c in self._chans),
+            "discarded_stale": sum(c.discarded_stale for c in self._chans),
+            "breakers": [
+                {"server": i, "addr": f"{c.host}:{c.port}",
+                 "state": b.state,
+                 "consecutive_failures": b.consecutive_failures}
+                for i, (c, b) in enumerate(zip(self._chans,
+                                               self._breakers))],
+        }
 
     def _keys_on(self, srv):
         """Keys whose shard routing places a range on server `srv`
@@ -354,15 +395,22 @@ class KVStoreDist(KVStoreTPU):
         keys, values = _normalize_push(key, value)
         if self._collective is not None:
             if len(keys) > 1:
-                self._collective_push_batch(keys, values)
+                self._supervised(
+                    "kvstore.push",
+                    lambda: self._collective_push_batch(keys, values))
                 return
-            for k, vals in zip(keys, values):
-                sk = _key(k)
-                if sk not in self._store:
-                    raise MXNetError(f"Key {k} has not been initialized")
-                self._collective_push(sk, vals)
+
+            def _push_each():
+                for k, vals in zip(keys, values):
+                    sk = _key(k)
+                    if sk not in self._store:
+                        raise MXNetError(
+                            f"Key {k} has not been initialized")
+                    self._collective_push(sk, vals)
+            self._supervised("kvstore.push", _push_each)
             return
-        self._socket_push(keys, values)
+        self._supervised("kvstore.push",
+                         lambda: self._socket_push(keys, values))
 
     def _collective_push_batch(self, keys, values):
         """Batched sync push: local reduce per key, then ONE fused global
@@ -429,6 +477,13 @@ class KVStoreDist(KVStoreTPU):
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         if out is None:
             raise MXNetError("pull requires out=")
+        # the sync pull is the step's rendezvous: it waits for every
+        # worker's round contribution, so a dead host stalls it — run it
+        # under the supervisor watchdog when one is active
+        self._supervised("kvstore.pull",
+                         lambda: self._pull_impl(key, out, ignore_sparse))
+
+    def _pull_impl(self, key, out, ignore_sparse=True):
         keys, outs = _normalize_push(key, out)
         if self._collective is not None:
             # the all-reduce left an identical fresh value on every worker;
@@ -491,7 +546,9 @@ class KVStoreDist(KVStoreTPU):
         self._barrier()
 
     def _barrier(self):
-        _check(self._chan.request({"cmd": "barrier"}))
+        self._supervised(
+            "kvstore.barrier",
+            lambda: _check(self._chan.request({"cmd": "barrier"})))
 
     def close(self, send_stop=True):
         """Close every server channel.  ``send_stop=False`` skips the

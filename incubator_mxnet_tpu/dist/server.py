@@ -29,12 +29,16 @@ import threading
 
 import numpy as np
 
+from .membership import MembershipTable
 from .transport import recv_msg, send_msg
 from ..resilience import faults as _faults
 
 # idempotent reads: re-executing a resend is safe and cheaper than
-# caching replies that can carry whole key-range arrays
-_READ_CMDS = frozenset({"pull", "server_list", "get_optimizer_states"})
+# caching replies that can carry whole key-range arrays ("hb" and
+# "members" are idempotent too — a re-executed heartbeat just refreshes
+# the same liveness timestamp)
+_READ_CMDS = frozenset({"pull", "server_list", "get_optimizer_states",
+                        "hb", "members"})
 
 
 class _State:
@@ -65,6 +69,12 @@ class _State:
         # keyed by the PAIR: a reconnect's re-handshake (same client,
         # new seq) must not clobber a still-executing request's marker
         self.crashed = False       # fault-injected crash: refuse everything
+        # elastic membership (the root server doubles as the pod
+        # coordinator): built lazily on the first hb/shrink so plain
+        # non-supervised runs never pay for it.  `epoch` mirrors the
+        # table's epoch for cheap fencing inside kvstore waits.
+        self.membership = None
+        self.epoch = 0
 
 
 class ParameterServer:
@@ -229,17 +239,84 @@ class ParameterServer:
             reply["seq"] = seq
         return reply
 
+    def _membership(self):
+        """The pod membership table (root server = coordinator), built on
+        first use with the configured heartbeat deadline."""
+        st = self._state
+        with st.cond:
+            if st.membership is None:
+                from .. import config as _config
+                st.membership = MembershipTable(
+                    st.num_workers,
+                    deadline_s=float(
+                        _config.get("MXNET_SUPERVISOR_DEADLINE_S")))
+                st.membership.epoch = st.epoch
+            return st.membership
+
+    def _reset_world(self, result):
+        """Shrink commit: the new epoch starts from a CLEAN kvstore — the
+        authoritative state is the survivors' last checkpoint, which the
+        resumed fit re-pushes exactly like a fresh launch (the PR 5
+        restarted-empty-server machinery).  Keeping the old store would be
+        worse than useless: it holds post-checkpoint updates and
+        half-aggregated rounds with dead-host contributions."""
+        st = self._state
+        with st.cond:
+            st.epoch = result["epoch"]
+            st.num_workers = result["world_size"]
+            st.store.clear()
+            st.version.clear()
+            st.agg.clear()
+            # release any barrier waiters from the old epoch (their reply
+            # lands on dead or about-to-restart channels either way)
+            st.barrier_count = 0
+            st.barrier_gen += 1
+            st.next_rank = 0
+            st.client_replies.clear()
+            st.cond.notify_all()
+
     def _dispatch(self, msg):
         cmd = msg.get("cmd")
         st = self._state
         if cmd == "register":
+            # epoch fence: once a shrink committed, a register from a
+            # host that missed it (its env still carries the old epoch)
+            # must be refused — its rank could collide with a survivor's
+            # new rank and corrupt post-shrink state
+            if st.membership is not None:
+                stale = st.membership.check_epoch(msg.get("epoch", 0))
+                if stale is not None and msg.get("role") == "worker":
+                    return stale
             with st.cond:
                 rank = msg.get("rank")
                 if rank is None:
                     rank = st.next_rank
                 st.next_rank = max(st.next_rank, rank + 1)
             return {"rank": rank, "num_workers": st.num_workers,
-                    "num_servers": st.num_servers}
+                    "num_servers": st.num_servers, "epoch": st.epoch}
+
+        if cmd == "hb":
+            return self._membership().heartbeat(
+                msg["rank"], msg.get("epoch", 0), step=msg.get("step"),
+                step_time=msg.get("step_time"))
+
+        if cmd == "members":
+            return {"ok": True, "view": self._membership().view()}
+
+        if cmd == "shrink":
+            from .. import config as _config
+            # the barrier must outlast a peer whose collective watchdog
+            # has not fired yet: survivors enter the hang within a step
+            # of each other, so the worst-case stagger is one full
+            # watchdog deadline (plus heartbeat slack) — a 30s barrier
+            # under a 120s watchdog would fence out healthy survivors
+            deadline = max(
+                float(_config.get("MXNET_SUPERVISOR_SHRINK_BARRIER_S")),
+                float(_config.get("MXNET_SUPERVISOR_COLLECTIVE_TIMEOUT_S"))
+                + 2 * float(_config.get("MXNET_SUPERVISOR_DEADLINE_S")))
+            return self._membership().propose_shrink(
+                msg["rank"], msg.get("epoch", 0), deadline_s=deadline,
+                on_commit=self._reset_world)
 
         if cmd == "register_server":
             # a secondary server announces its address; the root doubles
@@ -314,8 +391,18 @@ class ParameterServer:
             with st.cond:
                 if k not in st.store:
                     return {"error": f"Key {k} has not been initialized"}
+                # epoch fence: a shrink commit resets the store mid-wait —
+                # this round can never complete, so the waiter must be
+                # released with an error instead of idling out the 300s
+                epoch0 = st.epoch
                 ok = st.cond.wait_for(
-                    lambda: st.version.get(k, 0) >= min_version, timeout=300)
+                    lambda: st.version.get(k, 0) >= min_version
+                    or st.epoch != epoch0, timeout=300)
+                if st.epoch != epoch0:
+                    return {"error": f"epoch fenced: pull({k}) was waiting "
+                                     f"across a shrink commit (epoch "
+                                     f"{epoch0} -> {st.epoch}); re-register "
+                                     "and resume from the checkpoint"}
                 if not ok:
                     return {"error": f"pull({k}) timed out waiting for "
                                      f"version {min_version}"}

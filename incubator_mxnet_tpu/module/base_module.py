@@ -25,6 +25,7 @@ class BaseModule:
         self.optimizer_initialized = False
         self._symbol = None
         self._total_exec_bytes = 0
+        self._supervisor = None   # JobSupervisor of the last dist fit
 
     # -- high-level API --------------------------------------------------------
     def forward_backward(self, data_batch):
@@ -215,8 +216,21 @@ class BaseModule:
         The budget covers failures during the restart's own re-init too
         (the replacement server dying mid-handshake consumes a restart,
         not the whole run).
+
+        Elastic supervision (resilience/supervisor.py): a multi-worker
+        dist fit runs under a per-host `JobSupervisor` (MXNET_SUPERVISOR)
+        — heartbeats to the coordinator, a watchdog around every sync
+        push/pull/barrier, straggler findings.  A HOST loss then surfaces
+        as a `CollectiveTimeoutError` naming the absent hosts instead of
+        an indefinite hang, and with a ``checkpoint_dir`` set fit drives
+        **shrink-and-resume**: the survivors agree on the new world size
+        via the epoch-fenced shrink barrier, this worker adopts its new
+        (dense) rank, and the run restarts from the last committed
+        checkpoint at the smaller world size — a fenced-out stale host
+        can never rejoin and corrupt the shrunk pod.
         """
-        from ..resilience import ServerLostError
+        import os as _os
+        from ..resilience import ServerLostError, CollectiveTimeoutError
         if max_restarts is None:
             from .. import config as _config
             max_restarts = int(_config.get("MXNET_FIT_MAX_RESTARTS"))
@@ -244,13 +258,15 @@ class BaseModule:
                 return self._fit_attempt(
                     train_data, force_rebind=force_rebind,
                     force_init=force_init, resume=resume, **fixed)
-            except (ServerLostError, ConnectionError, EOFError,
-                    TimeoutError) as e:
+            except (ServerLostError, CollectiveTimeoutError,
+                    ConnectionError, EOFError, TimeoutError) as e:
                 # raw connection/timeout errors are recoverable only on a
                 # RESTART attempt's re-init (handshake against the
                 # replacement server, before per-server breakers exist) —
                 # on a first attempt they are real configuration errors
-                if not isinstance(e, ServerLostError) and not failed_over:
+                if not isinstance(e, (ServerLostError,
+                                      CollectiveTimeoutError)) \
+                        and not failed_over:
                     raise
                 if checkpoint_dir is None or max_restarts <= 0:
                     raise
@@ -259,6 +275,46 @@ class BaseModule:
                     # rebuilt; restarting would loop on its closed
                     # channels — surface the loss instead
                     raise
+                if isinstance(e, CollectiveTimeoutError):
+                    # a HOST (not a server) is gone: before restarting,
+                    # the survivors must agree on the smaller world —
+                    # the epoch-fenced shrink barrier.  This worker then
+                    # adopts its new dense rank and the post-shrink
+                    # membership epoch; the coordinator reset the kvstore
+                    # state at commit, so the resumed attempt re-inits it
+                    # from the checkpoint exactly like a fresh launch.
+                    if self._supervisor is None:
+                        raise
+                    try:
+                        shrink = self._supervisor.shrink(reason=str(e))
+                    except Exception as shrink_exc:
+                        self.logger.error(
+                            "fit: shrink barrier failed (%s) after %s",
+                            shrink_exc, e)
+                        raise e from shrink_exc
+                    self.logger.warning(
+                        "fit: %s — pod shrunk to world_size=%d at epoch "
+                        "%d (this worker: rank %d -> %d)", e,
+                        shrink.world_size, shrink.epoch,
+                        self._supervisor.rank, shrink.rank)
+                    _os.environ["DMLC_RANK"] = str(shrink.rank)
+                    _os.environ["DMLC_NUM_WORKER"] = str(shrink.world_size)
+                    _os.environ["MXNET_SUPERVISOR_EPOCH"] = \
+                        str(shrink.epoch)
+                    self._supervisor = None
+                    # the pre-shrink jax.distributed group still spans
+                    # the dead host: tear it down so the restarted
+                    # kvstore's collective plane re-initializes (and
+                    # re-derives its worker mesh) at the surviving world
+                    # size instead of failing against the stale group
+                    # and silently degrading to the socket data plane.
+                    # User code holding its own dp meshes re-derives
+                    # them with parallel.mesh.rebuild().
+                    try:
+                        from ..dist import collective as _collective
+                        _collective.shutdown()
+                    except Exception:
+                        pass
                 max_restarts -= 1
                 failed_over = True
                 self.logger.warning(
@@ -349,6 +405,7 @@ class BaseModule:
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+        sup = self._start_supervisor()
         if checkpoint_dir is not None:
             from .. import checkpoint as _ckpt
             # dist layout: the resolved kvstore names this process's rank —
@@ -387,7 +444,7 @@ class BaseModule:
         if ckpt_mgr is not None:
             ckpt_mgr.install_preemption_hook()
         from .. import analysis as _analysis
-        from ..resilience import ServerLostError
+        from ..resilience import CollectiveTimeoutError, ServerLostError
         server_lost = False
         try:
             with _analysis.hostsync.hot_loop("Module.fit"):
@@ -398,10 +455,21 @@ class BaseModule:
                     sparse_row_id_fn, begin_epoch, num_epoch, ckpt_mgr,
                     ckpt_resume, resume_nbatch, gstep, last_snap_step,
                     checkpoint_period)
-        except ServerLostError:
-            server_lost = True
-            raise
+        except (ServerLostError, CollectiveTimeoutError):
+            server_lost = True   # either failover signal must not be
+            raise                # masked by a deferred flush error
         finally:
+            if sup is not None:
+                # stop the heartbeat loop but KEEP self._supervisor: the
+                # restart loop's shrink barrier still needs its identity
+                # and membership view (the shrink request rides a fresh
+                # channel, not the stopped heartbeat one)
+                from ..resilience import supervisor as _sup_mod
+                _sup_mod.deactivate(sup)
+                try:
+                    sup.stop()
+                except Exception:
+                    pass
             if ckpt_mgr is not None:
                 try:
                     ckpt_mgr.flush()
@@ -412,6 +480,35 @@ class BaseModule:
                         raise
                 finally:
                     ckpt_mgr.close()
+
+    def _start_supervisor(self):
+        """Attach a `JobSupervisor` to a multi-worker dist fit: heartbeat
+        this host into the coordinator's membership table and arm the
+        hung-collective watchdog around the kvstore's sync exchanges.
+        Returns the started supervisor (also kept on `self._supervisor`
+        for the restart loop's shrink barrier) or None — single-process
+        and non-dist runs never pay for supervision, and a supervisor
+        bring-up failure degrades to the unsupervised PR 5 behavior
+        instead of blocking training."""
+        self._supervisor = None
+        kv = getattr(self, "_kvstore", None)
+        if kv is None or getattr(kv, "num_workers", 1) <= 1 or \
+                not hasattr(kv, "_chan"):
+            return None
+        from .. import config as _config
+        if not _config.get("MXNET_SUPERVISOR"):
+            return None
+        from ..resilience import supervisor as _sup_mod
+        try:
+            sup = _sup_mod.JobSupervisor.for_kvstore(kv).start()
+        except Exception as e:
+            self.logger.warning(
+                "supervisor unavailable (%s); continuing unsupervised",
+                str(e)[:200])
+            return None
+        _sup_mod.activate(sup)
+        self._supervisor = sup
+        return sup
 
     def _teardown_kvstore(self):
         """Drop the current kvstore connection so the next
@@ -437,6 +534,7 @@ class BaseModule:
                     eval_batch_end_callback, monitor, sparse_row_id_fn,
                     begin_epoch, num_epoch, ckpt_mgr, ckpt_resume,
                     resume_nbatch, gstep, last_snap_step, checkpoint_period):
+        from ..resilience import faults as _faults
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
@@ -459,6 +557,11 @@ class BaseModule:
                 end_of_batch = True
                 next_data_batch = None
             while not end_of_batch:
+                # pod chaos site: a `kill` here is a whole-host SIGKILL
+                # at a step boundary (the membership deadline detects it,
+                # the survivors' watchdogs convert the stalled round)
+                _faults.fire("host.step", nbatch=nbatch, epoch=epoch)
+                step_tic = time.time()
                 data_batch = next_data_batch
                 nbatch_at_entry = nbatch
                 # block mode: collect K batches and let the subclass run
@@ -519,6 +622,13 @@ class BaseModule:
                     nbatch += 1
 
                 gstep += nbatch - nbatch_at_entry
+                if self._supervisor is not None and nbatch > nbatch_at_entry:
+                    # per-step wall time feeds the heartbeat EWMA the
+                    # coordinator's straggler detection compares across
+                    # the pod; the step counter keys lag detection
+                    self._supervisor.record_step(
+                        (time.time() - step_tic) /
+                        (nbatch - nbatch_at_entry))
                 if ckpt_mgr is not None and nbatch > nbatch_at_entry:
                     # batch boundary: params and (epoch, nbatch, step)
                     # agree — the only place a snapshot may be taken

@@ -16,11 +16,11 @@ and reduction trees, training steps are jit-compiled SPMD programs over a
   bucketing strategy)
 * `pipeline.py` — pipeline-parallel microbatch schedule over `pp`
 """
-from .mesh import make_mesh, mesh_axes, local_mesh
+from .mesh import make_mesh, mesh_axes, local_mesh, rebuild
 from .gluon_bridge import (shard_block, block_shardings,
                            shard_state_for_zero, put)
 from .collectives import (all_reduce, all_gather, reduce_scatter, ppermute,
-                          broadcast)
+                          broadcast, supervised)
 from .data_parallel import data_parallel_step, replicate, unreplicate
 from .tensor_parallel import shard_params, ShardingRules
 from .ring_attention import ring_attention, blockwise_attention

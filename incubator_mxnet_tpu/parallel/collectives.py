@@ -50,3 +50,23 @@ def axis_index(axis_name):
 
 def axis_size(axis_name):
     return jax.lax.psum(1, axis_name)
+
+
+def supervised(name, fn, axis_name=None, timeout=None):
+    """Dispatch a blocking HOST-LEVEL cross-host collective under the
+    active `JobSupervisor`'s hung-collective watchdog (a plain call when
+    none is active).  The in-graph verbs above run inside XLA programs
+    where nothing can time them out — it is the host-side dispatch (the
+    jitted call + `block_until_ready`) that a lost host hangs forever,
+    and that is what gets the deadline:
+
+        result = collectives.supervised(
+            "grad-allreduce", lambda: allreduce_program(bucket),
+            axis_name="dp")
+
+    On expiry the watchdog raises `CollectiveTimeoutError` naming the
+    collective, the axis, and the hosts that failed to arrive (from
+    membership data).  mxlint's ``unsupervised-collective`` AST lint
+    flags host-level collective dispatches that bypass this wrapper."""
+    from ..resilience.supervisor import supervised as _supervised
+    return _supervised(name, fn, axis=axis_name, timeout=timeout)

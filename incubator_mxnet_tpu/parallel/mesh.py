@@ -56,6 +56,27 @@ def mesh_axes(mesh):
     return tuple(mesh.axis_names)
 
 
+def rebuild(axis_names=("dp",), per_host=None):
+    """Rebuild the 1-axis data-parallel mesh over the CURRENT global
+    device set — the shrink-and-resume step after a host loss: once the
+    survivors have torn down and re-formed the process group
+    (`dist.collective.shutdown()` + `init_process_group` at the smaller
+    world size), `jax.devices()` spans only surviving hosts and every
+    pre-shrink mesh is stale (it still holds the dead host's devices —
+    dispatching on it hangs exactly like the collective being recovered
+    from).  ``per_host`` optionally caps devices per process (testing
+    convenience, mirrors `local_mesh`)."""
+    import jax
+    devices = jax.devices()
+    if per_host is not None:
+        by_proc = {}
+        for d in devices:
+            by_proc.setdefault(d.process_index, []).append(d)
+        devices = [d for p in sorted(by_proc)
+                   for d in by_proc[p][:int(per_host)]]
+    return make_mesh({axis_names[0]: len(devices)}, devices=devices)
+
+
 def initialize_distributed(coordinator_address=None, num_processes=None,
                            process_id=None):
     """Multi-host bring-up (replaces ps-lite scheduler bootstrapping,

@@ -16,7 +16,7 @@ import threading
 
 __all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
            "resume", "Task", "Frame", "Counter", "Marker",
-           "record_memory", "record_serving"]
+           "record_memory", "record_serving", "record_supervisor"]
 
 _config = {"profile_all": False, "profile_symbolic": False,
            "profile_imperative": False, "profile_memory": False,
@@ -175,6 +175,19 @@ def record_serving(name, dur_us, **args):
     _emit({"name": name, "cat": "serving", "ph": "X",
            "ts": time.perf_counter() * 1e6 - float(dur_us),
            "dur": float(dur_us), "pid": 0, "tid": 0, "args": args})
+
+
+def record_supervisor(event, **args):
+    """Record one elastic-supervisor event (host lost, straggler flagged,
+    collective watchdog timeout, shrink commit — resilience.supervisor
+    feeds this) as an instant event in the chrome trace, so pod-level
+    membership churn lines up against the training steps it disrupted.
+    A no-op unless a profile is running."""
+    if not _state["running"]:
+        return
+    _emit({"name": f"supervisor:{event}", "cat": "supervisor", "ph": "i",
+           "s": "g", "ts": time.perf_counter() * 1e6, "pid": 0, "tid": 0,
+           "args": args})
 
 
 def record_fault(site, kind, **args):

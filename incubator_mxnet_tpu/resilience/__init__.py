@@ -18,6 +18,12 @@ both halves of that contract:
   server is diagnosed permanently dead — the signal
   ``Module.fit(checkpoint_dir=..., resume=True)`` turns into an
   automatic restart from the last checkpoint.
+* **elastic supervision** (`supervisor`) — the per-host `JobSupervisor`
+  for multi-host runs: heartbeat/membership with fenced epochs, the
+  hung-collective watchdog (`CollectiveTimeoutError` names the absent
+  hosts instead of blocking forever), straggler detection, and the
+  shrink-and-resume barrier `Module.fit` drives after a confirmed host
+  loss.
 
 With ``MXNET_FAULTS`` unset, every site hook is a function call behind
 one global read — no locks, no syscalls, no behavior change.
@@ -30,10 +36,15 @@ from .faults import (FaultInjected, TornWrite, configure, inject, clear,
                      reset, trace, fire, active)
 from .retry import RetryPolicy, RetryBudget
 from .breaker import CircuitBreaker
+from . import supervisor
+from .supervisor import (JobSupervisor, CollectiveTimeoutError,
+                         HostLostError, StaleEpochError)
 
 __all__ = ["faults", "FaultInjected", "TornWrite", "configure", "inject",
            "clear", "reset", "trace", "fire", "active", "RetryPolicy",
-           "RetryBudget", "CircuitBreaker", "ServerLostError"]
+           "RetryBudget", "CircuitBreaker", "ServerLostError", "supervisor",
+           "JobSupervisor", "CollectiveTimeoutError", "HostLostError",
+           "StaleEpochError"]
 
 
 class ServerLostError(MXNetError):

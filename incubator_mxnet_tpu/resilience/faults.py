@@ -16,7 +16,8 @@ programmatic `inject()` API.  Spec grammar (clauses joined with ``;``)::
                  | site ':' kind [ '(' key '=' value (',' key '=' value)* ')' ]
     site         = transport.connect | transport.send | transport.recv
                  | server.dispatch | serving.execute | checkpoint.commit
-    kind         = refuse | drop | slow | crash | torn | error
+                 | heartbeat.send | collective.dispatch | host.step
+    kind         = refuse | drop | slow | crash | torn | error | hang | kill
 
 Firing controls (any clause):
 
@@ -25,11 +26,22 @@ Firing controls (any clause):
 * ``p=F``                — fire with probability F from the SEEDED stream
 * ``cmd=NAME``           — only hits whose context carries ``cmd=NAME``
 
+The supervisor sites model pod-scale failures: ``heartbeat.send`` with a
+``drop`` skips one heartbeat (lossy control network), ``collective.
+dispatch`` with a ``hang`` sleeps inside the dispatched collective (the
+lost-host stall the watchdog must convert into an error), and
+``host.step`` with a ``kill`` hard-exits the whole process (SIGKILL-grade
+host loss, exit code 137) — the three ingredients of a deterministic
+in-process pod chaos schedule.
+
 Every fired fault appends an event to an in-process trace
 (`resilience.trace()`), and — when ``MXNET_FAULTS_LOG`` names a file —
-one JSON line per event, so multi-process chaos runs can assert exact
-fault sequences after the fact.  The same seed always produces the same
-schedule: hit counters and the Bernoulli stream are both deterministic.
+one JSON line per event.  Every event carries this process's pid and
+DMLC rank, and each line is written with a single ``O_APPEND`` write, so
+the processes of a multi-host chaos run can share ONE log file without
+interleaving or clobbering each other's events.  The same seed always
+produces the same schedule: hit counters and the Bernoulli stream are
+both deterministic.
 """
 from __future__ import annotations
 
@@ -59,7 +71,8 @@ class TornWrite(FaultInjected):
     """Checkpoint writer 'died' mid-commit (see checkpoint/snapshot.py)."""
 
 
-_KINDS = ("refuse", "drop", "slow", "crash", "torn", "error")
+_KINDS = ("refuse", "drop", "slow", "crash", "torn", "error", "hang",
+          "kill")
 _CLAUSE_RE = re.compile(
     r"^(?P<site>[\w.]+):(?P<kind>\w+)(?:\((?P<args>[^)]*)\))?$")
 
@@ -157,7 +170,7 @@ _clauses = []
 _trace = []
 _seed = 0
 _log_path = None
-_log_file = None
+_log_fd = None
 
 
 def _load_env():
@@ -234,13 +247,25 @@ def trace():
 
 
 def _record(event):
+    # every event names its emitting process: pid always, the dmlc rank
+    # when the launcher set one (read per event — the shrink-and-resume
+    # path re-ranks a live process mid-run)
+    rank = os.environ.get("DMLC_RANK")
+    event["pid"] = os.getpid()
+    event["rank"] = int(rank) if rank is not None and rank.isdigit() \
+        else None
     _trace.append(event)
     if _log_path is not None:
-        global _log_file
+        global _log_fd
         try:
-            if _log_file is None:
-                _log_file = open(_log_path, "a", buffering=1)
-            _log_file.write(json.dumps(event) + "\n")
+            if _log_fd is None:
+                # O_APPEND + one write() per line: POSIX makes each line
+                # atomic, so every process of a chaos run can append to
+                # the SAME file without interleaving mid-line
+                _log_fd = os.open(_log_path,
+                                  os.O_APPEND | os.O_CREAT | os.O_WRONLY,
+                                  0o644)
+            os.write(_log_fd, (json.dumps(event) + "\n").encode())
         except OSError:
             pass
     try:
@@ -325,3 +350,14 @@ def _execute(clause, site, ctx):
                         f"fault-injected torn write at {site}")
     if kind == "error":
         raise MXNetError(f"fault-injected error at {site}")
+    if kind == "hang":
+        # the lost-host stall: the call never returns on its own (default
+        # 1h — far past any watchdog deadline); ms= bounds it for tests
+        # that want the hang to eventually clear
+        time.sleep(float(clause.args.get("ms", 3_600_000)) / 1e3)
+        return
+    if kind == "kill":
+        # whole-host death: no atexit, no flush, no unwinding — the
+        # SIGKILL-grade loss the membership deadline must detect (the
+        # default code is the conventional 128+SIGKILL)
+        os._exit(int(clause.args.get("code", 137)))

@@ -1,0 +1,693 @@
+"""Elastic multi-host supervisor (ISSUE-7).
+
+Covers: membership liveness with an injectable clock (death within the
+heartbeat deadline) and epoch fencing (a stale host cannot rejoin);
+the epoch-fenced shrink barrier (dense re-rank, idempotent replay, late
+proposers fenced out); `dist.collective` shutdown/re-init returning the
+actual (coordinator, world_size, rank); the hung-collective watchdog
+converting a stall into a structured `CollectiveTimeoutError` naming the
+absent host (value passthrough and exception relay on the happy path);
+straggler findings landing in `analysis.runtime_report()`;
+`JobSupervisor.stats()` exporting the PR 5 kvstore retry/breaker
+counters; the faults JSONL log carrying pid+rank with line-atomic
+appends; `parallel.mesh.rebuild` post-shrink; the mxlint
+``unsupervised-collective`` AST lint; and the subprocess pod tests —
+a SIGKILLed worker detected within the heartbeat deadline with the
+stalled round raised as `CollectiveTimeoutError` (no indefinite hang),
+and full shrink-and-resume: 3 workers mid-`Module.fit`, one host killed,
+survivors shrink to world 2 and resume from the last checkpoint with
+final params bit-identical to an uninterrupted 2-worker run resumed from
+the same checkpoint, with zero compilations through the unified program
+cache.
+"""
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import resilience
+from incubator_mxnet_tpu.base import MXNetError
+from incubator_mxnet_tpu.dist.membership import MembershipTable
+from incubator_mxnet_tpu.resilience import (CollectiveTimeoutError,
+                                            JobSupervisor, StaleEpochError)
+from incubator_mxnet_tpu.resilience import supervisor as supmod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    resilience.clear()
+    supmod.reset_findings()
+    supmod.deactivate()
+    yield
+    resilience.clear()
+    supmod.reset_findings()
+    supmod.deactivate()
+
+
+@pytest.fixture()
+def fast_pod(monkeypatch):
+    """Pod clocks scaled for CI: death in ~0.6s, watchdog in 2s."""
+    monkeypatch.setenv("MXNET_SUPERVISOR_HEARTBEAT_S", "0.1")
+    monkeypatch.setenv("MXNET_SUPERVISOR_DEADLINE_S", "0.6")
+    monkeypatch.setenv("MXNET_SUPERVISOR_COLLECTIVE_TIMEOUT_S", "2.0")
+    monkeypatch.setenv("MXNET_SUPERVISOR_SHRINK_BARRIER_S", "8.0")
+
+
+# -- membership: liveness, deadline, epoch fence ------------------------------
+
+def test_membership_liveness_and_epoch_fence():
+    t = [0.0]
+    mt = MembershipTable(3, deadline_s=1.0, clock=lambda: t[0])
+    for r in range(3):
+        reply = mt.heartbeat(r, 0, step=1, step_time=0.01)
+        assert reply["ok"]
+    view = reply["view"]
+    assert view["alive"] == [0, 1, 2] and view["dead"] == []
+    assert view["epoch"] == 0 and view["world_size"] == 3
+    # rank 1 goes silent past the deadline: dead in the next view
+    t[0] += 0.5
+    mt.heartbeat(0, 0)
+    mt.heartbeat(2, 0)
+    t[0] += 0.6          # rank 1 now 1.1s silent; 0 and 2 only 0.6s
+    view = mt.view()
+    assert view["dead"] == [1] and view["alive"] == [0, 2]
+    assert view["age"][1] > 1.0
+    # epoch fence: a heartbeat from a past epoch is rejected, not folded in
+    err = mt.heartbeat(1, -1)
+    assert "stale epoch" in err["error"]
+    # per-host telemetry rides the view
+    assert view["steps"][0] >= 1 and view["ewma"][1] == 0.01
+
+
+def test_shrink_barrier_commits_reranks_and_fences():
+    t = [0.0]
+    mt = MembershipTable(3, deadline_s=1.0, clock=lambda: t[0])
+    for r in range(3):
+        mt.heartbeat(r, 0)
+    t[0] += 2.0              # everyone stale except who re-beats
+    mt.heartbeat(0, 0)
+    mt.heartbeat(2, 0)       # rank 1 is dead
+    committed = []
+    results = {}
+
+    def propose(rank):
+        results[rank] = mt.propose_shrink(rank, 0, deadline_s=5.0,
+                                          on_commit=committed.append)
+    th = threading.Thread(target=propose, args=(2,))
+    th.start()
+    propose(0)
+    th.join(timeout=10)
+    assert not th.is_alive()
+    res = results[0]
+    assert res == results[2]
+    assert res["epoch"] == 1 and res["world_size"] == 2
+    assert res["survivors"] == [0, 2]
+    assert res["rank_map"] == {0: 0, 2: 1}   # dense re-rank, sorted order
+    assert len(committed) == 1               # on_commit fired exactly once
+    # a resent proposal from a survivor replays the committed result
+    assert mt.propose_shrink(2, 0, deadline_s=1.0)["epoch"] == 1
+    # the dead host proposing late is fenced, not readmitted
+    late = mt.propose_shrink(1, 0, deadline_s=1.0)
+    assert "stale epoch" in late.get("error", "")
+    # and post-shrink, old-epoch heartbeats are fenced too
+    assert "stale epoch" in mt.heartbeat(0, 0)["error"]
+    assert mt.heartbeat(0, 1)["ok"]
+
+
+def test_second_shrink_commits_a_new_epoch():
+    """Regression: the pod must survive a SECOND host loss — the next
+    shrink barrier must commit a fresh epoch, not instantly replay the
+    previous shrink's result (which still contains the newly dead
+    host)."""
+    t = [0.0]
+    mt = MembershipTable(3, deadline_s=1.0, clock=lambda: t[0])
+    for r in range(3):
+        mt.heartbeat(r, 0)
+    t[0] += 2.0
+    mt.heartbeat(0, 0)
+    mt.heartbeat(1, 0)       # rank 2 dead -> shrink #1 to world 2
+    results = {}
+
+    def propose(rank, epoch):
+        results[rank] = mt.propose_shrink(rank, epoch, deadline_s=5.0)
+    th = threading.Thread(target=propose, args=(1, 0))
+    th.start()
+    propose(0, 0)
+    th.join(timeout=10)
+    assert results[0]["epoch"] == 1 and results[0]["world_size"] == 2
+    # the new epoch's world: survivors re-heartbeat under new ranks 0, 1
+    mt.heartbeat(0, 1)
+    mt.heartbeat(1, 1)
+    t[0] += 2.0
+    mt.heartbeat(0, 1)       # new-rank 1 dead -> shrink #2 to world 1
+    # a lone proposer is not a majority of world 2, so the second
+    # barrier commits only at its deadline — tick the scripted clock
+    # past it while the proposal waits
+
+    def tick():
+        for _ in range(100):
+            time.sleep(0.01)
+            t[0] += 0.1
+    tick_th = threading.Thread(target=tick)
+    tick_th.start()
+    res2 = mt.propose_shrink(0, 1, deadline_s=0.5)
+    tick_th.join()
+    assert "error" not in res2, res2
+    assert res2["epoch"] == 2, "second shrink replayed the first commit"
+    assert res2["world_size"] == 1 and res2["survivors"] == [0]
+
+
+def test_shrink_barrier_deadline_needs_quorum():
+    """At the deadline the barrier commits only on a strict proposer
+    majority of the hosts still alive: one host with a misfiring
+    watchdog must NOT be able to shrink a healthy pod down to itself —
+    its proposal fails instead."""
+    t = [0.0]
+    mt = MembershipTable(2, deadline_s=10.0, clock=lambda: t[0])
+    mt.heartbeat(0, 0)
+    mt.heartbeat(1, 0)       # alive, healthy, never proposes
+
+    def tick():
+        for _ in range(100):
+            time.sleep(0.01)
+            t[0] += 0.1
+    th = threading.Thread(target=tick)
+    th.start()
+    res = mt.propose_shrink(0, 0, deadline_s=0.5)
+    th.join()
+    assert "quorum" in res["error"]
+    assert mt.epoch == 0     # the pod was NOT shrunk
+
+
+def test_shrink_barrier_deadline_commits_with_majority():
+    """A proposer MAJORITY at the deadline commits, excluding an
+    alive-but-wedged host (heartbeating, never proposing) — which is
+    then fenced out of the new epoch."""
+    t = [0.0]
+    mt = MembershipTable(4, deadline_s=10.0, clock=lambda: t[0])
+    for r in range(4):
+        mt.heartbeat(r, 0)
+    results = {}
+
+    def propose(rank):
+        results[rank] = mt.propose_shrink(rank, 0, deadline_s=0.5)
+    threads = [threading.Thread(target=propose, args=(r,))
+               for r in (0, 1, 2)]          # rank 3 wedged: hb only
+    for th in threads:
+        th.start()
+
+    def tick():
+        for _ in range(100):
+            time.sleep(0.01)
+            t[0] += 0.1
+    tick_th = threading.Thread(target=tick)
+    tick_th.start()
+    for th in threads:
+        th.join(timeout=15)
+        assert not th.is_alive()
+    tick_th.join()
+    res = results[0]
+    assert res["survivors"] == [0, 1, 2] and res["world_size"] == 3
+    # the wedged host is fenced out of the committed epoch
+    assert "stale epoch" in mt.propose_shrink(3, 0, 0.5).get("error", "")
+
+
+def test_epoch_fenced_pull_raises_recoverable_signal(monkeypatch):
+    """A pull blocked server-side while a shrink commits is released with
+    an epoch-fence error that surfaces as CollectiveTimeoutError — the
+    recoverable signal fit's restart loop drives through the fence path —
+    not as a generic MXNetError."""
+    from incubator_mxnet_tpu.dist.server import ParameterServer
+    from incubator_mxnet_tpu.dist.kvstore_dist import KVStoreDist
+    from incubator_mxnet_tpu import nd
+
+    srv = ParameterServer(num_workers=2).start()
+    for k, v in {"DMLC_PS_ROOT_URI": "127.0.0.1",
+                 "DMLC_PS_ROOT_PORT": str(srv.port), "DMLC_RANK": "0",
+                 "DMLC_NUM_WORKER": "2",
+                 "MXNET_KVSTORE_COLLECTIVE": "0"}.items():
+        monkeypatch.setenv(k, v)
+    kv = KVStoreDist("dist_sync")
+    try:
+        srv._state.store["w"] = np.zeros(4, "f4")
+        srv._state.version["w"] = 0
+        kv._store["w"] = nd.zeros((4,))
+        kv.push("w", nd.ones((4,)))   # round needs 2 workers: incomplete
+
+        def commit_soon():
+            time.sleep(0.3)
+            srv._reset_world({"epoch": 1, "world_size": 1})
+        th = threading.Thread(target=commit_soon)
+        th.start()
+        out = nd.zeros((4,))
+        with pytest.raises(CollectiveTimeoutError, match="epoch fenced"):
+            kv.pull("w", out=out)     # waiting when the commit lands
+        th.join()
+    finally:
+        kv.close(send_stop=False)
+        srv.shutdown()
+
+
+# -- dist.collective: shutdown / re-init (satellite) --------------------------
+
+def test_collective_returns_group_tuple_and_reinitializes():
+    from incubator_mxnet_tpu.dist import collective
+
+    collective.shutdown()    # clean slate whatever ran before
+    g = collective.init_process_group(num_processes=1, process_id=0)
+    assert g == (g[0], 1, 0) and isinstance(g[0], str)
+    assert collective.initialized() and collective.group() == g
+    # idempotent while live: the SAME group comes back
+    assert collective.init_process_group(num_processes=1) == g
+    # shutdown -> re-init at a "different world" (still 1 process on CPU,
+    # but the state machine is the shrink path's)
+    collective.shutdown()
+    assert not collective.initialized() and collective.group() is None
+    g2 = collective.init_process_group(
+        coordinator="127.0.0.1:7777", num_processes=1, process_id=0)
+    assert g2 == ("127.0.0.1:7777", 1, 0)
+    collective.shutdown()
+    # historical alias still works
+    assert collective.finalize is collective.shutdown
+
+
+# -- watchdog -----------------------------------------------------------------
+
+def test_watchdog_passthrough_error_relay_and_timeout(fast_pod):
+    from incubator_mxnet_tpu.dist.server import ParameterServer
+
+    srv = ParameterServer(num_workers=2).start()
+    s0 = JobSupervisor(0, 2, host="127.0.0.1", port=srv.port).start()
+    s1 = JobSupervisor(1, 2, host="127.0.0.1", port=srv.port).start()
+    try:
+        # passthrough: value and exceptions of the wrapped fn
+        assert s0.collective("noop", lambda: 41 + 1) == 42
+        with pytest.raises(ValueError, match="boom"):
+            s0.collective("err", lambda: (_ for _ in ()).throw(
+                ValueError("boom")))
+        # kill host 1's heartbeats; detection within the deadline
+        s1.stop()
+        t0 = time.monotonic()
+        while 1 not in (s0.view() or {}).get("dead", ()):
+            assert time.monotonic() - t0 < 3.0, \
+                "host death not detected within the deadline"
+            time.sleep(0.05)
+        # a hung collective raises a STRUCTURED timeout naming the host
+        s0.record_step(0.01)
+        with pytest.raises(CollectiveTimeoutError,
+                           match=r"kvstore\.pull.*host\(s\) \[1\] failed "
+                                 r"to arrive") as err:
+            s0.collective("kvstore.pull", lambda: time.sleep(60),
+                          axis="workers", timeout=0.4)
+        assert err.value.absent == [1]
+        assert err.value.collective == "kvstore.pull"
+        assert err.value.axis == "workers"
+        stats = s0.stats()
+        assert stats["collective_timeouts"] == 1
+        assert stats["hosts_lost"] == 1
+        # the host loss landed as a runtime finding too
+        from incubator_mxnet_tpu import analysis
+        codes = analysis.runtime_report().by_code()
+        assert codes.get("host-lost", 0) >= 1
+    finally:
+        s0.stop()
+        s1.stop()
+        srv.shutdown()
+
+
+def test_injected_hang_fault_trips_the_watchdog(fast_pod):
+    """The collective.dispatch:hang fault site stalls INSIDE the
+    dispatched collective — the deterministic stand-in for a lost host's
+    stall — and the watchdog must convert it."""
+    from incubator_mxnet_tpu.dist.server import ParameterServer
+
+    resilience.inject("collective.dispatch", "hang", at=1)
+    srv = ParameterServer(num_workers=1).start()
+    sup = JobSupervisor(0, 1, host="127.0.0.1", port=srv.port).start()
+    try:
+        with pytest.raises(CollectiveTimeoutError, match="allreduce"):
+            sup.collective("allreduce", lambda: 1, timeout=0.3)
+        assert [e["kind"] for e in resilience.trace()
+                if e["event"] == "fault"] == ["hang"]
+        # the NEXT collective is unaffected (at=1 fired once)
+        assert sup.collective("allreduce", lambda: 7, timeout=5.0) == 7
+    finally:
+        sup.stop()
+        srv.shutdown()
+
+
+def test_fenced_supervisor_refuses_collectives():
+    sup = JobSupervisor(0, 2, host="127.0.0.1", port=1)   # never started
+    sup._fenced = True
+    with pytest.raises(StaleEpochError, match="fenced"):
+        sup.collective("x", lambda: 1)
+
+
+# -- straggler detection ------------------------------------------------------
+
+def test_straggler_finding_lands_in_runtime_report():
+    sup = JobSupervisor(0, 4, host="127.0.0.1", port=1, straggler_k=2.0)
+    # a pod view where rank 3's EWMA diverges far beyond k*sigma
+    sup._on_view({"epoch": 0, "alive": [0, 1, 2, 3], "dead": [],
+                  "age": {}, "steps": {},
+                  "ewma": {0: 0.100, 1: 0.101, 2: 0.099, 3: 0.400}})
+    assert sup.stats()["stragglers_flagged"] == 1
+    from incubator_mxnet_tpu import analysis
+    report = analysis.runtime_report()
+    strag = [f for f in report if f.code == "straggler-host"]
+    assert len(strag) == 1 and "rank 3" in strag[0].message
+    assert "sigma" in strag[0].message
+    # repeats dedupe into the count, not new findings
+    sup._stragglers.clear()
+    sup._on_view({"epoch": 0, "alive": [0, 1, 2, 3], "dead": [],
+                  "age": {}, "steps": {},
+                  "ewma": {0: 0.1, 1: 0.1, 2: 0.1, 3: 0.5}})
+    strag = [f for f in analysis.runtime_report()
+             if f.code == "straggler-host"]
+    assert len(strag) == 1 and strag[0].count == 2
+    # a uniform pod flags nothing
+    sup2 = JobSupervisor(0, 4, host="127.0.0.1", port=1)
+    sup2._on_view({"epoch": 0, "alive": [0, 1], "dead": [], "age": {},
+                   "steps": {}, "ewma": {0: 0.1, 1: 0.100001}})
+    assert sup2.stats()["stragglers_flagged"] == 0
+
+
+# -- stats export (satellite) -------------------------------------------------
+
+def test_stats_exports_kvstore_retry_breaker_counters(monkeypatch):
+    from incubator_mxnet_tpu.dist.server import ParameterServer
+    from incubator_mxnet_tpu.dist.kvstore_dist import KVStoreDist
+    from incubator_mxnet_tpu import nd
+
+    srv = ParameterServer(num_workers=1).start()
+    for k, v in {"DMLC_PS_ROOT_URI": "127.0.0.1",
+                 "DMLC_PS_ROOT_PORT": str(srv.port), "DMLC_RANK": "0",
+                 "DMLC_NUM_WORKER": "1",
+                 "MXNET_KVSTORE_COLLECTIVE": "0"}.items():
+        monkeypatch.setenv(k, v)
+    kv = KVStoreDist("dist_sync")
+    try:
+        kv.init("w", nd.ones((4,)))
+        ks = kv.stats()
+        assert ks["resends"] == 0 and ks["discarded_stale"] == 0
+        assert ks["breakers"][0]["state"] == "closed"
+        assert ks["breakers"][0]["server"] == 0
+        sup = JobSupervisor.for_kvstore(kv)
+        stats = sup.stats()
+        assert stats["kvstore"]["breakers"][0]["state"] == "closed"
+        assert stats["rank"] == 0 and stats["world_size"] == 1
+    finally:
+        kv.close()
+        srv.shutdown()
+
+
+# -- faults log: rank + pid, line-atomic appends (satellite) ------------------
+
+def test_faults_log_carries_rank_pid_and_is_line_atomic(tmp_path,
+                                                        monkeypatch):
+    log = tmp_path / "faults.jsonl"
+    monkeypatch.setenv("DMLC_RANK", "3")
+    monkeypatch.setenv("MXNET_FAULTS_LOG", str(log))
+    resilience.configure("demo.site:slow(ms=0,n=64)")
+    # re-read the env log path (configure keeps clauses, not the path)
+    from incubator_mxnet_tpu.resilience import faults as _faults
+    monkeypatch.setattr(_faults, "_log_path", str(log))
+    monkeypatch.setattr(_faults, "_log_fd", None)
+    threads = [threading.Thread(
+        target=lambda: [resilience.fire("demo.site") for _ in range(8)])
+        for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    lines = log.read_text().strip().splitlines()
+    assert len(lines) == 32
+    for line in lines:
+        event = json.loads(line)   # every line parses: no interleaving
+        assert event["rank"] == 3
+        assert event["pid"] == os.getpid()
+        assert event["site"] == "demo.site"
+
+
+# -- mesh rebuild -------------------------------------------------------------
+
+def test_mesh_rebuild_spans_current_world():
+    from incubator_mxnet_tpu import parallel
+
+    mesh = parallel.rebuild()
+    assert mesh.axis_names == ("dp",)
+    assert mesh.size == 8          # the test harness's virtual mesh
+    capped = parallel.rebuild(per_host=2)
+    assert capped.size == 2
+
+
+# -- mxlint: unsupervised-collective (satellite) ------------------------------
+
+def test_mxlint_flags_unsupervised_collective():
+    from incubator_mxnet_tpu import analysis
+
+    src = (
+        "from incubator_mxnet_tpu import parallel\n"
+        "def step(bucket):\n"
+        "    return parallel.collectives.all_reduce(bucket, 'dp')\n")
+    report = analysis.check_source(src, filename="train.py")
+    codes = report.by_code()
+    assert codes.get("unsupervised-collective") == 1
+    finding = [f for f in report
+               if f.code == "unsupervised-collective"][0]
+    assert "train.py:3" in finding.location
+    assert "supervised" in finding.message
+
+
+def test_mxlint_unsupervised_collective_respects_scopes():
+    from incubator_mxnet_tpu import analysis
+
+    # a with-scope naming the supervisor/watchdog is supervised
+    src_with = (
+        "def step(sup, bucket):\n"
+        "    with sup.watchdog('allreduce'):\n"
+        "        return coll.all_reduce(bucket, 'dp')\n")
+    # the supervised(...) wrapper's own arguments are the supervised scope
+    src_wrap = (
+        "def step(bucket):\n"
+        "    return collectives.supervised('g', lambda: "
+        "coll.all_reduce(bucket, 'dp'))\n")
+    # in-graph (jitted) collectives are XLA's business
+    src_jit = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(bucket):\n"
+        "    return coll.all_reduce(bucket, 'dp')\n")
+    # suppression comment
+    src_supp = ("def step(b):\n"
+                "    return coll.all_reduce(b, 'dp')"
+                "  # mxlint: disable=unsupervised-collective\n")
+    for src in (src_with, src_wrap, src_jit, src_supp):
+        assert analysis.check_source(src).by_code().get(
+            "unsupervised-collective") is None, src
+    # a name that SAYS it is not supervised must not silence the lint
+    src_unsup = ("def step(b):\n"
+                 "    return run_unsupervised(lambda: "
+                 "plane.allreduce(b))\n")
+    assert analysis.check_source(src_unsup).by_code().get(
+        "unsupervised-collective") == 1
+
+
+# -- subprocess pod tests -----------------------------------------------------
+
+MEMBER_WORKER = r"""
+import os, sys, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.resilience import (CollectiveTimeoutError,
+                                            JobSupervisor)
+from incubator_mxnet_tpu.resilience import supervisor as supmod
+
+rank = int(os.environ["DMLC_RANK"])
+kv = mx.kv.create("dist_sync")
+sup = JobSupervisor.for_kvstore(kv).start()
+supmod.activate(sup)
+kv.init("w", nd.zeros((4,)))
+kv.push("w", nd.ones((4,)))
+out = nd.zeros((4,))
+kv.pull("w", out=out)
+assert out.asnumpy()[0] == kv.num_workers
+
+if rank == 1:
+    # die without unwinding: the SIGKILL'd-host stand-in
+    os._exit(137)
+
+# rank 0: the peer is gone — detection must land within the heartbeat
+# deadline (+ one beat + scheduling slack)
+t0 = time.monotonic()
+deadline = float(os.environ["MXNET_SUPERVISOR_DEADLINE_S"])
+while 1 not in (sup.view() or {}).get("dead", ()):
+    assert time.monotonic() - t0 < deadline + 2.0, "death not detected"
+    time.sleep(0.05)
+print("DETECTED %.3f" % (time.monotonic() - t0))
+
+# the next sync round can never complete: the watchdog must convert the
+# stall into a structured error naming the absent host
+sup.record_step(0.01)
+kv.push("w", nd.ones((4,)))
+try:
+    kv.pull("w", out=out)
+    print("NO_TIMEOUT")
+except CollectiveTimeoutError as e:
+    assert e.absent == [1], e.absent
+    assert e.collective == "kvstore.pull"
+    print("TIMEOUT_OK " + str(e)[:120])
+sup.stop()
+kv.close(send_stop=False)
+print("worker %d OK" % rank)
+"""
+
+
+def test_killed_worker_detected_and_hung_round_raises(tmp_path, fast_pod,
+                                                      monkeypatch):
+    """Two real worker processes: SIGKILL one mid-run — the survivor's
+    membership view marks it dead within the heartbeat deadline, and the
+    stalled sync round raises CollectiveTimeoutError naming the absent
+    host instead of hanging (the acceptance gate's detection half)."""
+    from incubator_mxnet_tpu.dist.server import ParameterServer
+
+    script = tmp_path / "member_worker.py"
+    script.write_text(MEMBER_WORKER)
+    server = ParameterServer(num_workers=2).start()
+    env = dict(os.environ,
+               DMLC_PS_ROOT_URI="127.0.0.1",
+               DMLC_PS_ROOT_PORT=str(server.port),
+               DMLC_NUM_WORKER="2", DMLC_ROLE="worker",
+               MXNET_KVSTORE_COLLECTIVE="0",
+               MXNET_SUPERVISOR_HEARTBEAT_S="0.1",
+               MXNET_SUPERVISOR_DEADLINE_S="0.8",
+               MXNET_SUPERVISOR_COLLECTIVE_TIMEOUT_S="2.5",
+               JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                             ""))
+    procs = [subprocess.Popen([sys.executable, str(script)],
+                              env=dict(env, DMLC_RANK=str(r)),
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT)
+             for r in range(2)]
+    outs = [p.communicate(timeout=180)[0].decode() for p in procs]
+    server.shutdown()
+    assert procs[1].returncode == 137
+    assert procs[0].returncode == 0, outs[0]
+    assert "worker 0 OK" in outs[0]
+    m = re.search(r"DETECTED ([\d.]+)", outs[0])
+    assert m, outs[0]
+    assert float(m.group(1)) <= 0.8 + 2.0, "detection exceeded deadline"
+    assert "TIMEOUT_OK" in outs[0] and "NO_TIMEOUT" not in outs[0]
+    assert "failed to arrive" in outs[0]
+
+
+# the worker subprocess body is tools/pod_worker.py — ONE copy shared
+# with the run_chaos --pod schedules so this acceptance gate and the
+# chaos artifact exercise the identical protocol
+POD_WORKER_PATH = os.path.join(REPO, "tools", "pod_worker.py")
+
+
+def _run_fit_pod(server_port, n_workers, ckpt_dir, faults_by_rank=None,
+                 resume=False):
+    env = dict(os.environ,
+               DMLC_PS_ROOT_URI="127.0.0.1",
+               DMLC_PS_ROOT_PORT=str(server_port),
+               DMLC_NUM_WORKER=str(n_workers), DMLC_ROLE="worker",
+               MXNET_KVSTORE_COLLECTIVE="0",
+               MXNET_SUPERVISOR_HEARTBEAT_S="0.1",
+               MXNET_SUPERVISOR_DEADLINE_S="0.8",
+               MXNET_SUPERVISOR_COLLECTIVE_TIMEOUT_S="2.5",
+               MXNET_SUPERVISOR_SHRINK_BARRIER_S="10.0",
+               MXNET_PS_RECONNECT_WAIT="1.0",
+               POD_CKPT_DIR=str(ckpt_dir),
+               POD_RESUME="1" if resume else "0",
+               JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                             ""))
+    env.pop("MXNET_FAULTS", None)
+    env.pop("MXNET_SUPERVISOR_EPOCH", None)
+    procs = []
+    for r in range(n_workers):
+        wenv = dict(env, DMLC_RANK=str(r))
+        spec = (faults_by_rank or {}).get(r)
+        if spec:
+            wenv["MXNET_FAULTS"] = spec
+        procs.append(subprocess.Popen([sys.executable, POD_WORKER_PATH],
+                                      env=wenv, stdout=subprocess.PIPE,
+                                      stderr=subprocess.STDOUT))
+    outs = [p.communicate(timeout=240)[0].decode() for p in procs]
+    return procs, outs
+
+
+def _sha(out):
+    m = re.search(r"PARAMS_SHA (\w+)", out)
+    return m.group(1) if m else None
+
+
+def test_pod_kill_shrink_resume_bit_identical(tmp_path, fast_pod):
+    """THE acceptance gate: 3 workers mid-`Module.fit`, one host
+    SIGKILLed (host.step:kill) — survivors detect the loss, convert the
+    stalled round into CollectiveTimeoutError (no indefinite hang),
+    shrink the pod to world 2 via the epoch-fenced barrier, and resume
+    from the last committed checkpoint; final params are bit-identical
+    to an uninterrupted 2-worker run resumed from that same checkpoint,
+    and the run performs zero compilations through the unified program
+    cache."""
+    from incubator_mxnet_tpu.dist.server import ParameterServer
+
+    # phase 1 — chaos: rank 2 dies at its 4th step
+    ckpt = tmp_path / "ckpts"
+    server = ParameterServer(num_workers=3).start()
+    procs, outs = _run_fit_pod(
+        server.port, 3, ckpt,
+        faults_by_rank={2: "seed=22;host.step:kill(at=4)"})
+    server.shutdown()
+    assert procs[2].returncode == 137          # the killed host
+    for r in (0, 1):
+        assert procs[r].returncode == 0, outs[r]
+        assert "worker OK" in outs[r]
+        assert "pod shrunk to world_size=2" in outs[r], outs[r]
+        assert "COMPILES 0" in outs[r]
+    chaos_shas = {_sha(outs[0]), _sha(outs[1])}
+    assert len(chaos_shas) == 1 and None not in chaos_shas
+    # the survivors' supervisors ended at epoch 1, world 2
+    sup_stats = [json.loads(re.search(r"SUPSTATS (.*)", o).group(1))
+                 for o in outs[:2]]
+    assert all(s["epoch"] == 1 and s["world_size"] == 2
+               for s in sup_stats)
+    # phase 2 — control: an uninterrupted 2-worker run resumed from the
+    # SAME checkpoint the survivors resumed from.  The chaos run's
+    # post-shrink snapshots have higher steps; prune back to the resume
+    # point (parsed from the survivors' own resume log line).
+    m = re.search(r"resuming from .*\(step (\d+),", outs[0])
+    assert m, outs[0]
+    resume_step = int(m.group(1))
+    control = tmp_path / "control"
+    shutil.copytree(ckpt, control)
+    for entry in os.listdir(control):
+        cm = re.match(r"ckpt-(\d+)$", entry)
+        if cm and int(cm.group(1)) > resume_step:
+            shutil.rmtree(control / entry)
+    server = ParameterServer(num_workers=2).start()
+    cprocs, couts = _run_fit_pod(server.port, 2, control, resume=True)
+    server.shutdown()
+    for r in (0, 1):
+        assert cprocs[r].returncode == 0, couts[r]
+    control_shas = {_sha(couts[0]), _sha(couts[1])}
+    assert len(control_shas) == 1 and None not in control_shas
+    assert control_shas == chaos_shas, \
+        "shrink-and-resume diverged from a clean resume at world 2"

@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""One elastic pod worker: the subprocess body both `tools/run_chaos.py
+--pod` and `tests/test_supervisor.py` launch (one copy — the chaos
+artifact and the acceptance test must not drift apart).
+
+Runs a small supervised `Module.fit(kvstore='dist_sync')` against the
+coordinator named by the DMLC env, with elastic checkpointing, then
+prints the machine-readable protocol the launchers parse:
+
+    SUPSTATS {json}      JobSupervisor.stats() of the final attempt
+    COMPILES N           unified-program-cache compiles this process
+    PARAMS_SHA hex       sha256 over the sorted final params
+    worker OK rank=R
+
+Env: ``POD_CKPT_DIR`` (shared checkpoint directory, required),
+``POD_RESUME=1`` (resume the directory's run — the control lane of the
+bit-identical gate), and the usual DMLC_*/MXNET_* knobs (fault schedules
+ride ``MXNET_FAULTS``).
+"""
+import hashlib
+import json
+import logging
+import os
+
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+logging.basicConfig(level=logging.INFO)
+
+import incubator_mxnet_tpu as mx                      # noqa: E402
+from incubator_mxnet_tpu import sym                   # noqa: E402
+from incubator_mxnet_tpu.io import NDArrayIter        # noqa: E402
+
+
+def main():
+    d = sym.Variable("data")
+    f1 = sym.FullyConnected(d, num_hidden=8, name="fc1")
+    a1 = sym.Activation(f1, act_type="relu")
+    f2 = sym.FullyConnected(a1, num_hidden=4, name="fc2")
+    net = sym.SoftmaxOutput(f2, name="softmax")
+    mx.random.seed(11)
+    np.random.seed(11)
+    X = np.random.RandomState(2).randn(48, 10).astype("f4")
+    y = (np.arange(48) % 4).astype("f4")
+    it = NDArrayIter(X, y, batch_size=8, shuffle=False)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, kvstore="dist_sync", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1}, num_epoch=2,
+            checkpoint_dir=os.environ["POD_CKPT_DIR"],
+            checkpoint_period=1, checkpoint_keep_last=100,
+            resume=os.environ.get("POD_RESUME") == "1")
+    sup = mod._supervisor
+    if sup is not None:
+        print("SUPSTATS " + json.dumps(sup.stats()))
+    from incubator_mxnet_tpu import compile as _compile
+    print("COMPILES %d" % _compile.stats()["counters"]["compiles"])
+    args, _ = mod.get_params()
+    blob = b"".join(args[k].asnumpy().tobytes() for k in sorted(args))
+    print("PARAMS_SHA " + hashlib.sha256(blob).hexdigest())
+    kv = getattr(mod, "_kvstore", None)
+    if kv is not None:
+        # the protocol 'stop' lets a serve_forever coordinator reach its
+        # shutdown quorum once every (post-shrink) worker finished
+        kv.close()
+    print("worker OK rank=%s" % os.environ.get("DMLC_RANK"))
+
+
+if __name__ == "__main__":
+    main()

@@ -10,10 +10,23 @@ and the final pass/fail counts.  The tests are the SAME tests that gate
 normal PRs — the chaos claim is exactly "the functional contract holds
 while the transport is being actively sabotaged".
 
-Usage: python tools/run_chaos.py [--quick] [--json] [--out PATH]
+Pod mode (``--pod``) runs the ELASTIC schedules instead: a root
+parameter server (the pod coordinator) plus three real worker processes
+mid-`Module.fit` under the supervisor, sabotaged per rank — heartbeat
+drops that must NOT trip false host loss, one host SIGKILLed mid-fit
+(survivors must detect it, shrink, and resume from the checkpoint), and
+one hung collective (the watchdog must convert the stall into a
+`CollectiveTimeoutError` and the whole pod must recover).  The artifact
+(``CHAOS_POD.json``) embeds every surviving worker's
+`JobSupervisor.stats()` dict — heartbeats, watchdog timeouts, hosts
+lost, and the PR 5 kvstore retry/breaker counters.
+
+Usage: python tools/run_chaos.py [--quick] [--pod] [--json] [--out PATH]
     --quick   bounded test selection (the run_tpu_parity.py stage)
+    --pod     run the elastic pod schedules (writes CHAOS_POD.json)
     --json    print only the JSON artifact on stdout
-    --out     also write the artifact to PATH (default CHAOS_REPORT.json)
+    --out     also write the artifact to PATH (default CHAOS_REPORT.json,
+              or CHAOS_POD.json with --pod)
 
 Exit status: 0 when every schedule's tests passed.
 """
@@ -23,6 +36,8 @@ import argparse
 import json
 import os
 import re
+import shutil
+import socket
 import subprocess
 import sys
 import tempfile
@@ -131,13 +146,177 @@ def run_schedule(name, spec, tests, quiet=False):
     return result
 
 
+# -- pod schedules: elastic multi-host supervision under sabotage -------------
+# three workers mid-Module.fit; faults are injected PER RANK so each
+# schedule is one deterministic pod failure story
+POD_SCHEDULES = {
+    # lossy control network: a burst of 3 consecutive dropped heartbeats
+    # per host (0.6s silence under the 1.2s deadline) must not trip
+    # false host loss — and the drops must verifiably fire
+    "pod-hb-drops": {"faults": {"*": "seed=21;heartbeat.send:drop(at=2-4)"},
+                     "killed": None, "min_faults": 3},
+    # whole-host SIGKILL mid-fit: survivors must detect the loss within
+    # the heartbeat deadline, convert the stalled round into a
+    # CollectiveTimeoutError, shrink to world 2, and resume
+    "pod-host-crash": {"faults": {"2": "seed=22;host.step:kill(at=4)"},
+                       "killed": 2},
+    # hung collective on one rank: every watchdog fires (no host is
+    # dead), the full pod shrinks-in-place and resumes — no indefinite
+    # hang anywhere
+    "pod-hung-collective": {
+        "faults": {"1": "seed=23;collective.dispatch:hang(at=9)"},
+        "killed": None},
+}
+
+# the worker subprocess body is tools/pod_worker.py — ONE copy shared
+# with tests/test_supervisor.py so the chaos artifact and the acceptance
+# test exercise the identical protocol
+POD_WORKER_PATH = os.path.join(REPO, "tools", "pod_worker.py")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_pod_schedule(name, schedule, quiet=False):
+    """One pod schedule: root server (coordinator) + 3 supervised workers
+    mid-fit, faults injected per rank.  Returns the result dict with
+    per-worker outcomes and every survivor's JobSupervisor.stats()."""
+    n_workers = 3
+    log_fd, log_path = tempfile.mkstemp(prefix="chaos-%s-" % name,
+                                        suffix=".jsonl")
+    os.close(log_fd)
+    ckpt_dir = tempfile.mkdtemp(prefix="chaos-%s-ckpt-" % name)
+    port = _free_port()
+    base_env = dict(
+        os.environ,
+        DMLC_PS_ROOT_URI="127.0.0.1", DMLC_PS_ROOT_PORT=str(port),
+        DMLC_NUM_WORKER=str(n_workers), DMLC_ROLE="worker",
+        MXNET_KVSTORE_COLLECTIVE="0",
+        # fast pod clocks: detection in ~1s, watchdog in 3s, so a whole
+        # schedule (including shrink + resume) fits a CI budget
+        MXNET_SUPERVISOR_HEARTBEAT_S="0.2",
+        MXNET_SUPERVISOR_DEADLINE_S="1.2",
+        MXNET_SUPERVISOR_COLLECTIVE_TIMEOUT_S="3.0",
+        MXNET_SUPERVISOR_SHRINK_BARRIER_S="10.0",
+        MXNET_PS_RECONNECT_WAIT="1.0",
+        MXNET_FAULTS_LOG=log_path,
+        POD_CKPT_DIR=ckpt_dir,
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    base_env.pop("MXNET_FAULTS", None)
+    t0 = time.time()
+    server = subprocess.Popen(
+        [sys.executable, "-m", "incubator_mxnet_tpu.dist.server"],
+        env=dict(base_env), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, cwd=REPO)
+    procs = []
+    for r in range(n_workers):
+        env = dict(base_env, DMLC_RANK=str(r))
+        spec = schedule["faults"].get(str(r)) or schedule["faults"].get("*")
+        if spec:
+            env["MXNET_FAULTS"] = spec
+        procs.append(subprocess.Popen(
+            [sys.executable, POD_WORKER_PATH], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, cwd=REPO))
+    workers = []
+    hung = False
+    for r, p in enumerate(procs):
+        try:
+            out = p.communicate(timeout=240)[0].decode()
+        except subprocess.TimeoutExpired:
+            # a hung worker is the exact failure this subsystem exists to
+            # prevent — record it as the worst result, don't hang the run
+            hung = True
+            p.kill()
+            out = (p.communicate()[0] or b"").decode() + "\nHUNG (killed)"
+        sup_stats = None
+        sha = None
+        for line in out.splitlines():
+            if line.startswith("SUPSTATS "):
+                try:
+                    sup_stats = json.loads(line[len("SUPSTATS "):])
+                except ValueError:
+                    pass
+            elif line.startswith("PARAMS_SHA "):
+                sha = line.split()[1]
+        workers.append({"rank": r, "rc": p.returncode,
+                        "params_sha": sha, "supervisor": sup_stats,
+                        "tail": "\n".join(out.strip().splitlines()[-5:])
+                                [-800:]})
+    server.kill()
+    server.communicate()
+    shutil.rmtree(ckpt_dir, ignore_errors=True)
+    killed = schedule["killed"]
+    survivors = [w for w in workers if w["rank"] != killed]
+    fault_agg = _read_fault_log(log_path)
+    passed = (not hung
+              and all(w["rc"] == 0 for w in survivors)
+              and all(w["params_sha"] is not None for w in survivors)
+              and len({w["params_sha"] for w in survivors}) == 1
+              and (killed is None or workers[killed]["rc"] == 137)
+              and fault_agg["faults"] >= schedule.get("min_faults", 1))
+    result = {
+        "schedule": name,
+        "specs": schedule["faults"],
+        "killed_rank": killed,
+        "workers": workers,
+        "duration_s": round(time.time() - t0, 1),
+        **fault_agg,
+        "passed": passed,
+    }
+    os.unlink(log_path)
+    if not quiet:
+        print("chaos[%s]: passed=%s rcs=%s faults=%d (%.1fs)" %
+              (name, passed, [w["rc"] for w in workers],
+               result["faults"], result["duration_s"]), file=sys.stderr)
+    return result
+
+
+def run_pod(as_json=False, out_path=None):
+    runs = [run_pod_schedule(name, sched, quiet=as_json)
+            for name, sched in POD_SCHEDULES.items()]
+    artifact = {
+        "schedules": runs,
+        "all_passed": all(r["passed"] for r in runs),
+        "supervisor_stats": {
+            r["schedule"]: [w["supervisor"] for w in r["workers"]
+                            if w["supervisor"] is not None]
+            for r in runs},
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(artifact, f, indent=1)
+    if as_json:
+        slim = {"all_passed": artifact["all_passed"],
+                "schedules": [{k: v for k, v in r.items()
+                               if k not in ("workers",)}
+                              for r in runs],
+                "supervisor_stats": artifact["supervisor_stats"]}
+        print(json.dumps(slim))
+    else:
+        print("chaos pod: %d schedule(s), all_passed=%s -> %s" %
+              (len(runs), artifact["all_passed"], out_path))
+    return 0 if artifact["all_passed"] else 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="run_chaos", description=__doc__)
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--pod", action="store_true")
     ap.add_argument("--json", action="store_true", dest="as_json")
-    ap.add_argument("--out", default=os.path.join(REPO,
-                                                  "CHAOS_REPORT.json"))
+    ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
+    if args.pod:
+        out = args.out if args.out is not None \
+            else os.path.join(REPO, "CHAOS_POD.json")
+        return run_pod(as_json=args.as_json, out_path=out)
+    if args.out is None:
+        args.out = os.path.join(REPO, "CHAOS_REPORT.json")
     tests = QUICK_TESTS if args.quick else FULL_TESTS
 
     runs = [run_schedule(name, spec, tests, quiet=args.as_json)

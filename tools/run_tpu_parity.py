@@ -133,6 +133,27 @@ def chaos_stage():
         return {"error": f"chaos stage failed: {exc!r}"}
 
 
+def chaos_pod_stage():
+    """Elastic pod stage: run tools/run_chaos.py --pod in a throwaway
+    process — three supervised workers mid-fit under heartbeat drops,
+    one SIGKILLed host (shrink-and-resume), and one hung collective —
+    and attach its CHAOS_POD artifact, including every survivor's
+    `JobSupervisor.stats()` dict (heartbeats, watchdog timeouts, hosts
+    lost, kvstore retry/breaker counters), to the round.  Pod-level
+    recovery claims become checkable evidence next to the parity
+    outcomes."""
+    cmd = [sys.executable, os.path.join(REPO, "tools", "run_chaos.py"),
+           "--pod", "--json", "--out", ""]
+    try:
+        out = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                             timeout=1800)
+        summary = json.loads(out.stdout)
+        summary["rc"] = out.returncode
+        return summary
+    except Exception as exc:
+        return {"error": f"chaos pod stage failed: {exc!r}"}
+
+
 def coldstart_stage():
     """Cold-start stage: the warmup CLI's built-in probe, run cold then
     warm in fresh subprocesses (tools/warmup.py coldstart_probe) — the
@@ -168,6 +189,7 @@ def main():
         "mxlint": mxlint_stage(),
         "serving": serving_stage(),
         "chaos": chaos_stage(),
+        "chaos_pod": chaos_pod_stage(),
         "coldstart": coldstart_stage(),
         "cmd": " ".join(cmd[2:]),
         "tests": tests[:500],
