@@ -24,6 +24,13 @@ walking a script's AST:
   `MXNetError` — including structured failover signals like
   `ServerLostError` — and the training script keeps "running" on a dead
   cluster.
+* ``router-bypass`` — a direct `ServedModel.infer()` call or a bare
+  `ModelServer(...)` in a script that also configures a
+  `ReplicaRouter`: traffic through those paths bypasses the router's
+  failover, health checking, and priority-class shedding — one replica
+  death or one overload burst takes exactly that traffic down.  Route
+  requests through ``router.submit()/predict()`` (or keep the script
+  router-less on purpose and say so with a suppression).
 * ``unsupervised-collective`` — a host-level cross-host collective
   dispatch (`collectives.all_reduce` / `all_gather` / `reduce_scatter` /
   `ppermute` / a collective plane's `allreduce`) outside a supervisor/
@@ -76,7 +83,8 @@ _PASS_BY_CODE = {"host-sync-in-loop": "source.hostsync",
                  "kvstore-local-on-tpu": "source.kvstore",
                  "unbounded-retry": "source.retry",
                  "bare-except": "source.except",
-                 "unsupervised-collective": "source.supervisor"}
+                 "unsupervised-collective": "source.supervisor",
+                 "router-bypass": "source.router"}
 
 
 def _suppressed(lines, lineno, code):
@@ -98,6 +106,10 @@ class _Visitor(ast.NodeVisitor):
         self.findings = []
         self.uses_tpu = False
         self.kv_local_sites = []   # (lineno, sink name)
+        self.router_configured = False
+        self.served_names = set()    # names bound from ServedModel(...)
+        self.bypass_sites = []       # (lineno, what) — emitted only when
+                                     # a router is configured
         self.supervised_depth = 0  # inside a supervisor/watchdog `with`
         self.device_depth = 0      # inside a jit/pjit/shard_map function
 
@@ -208,6 +220,15 @@ class _Visitor(ast.NodeVisitor):
                 out.add(sub.attr)
         return out
 
+    # -- assignments (ServedModel bindings for the router-bypass lint) -------
+    def visit_Assign(self, node):
+        if isinstance(node.value, ast.Call) and \
+                "ServedModel" in self._idents(node.value.func):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.served_names.add(tgt.id)
+        self.generic_visit(node)
+
     # -- supervised scopes ---------------------------------------------------
     def _visit_with(self, node):
         supervised = any(
@@ -255,6 +276,22 @@ class _Visitor(ast.NodeVisitor):
                         isinstance(kw.value, ast.Constant) and \
                         kw.value.value == "local":
                     self.kv_local_sites.append((node.lineno, name))
+        # -- router bypass ---------------------------------------------------
+        if name == "ReplicaRouter":
+            self.router_configured = True
+        elif name == "ModelServer":
+            self.bypass_sites.append(
+                (node.lineno, "ModelServer(...) instantiated"))
+        elif name == "infer":
+            recv = func.value if isinstance(func, ast.Attribute) else None
+            recv_name = recv.id if isinstance(recv, ast.Name) else None
+            # `model.infer(...)` on a ServedModel binding, or a direct
+            # `ServedModel(...).infer(...)` / `ServedModel.load(...).infer`
+            if (recv_name in self.served_names
+                    or (recv is not None
+                        and "ServedModel" in self._idents(recv))):
+                self.bypass_sites.append(
+                    (node.lineno, "direct ServedModel.infer() call"))
         if name in _COLLECTIVE_CALLS and isinstance(func, ast.Attribute) \
                 and self.supervised_depth == 0 and self.device_depth == 0:
             self._add("unsupervised-collective", node.lineno,
@@ -287,6 +324,17 @@ def scan_source(text, filename="<string>"):
     v = _Visitor(filename, lines)
     v.visit(tree)
     report.extend(v.findings)
+    if v.router_configured:
+        for lineno, what in v.bypass_sites:
+            if _suppressed(lines, lineno, "router-bypass"):
+                continue
+            report.add(Finding(
+                "source.router", "router-bypass", WARN,
+                f"{what} in a script that configures a ReplicaRouter: "
+                "this traffic bypasses the router's failover, health "
+                "checks, and priority-class shedding — route it through "
+                "router.submit()/predict()",
+                location=f"{filename}:{lineno}"))
     if v.uses_tpu:
         for lineno, sink in v.kv_local_sites:
             if _suppressed(lines, lineno, "kvstore-local-on-tpu"):

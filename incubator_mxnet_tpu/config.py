@@ -149,6 +149,40 @@ KNOBS = {
     "MXNET_SERVING_BREAKER_RESET_S": (float, 30.0, "honored",
                                       "serving breaker open->half-open "
                                       "probe window"),
+    # -- multi-replica serving router (serving/router.py) --------------------
+    "MXNET_ROUTER_HEALTH_INTERVAL_S": (float, 0.5, "honored",
+                                       "router health thread probe "
+                                       "interval per replica (heartbeat; "
+                                       "every k-th is a deepcheck)"),
+    "MXNET_ROUTER_HEALTH_DEADLINE_S": (float, 5.0, "honored",
+                                       "probe silence before a replica "
+                                       "is declared dead and its "
+                                       "in-flight requests fail over "
+                                       "(a probe-failure BURST inside "
+                                       "the deadline only suspends "
+                                       "dispatch — no false eviction)"),
+    "MXNET_ROUTER_DEEPCHECK_EVERY": (int, 8, "honored",
+                                     "every Nth health probe runs a real "
+                                     "bucket-1 inference through the "
+                                     "compiled ladder instead of a cheap "
+                                     "heartbeat (0 disables deepchecks)"),
+    "MXNET_ROUTER_MAX_DISPATCHES": (int, 3, "honored",
+                                    "dispatch attempts per request "
+                                    "across replica deaths before the "
+                                    "request fails (failover budget)"),
+    "MXNET_ROUTER_SHED_BEST_EFFORT_MS": (float, 25.0, "honored",
+                                         "estimated fleet wait beyond "
+                                         "which best_effort requests "
+                                         "are shed (the FIRST class to "
+                                         "degrade under overload)"),
+    "MXNET_ROUTER_SHED_BATCH_MS": (float, 100.0, "honored",
+                                   "estimated fleet wait beyond which "
+                                   "batch-class requests are shed"),
+    "MXNET_ROUTER_SHED_INTERACTIVE_MS": (float, 1000.0, "honored",
+                                         "estimated fleet wait beyond "
+                                         "which even interactive "
+                                         "requests are shed (the last "
+                                         "line before queue collapse)"),
     "MXNET_FIT_MAX_RESTARTS": (int, 2, "honored",
                                "Module.fit auto-restarts from the last "
                                "checkpoint after ServerLostError or "

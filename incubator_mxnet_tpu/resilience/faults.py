@@ -17,6 +17,7 @@ programmatic `inject()` API.  Spec grammar (clauses joined with ``;``)::
     site         = transport.connect | transport.send | transport.recv
                  | server.dispatch | serving.execute | checkpoint.commit
                  | heartbeat.send | collective.dispatch | host.step
+                 | router.dispatch | replica.health | replica.swap
     kind         = refuse | drop | slow | crash | torn | error | hang | kill
 
 Firing controls (any clause):
@@ -33,6 +34,14 @@ lost-host stall the watchdog must convert into an error), and
 ``host.step`` with a ``kill`` hard-exits the whole process (SIGKILL-grade
 host loss, exit code 137) — the three ingredients of a deterministic
 in-process pod chaos schedule.
+
+The serving-router sites model replica-fleet failures (serving/router.py):
+``router.dispatch`` fires per dispatch attempt (an ``error`` there is a
+failed hand-off), ``replica.health`` fires per health probe (a ``drop``
+burst is a lossy probe network — it must cause suspicion, not
+eviction), and ``replica.swap`` fires before each replica's weight swap
+(a ``torn`` there is a swap that dies mid-roll — the fleet must keep
+serving and the roll must abort cleanly).
 
 Every fired fault appends an event to an in-process trace
 (`resilience.trace()`), and — when ``MXNET_FAULTS_LOG`` names a file —

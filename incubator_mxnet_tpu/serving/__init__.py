@@ -24,8 +24,17 @@ Layers:
   drain.
 * `ModelServer` (server.py) — multi-model front end with hot
   load/unload that never drops in-flight requests.
-* `ServingMetrics` (metrics.py) — QPS, p50/p99 latency, batch occupancy,
-  queue depth; batches land in the profiler trace when one is running.
+* `ServingMetrics` (metrics.py) — QPS, p50/p99 latency (bounded
+  reservoir), per-priority-class counters, batch occupancy, queue
+  depth; batches land in the profiler trace when one is running.
+* `ReplicaRouter` (router.py) over `Replica` handles (replica.py) — the
+  availability layer: least-loaded health/breaker-aware dispatch over N
+  replicas (in-process `LocalReplica`s and/or `RemoteReplica`
+  subprocess workers on the dist transport, worker.py), idempotent
+  failover of in-flight requests off a dead replica, rolling hot
+  weight-swap with zero dropped requests and zero XLA compiles, and
+  priority classes (interactive/batch/best_effort) that shed lowest
+  first under overload.
 
 Minimal server::
 
@@ -45,7 +54,12 @@ from __future__ import annotations
 from .model import ServedModel, DEFAULT_BUCKETS
 from .batcher import MicroBatcher
 from .server import ModelServer
-from .metrics import ServingMetrics
+from .metrics import ServingMetrics, LatencyReservoir
+from .replica import (Replica, LocalReplica, RemoteReplica,
+                      ReplicaLostError)
+from .router import ReplicaRouter, PRIORITIES
 
 __all__ = ["ServedModel", "MicroBatcher", "ModelServer", "ServingMetrics",
+           "LatencyReservoir", "Replica", "LocalReplica", "RemoteReplica",
+           "ReplicaLostError", "ReplicaRouter", "PRIORITIES",
            "DEFAULT_BUCKETS"]

@@ -53,13 +53,14 @@ __all__ = ["MicroBatcher"]
 
 class _Request:
     __slots__ = ("arrs", "rows", "deadline", "timeout_ms", "future",
-                 "t_enqueue", "rid")
+                 "t_enqueue", "rid", "prio")
 
-    def __init__(self, arrs, rows, timeout_ms, rid):
+    def __init__(self, arrs, rows, timeout_ms, rid, prio=1):
         self.arrs = arrs
         self.rows = rows
         self.timeout_ms = timeout_ms
         self.rid = rid
+        self.prio = int(prio)
         self.t_enqueue = time.monotonic()
         self.deadline = (self.t_enqueue + timeout_ms / 1e3
                          if timeout_ms is not None else None)
@@ -80,13 +81,19 @@ class MicroBatcher:
                                   model.max_batch_size)
         self.max_queue_latency_ms = float(max_queue_latency_ms)
         self.max_queue = int(max_queue)
-        self._q = _queue.Queue(maxsize=self.max_queue)
+        # priority queue keyed (prio rank, arrival seq): the router's
+        # QoS classes hold DISPATCH order too — an admitted best-effort
+        # burst must not sit ahead of interactive work (admission
+        # control alone cannot recall what it already let in).  Rank 1
+        # is the default, so router-less callers keep plain FIFO.
+        self._q = _queue.PriorityQueue(maxsize=self.max_queue)
         self._carry = None         # request admitted but deferred to the
                                    # next batch (would overflow this one)
         self._outstanding = 0
         self._lock = threading.Lock()
         self._idle = threading.Condition(self._lock)
         self._stop = threading.Event()
+        self._killed = False       # abrupt death: sweep, don't execute
         self._draining = threading.Event()
         self._paused = threading.Event()
         self._monitor = None       # a monitor.Monitor driven per batch
@@ -116,9 +123,11 @@ class MicroBatcher:
         batches_ahead = -(-(self._q.qsize() + 1) // self.max_batch_size)
         return batch_s * batches_ahead
 
-    def submit(self, inputs, timeout_ms=None):
+    def submit(self, inputs, timeout_ms=None, priority=1):
         """Enqueue one request; returns a Future resolving to the list of
-        per-output NDArrays for exactly this request's rows."""
+        per-output NDArrays for exactly this request's rows.
+        ``priority`` is the dispatch rank (0 = interactive first, 1 =
+        default, 2 = best-effort last); equal ranks stay FIFO."""
         if self._draining.is_set() or self._stop.is_set():
             raise MXNetError(f"serving: model '{self._model.name}' is "
                              "draining; not accepting requests")
@@ -153,15 +162,32 @@ class MicroBatcher:
                 raise MXNetError(
                     f"serving: model '{self._model.name}' request batch "
                     f"{rows} exceeds max_batch_size {self.max_batch_size}")
+            if priority >= 2 and \
+                    self._q.qsize() >= (self.max_queue * 4) // 5:
+                # the top fifth of the queue is reserved for higher
+                # classes: a best-effort flood may fill its 80% and
+                # bounce, but it can never backpressure the traffic the
+                # QoS policy exists to protect.  Rank 0/1 (interactive
+                # and default router-less callers) see the full queue.
+                self._metrics.record_reject()
+                raise MXNetError(
+                    f"serving: model '{self._model.name}' queue is past "
+                    f"its best-effort high-water mark "
+                    f"({(self.max_queue * 4) // 5} of {self.max_queue}) "
+                    "— backpressure, retry later")
             with self._lock:
                 self._rid_counter += 1
-                rid = f"{self._model.name}-{self._rid_counter}"
-                req = _Request(arrs, rows, timeout_ms, rid)
+                seq = self._rid_counter   # captured under the lock: the
+                # queue tie-break must be unique or heapq falls through
+                # to comparing _Request objects
+                rid = f"{self._model.name}-{seq}"
+                req = _Request(arrs, rows, timeout_ms, rid,
+                               prio=priority)
                 req.future.request_id = rid
                 self._outstanding += 1
                 self._pending[rid] = req
             try:
-                self._q.put_nowait(req)
+                self._q.put_nowait((req.prio, seq, req))
             except _queue.Full:
                 with self._lock:
                     self._outstanding -= 1
@@ -229,10 +255,23 @@ class MicroBatcher:
                 + " — queued ones were failed with a shutdown error; a "
                   "request wedged in execution is abandoned to its future")
 
+    def kill(self):
+        """Abrupt death (the replica-failure simulation local replicas
+        need): the worker stops WITHOUT executing queued requests — they
+        fail with the shutdown error, exactly like a SIGKILLed remote
+        worker's queue.  A batch already on the device completes (its
+        callers were served before the death)."""
+        self._killed = True
+        self._draining.set()
+        self._stop.set()
+        self._paused.clear()
+        self._thread.join(timeout=10)
+        self._sweep_failed()
+
     def _sweep_failed(self):
         while True:
             try:
-                req = self._q.get_nowait()
+                req = self._q.get_nowait()[2]
             except _queue.Empty:
                 return
             self._fail(req, MXNetError(
@@ -258,7 +297,7 @@ class MicroBatcher:
         if self._carry is not None:
             req, self._carry = self._carry, None
             return req
-        return self._q.get(timeout=timeout)
+        return self._q.get(timeout=timeout)[2]
 
     def _worker(self):
         while True:
@@ -299,6 +338,13 @@ class MicroBatcher:
                 batch.append(nxt)
                 rows += nxt.rows
             self._metrics.set_queue_depth(self._q.qsize())
+            if self._killed:
+                # killed mid-coalesce: nothing else may execute here
+                for req in batch:
+                    self._fail(req, MXNetError(
+                        f"serving: model '{self._model.name}' shut down "
+                        "before this request ran"))
+                continue
             self._execute(batch)
 
     def _execute(self, batch):

@@ -6,25 +6,83 @@ Snapshots are pull-based (`snapshot()` / `ModelServer.stats()`); each
 executed batch is also emitted into the profiler's chrome trace when a
 profile is running (`profiler.record_serving`), so serving load shows up
 in the same trace viewer as the XLA timeline.
+
+Latency accounting is a `LatencyReservoir` — a FIXED-size uniform sample
+(Vitter's algorithm R) over every response since start, so a week of
+traffic costs the same memory as a minute and the percentiles describe
+the whole run, not just the last few thousand requests.  Priority-class
+traffic (the router's interactive/batch/best-effort split) lands in
+per-class shed counters and per-class reservoirs so a degradation claim
+("best-effort shed first, interactive p99 inside SLO") is readable off
+one snapshot.
 """
 from __future__ import annotations
 
 import collections
+import random
 import threading
 import time
 
 import numpy as _np
 
-__all__ = ["ServingMetrics"]
+__all__ = ["ServingMetrics", "LatencyReservoir"]
+
+
+class LatencyReservoir:
+    """Bounded uniform sample of a value stream (algorithm R).
+
+    O(1) per record, O(capacity) memory forever: slot i of the first
+    `capacity` records is kept verbatim; record n > capacity replaces a
+    random slot with probability capacity/n, which keeps the array a
+    uniform sample of ALL n records.  The RNG is seeded per reservoir so
+    runs are reproducible.  NOT thread-safe on its own — callers hold
+    their own metrics lock.
+    """
+
+    __slots__ = ("_vals", "count", "_rng", "capacity")
+
+    def __init__(self, capacity=4096, seed=0):
+        self.capacity = int(capacity)
+        self._vals = _np.empty(self.capacity, dtype=_np.float64)
+        self.count = 0
+        self._rng = random.Random(seed)
+
+    def add(self, value):
+        n = self.count
+        if n < self.capacity:
+            self._vals[n] = value
+        else:
+            j = self._rng.randrange(n + 1)
+            if j < self.capacity:
+                self._vals[j] = value
+        self.count = n + 1
+
+    def __len__(self):
+        return min(self.count, self.capacity)
+
+    def percentile(self, q):
+        """q-th percentile of the sample, or None before any record."""
+        n = len(self)
+        if not n:
+            return None
+        return float(_np.percentile(self._vals[:n], q))
+
+    def sample(self):
+        return _np.array(self._vals[:len(self)])
 
 
 class ServingMetrics:
-    """Counters and a sliding latency window for one served model."""
+    """Counters and a bounded latency reservoir for one served model."""
 
     def __init__(self, model_name, window=4096):
         self.model_name = model_name
         self._lock = threading.Lock()
-        self._lat_ms = collections.deque(maxlen=window)
+        self._lat_ms = LatencyReservoir(window)
+        self._window = int(window)
+        # priority-class plane: class -> {"responses", "shed",
+        # "rejected", "lat": LatencyReservoir}; created lazily so
+        # single-class (router-less) serving pays nothing
+        self._classes = {}
         self._t0 = time.monotonic()
         self.requests = 0        # accepted into the queue
         self.responses = 0       # completed with a result
@@ -40,6 +98,7 @@ class ServingMetrics:
         self.breaker_state = "closed"   # gauge, set by the batcher
         self.retries = collections.Counter()   # attempt number -> count
         self._ewma_batch_s = None    # recent batch execution time
+        self._ewma_lat_s = None      # recent end-to-end response latency
 
     # -- hot-path updates ----------------------------------------------------
     def record_request(self, queue_depth):
@@ -66,9 +125,26 @@ class ServingMetrics:
         with self._lock:
             return self._ewma_batch_s
 
-    def record_shed(self):
+    def _class_locked(self, cls):
+        rec = self._classes.get(cls)
+        if rec is None:
+            # stable per-class seed (str hash is randomized per process)
+            import zlib
+            rec = self._classes[cls] = {
+                "responses": 0, "shed": 0, "rejected": 0,
+                "lat": LatencyReservoir(max(self._window // 4, 256),
+                                        seed=zlib.crc32(cls.encode()))}
+        return rec
+
+    def record_shed(self, cls=None):
         with self._lock:
             self.shed += 1
+            if cls is not None:
+                self._class_locked(cls)["shed"] += 1
+
+    def record_class_reject(self, cls):
+        with self._lock:
+            self._class_locked(cls)["rejected"] += 1
 
     def record_breaker_reject(self):
         with self._lock:
@@ -82,10 +158,24 @@ class ServingMetrics:
         with self._lock:
             self.breaker_state = state
 
-    def record_response(self, latency_s):
+    def record_response(self, latency_s, cls=None):
         with self._lock:
             self.responses += 1
-            self._lat_ms.append(latency_s * 1e3)
+            self._lat_ms.add(latency_s * 1e3)
+            self._ewma_lat_s = latency_s if self._ewma_lat_s is None \
+                else 0.8 * self._ewma_lat_s + 0.2 * latency_s
+            if cls is not None:
+                rec = self._class_locked(cls)
+                rec["responses"] += 1
+                rec["lat"].add(latency_s * 1e3)
+
+    def avg_latency_s(self):
+        """Recent end-to-end response latency (EWMA), or None before the
+        first response.  Unlike `avg_batch_s` this includes queueing and
+        host scheduling — what a NEW request actually experiences — so
+        overload estimators should prefer it."""
+        with self._lock:
+            return self._ewma_lat_s
 
     def record_timeout(self):
         with self._lock:
@@ -102,9 +192,18 @@ class ServingMetrics:
     # -- reads ---------------------------------------------------------------
     def snapshot(self):
         """One coherent metrics dict: counts, QPS since start, p50/p99
-        latency (ms, over the sliding window), mean batch occupancy."""
+        latency (ms, reservoir-sampled over the whole run), mean batch
+        occupancy, and a per-priority-class block when router traffic
+        carried classes."""
         with self._lock:
-            lat = _np.asarray(self._lat_ms, dtype=_np.float64)
+            lat = self._lat_ms.sample()
+            classes = {
+                cls: {"responses": rec["responses"],
+                      "shed": rec["shed"],
+                      "rejected": rec["rejected"],
+                      "p50_ms": rec["lat"].percentile(50),
+                      "p99_ms": rec["lat"].percentile(99)}
+                for cls, rec in self._classes.items()}
             elapsed = max(time.monotonic() - self._t0, 1e-9)
             snap = {
                 "model": self.model_name,
@@ -127,6 +226,8 @@ class ServingMetrics:
                 "avg_batch_ms": (self._ewma_batch_s * 1e3
                                  if self._ewma_batch_s is not None else None),
             }
+            if classes:
+                snap["classes"] = classes
         if lat.size:
             snap["p50_ms"] = float(_np.percentile(lat, 50))
             snap["p99_ms"] = float(_np.percentile(lat, 99))

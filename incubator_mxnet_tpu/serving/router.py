@@ -1,0 +1,581 @@
+"""ReplicaRouter: health-checked request routing over N model replicas.
+
+The availability layer of the serving plane.  One process dying — or
+one bad weight reload — must cost capacity, not the model: the unit of
+redundancy is the REPLICA (the parameter-server failover model of the
+MXNet paper, the replica-fleet production story of the TensorFlow
+paper), and this router composes the repo's existing ingredients
+around it:
+
+* **least-loaded, health- and breaker-aware dispatch** — each request
+  goes to the live replica with the least outstanding work; a replica
+  whose requests keep failing trips its `CircuitBreaker` and is skipped
+  while it cools off.
+* **liveness** — a health thread heartbeats every replica on an
+  interval, with every k-th beat a *deepcheck* (a real bucket-1
+  inference through the compiled ladder).  The judgement is
+  `dist.membership` semantics: a failed probe makes a replica
+  *suspect* (dispreferred for new work, never evicted — even a
+  correlated probe-drop burst across the whole fleet only reorders
+  preference); only probe silence older than the deadline makes it
+  *dead*, and a completed request counts as proof of life.
+* **failover, idempotent by request id** — when a replica dies with
+  requests in flight, each unresolved request is re-dispatched to a
+  survivor.  A request is re-dispatched ONLY on `ReplicaLostError`
+  (replica death), never on a caller error; the first result to arrive
+  wins the future, late duplicates are counted and dropped, and remote
+  workers deduplicate by rid so a transport resend can never execute
+  twice on one worker.
+* **hot weight-swap, replica by replica** — `swap_weights()` rolls a
+  new parameter set (typically the newest valid elastic checkpoint)
+  through the fleet: each replica in turn stops taking new work, drains
+  its in-flight requests, swaps in place (same shapes, same programs —
+  zero XLA compiles), passes a deepcheck, and rejoins.  The rest of
+  the fleet keeps serving, so no request is dropped, and every request
+  is served wholly by one replica at one version (never mixed).  A
+  failed swap aborts the roll with the fleet still serving.
+* **priority classes** — requests carry ``priority`` in
+  {"interactive", "batch", "best_effort"}.  Under overload (estimated
+  queue wait beyond the class's shed threshold) low classes shed
+  FIRST, so an N-1 fleet keeps interactive p99 inside SLO by shedding
+  best-effort traffic; per-class latency/shed counters make the
+  degradation visible in `stats()`.
+
+Fault sites (`resilience.faults`): ``router.dispatch`` (per dispatch,
+names replica + rid), ``replica.health`` (per probe), ``replica.swap``
+(per replica swap step).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from concurrent.futures import Future
+
+from ..base import MXNetError
+from ..resilience import CircuitBreaker, faults as _faults
+from .metrics import ServingMetrics
+from .replica import ReplicaLostError
+
+__all__ = ["ReplicaRouter", "PRIORITIES"]
+
+PRIORITIES = ("interactive", "batch", "best_effort")
+# dispatch rank inside replica queues: interactive is served first even
+# when lower classes were admitted ahead of it
+PRIORITY_RANK = {"interactive": 0, "batch": 1, "best_effort": 2}
+
+HEALTHY, SUSPECT, SWAPPING, DEAD = "healthy", "suspect", "swapping", "dead"
+
+
+class _Slot:
+    """Router-side bookkeeping for one replica."""
+
+    def __init__(self, replica, breaker, now):
+        self.replica = replica
+        self.state = HEALTHY
+        self.breaker = breaker
+        self.last_ok = now
+        self.probe_failures = 0    # consecutive
+        self.probes = 0
+        self.deepchecks = 0
+        self.dispatching = 0       # submits claimed but not yet handed
+                                   # to the replica (the swap fence)
+
+
+class _RouterRequest:
+    __slots__ = ("rid", "inputs", "timeout_ms", "priority", "future",
+                 "dispatches", "replica_id", "t0", "lock", "done")
+
+    def __init__(self, rid, inputs, timeout_ms, priority, now):
+        self.rid = rid
+        self.inputs = inputs
+        self.timeout_ms = timeout_ms
+        self.priority = priority
+        self.future = Future()
+        self.future.request_id = rid
+        self.dispatches = 0
+        self.replica_id = None
+        self.t0 = now
+        self.lock = threading.Lock()
+        self.done = False
+
+
+class ReplicaRouter:
+    """Front-end router over `Replica` handles (see module docstring)."""
+
+    def __init__(self, replicas=(), name="router", health_interval_s=None,
+                 health_deadline_s=None, deepcheck_every=None,
+                 max_dispatches=None, shed_ms=None, clock=time.monotonic):
+        from .. import config as _config
+        self.name = str(name)
+        self._clock = clock
+        self.health_interval_s = float(
+            health_interval_s if health_interval_s is not None
+            else _config.get("MXNET_ROUTER_HEALTH_INTERVAL_S"))
+        self.health_deadline_s = float(
+            health_deadline_s if health_deadline_s is not None
+            else _config.get("MXNET_ROUTER_HEALTH_DEADLINE_S"))
+        self.deepcheck_every = int(
+            deepcheck_every if deepcheck_every is not None
+            else _config.get("MXNET_ROUTER_DEEPCHECK_EVERY"))
+        self.max_dispatches = int(
+            max_dispatches if max_dispatches is not None
+            else _config.get("MXNET_ROUTER_MAX_DISPATCHES"))
+        self.shed_ms = dict(shed_ms) if shed_ms is not None else {
+            "best_effort": float(
+                _config.get("MXNET_ROUTER_SHED_BEST_EFFORT_MS")),
+            "batch": float(_config.get("MXNET_ROUTER_SHED_BATCH_MS")),
+            "interactive": float(
+                _config.get("MXNET_ROUTER_SHED_INTERACTIVE_MS"))}
+        self.metrics = ServingMetrics(self.name)
+        self._lock = threading.Lock()
+        self._slots = {}               # replica_id -> _Slot
+        self._inflight = {}            # rid -> _RouterRequest
+        # resolved rids, insertion-ordered so the bounded trim drops the
+        # OLDEST first (the idempotency window must keep recent ids)
+        self._completed = {}           # rid -> True
+        self._completed_cap = 65536
+        self._rid_counter = 0
+        # generated ids live in their own namespace so they can never
+        # collide with a caller-supplied request_id
+        import uuid
+        self._rid_ns = uuid.uuid4().hex[:8]
+        self._swap_lock = threading.Lock()
+        self._closed = threading.Event()
+        # fleet counters
+        self.failovers = 0
+        self.duplicates_suppressed = 0
+        self.replicas_lost = 0
+        self.swaps_committed = 0
+        for r in replicas:
+            self.add_replica(r)
+        self._health_thread = threading.Thread(
+            target=self._health_loop, daemon=True,
+            name=f"mx-router-{self.name}-health")
+        self._health_thread.start()
+
+    # -- fleet membership -----------------------------------------------------
+    def add_replica(self, replica):
+        from .. import config as _config
+        breaker = CircuitBreaker(
+            failure_threshold=int(
+                _config.get("MXNET_SERVING_BREAKER_THRESHOLD")),
+            reset_timeout=float(
+                _config.get("MXNET_SERVING_BREAKER_RESET_S")))
+        with self._lock:
+            if replica.replica_id in self._slots:
+                raise MXNetError(
+                    f"router '{self.name}': duplicate replica id "
+                    f"{replica.replica_id!r}")
+            self._slots[replica.replica_id] = _Slot(replica, breaker,
+                                                    self._clock())
+        return replica
+
+    def remove_replica(self, replica_id, drain=True):
+        with self._lock:
+            slot = self._slots.pop(replica_id, None)
+        if slot is None:
+            raise MXNetError(f"router '{self.name}': no replica "
+                             f"{replica_id!r}")
+        slot.replica.close(drain=drain)
+
+    def replicas(self):
+        with self._lock:
+            return sorted(self._slots)
+
+    # -- dispatch -------------------------------------------------------------
+    def _eligible_locked(self):
+        # state-only filter: checking `breaker.state` (unlike `allow()`)
+        # consumes no half-open probe token, so load estimation never
+        # wedges a breaker.  SUSPECT replicas count: they are still
+        # serving, just not first choice.
+        return [s for s in self._slots.values()
+                if s.state in (HEALTHY, SUSPECT)
+                and s.breaker.state != "open"]
+
+    def _pick(self, exclude=()):
+        """Least-loaded live replica (breaker-aware), or None.  Healthy
+        replicas are preferred; suspect ones (a failed probe inside the
+        liveness deadline) are the fallback tier — a correlated
+        probe-drop burst must degrade PREFERENCE, never availability.
+        Only the chosen slot's `allow()` is consulted — it may consume
+        that breaker's half-open probe token, which the dispatch
+        outcome then settles (success/failure/release)."""
+        with self._lock:
+            cands = [s for s in self._eligible_locked()
+                     if s.replica.replica_id not in exclude]
+        cands.sort(key=lambda s: (s.state != HEALTHY,
+                                  s.replica.outstanding()))
+        for s in cands:
+            if s.breaker.allow():
+                return s
+        return None
+
+    def _fleet_wait_s(self):
+        """The wait a new request faces: the BEST estimated wait among
+        live replicas (that is the queue the request would join)."""
+        with self._lock:
+            slots = self._eligible_locked()
+        waits = [w for s in slots
+                 if (w := s.replica.estimated_wait_s()) is not None]
+        if not waits or len(waits) < len(slots):
+            # any replica without an estimate is assumed free
+            return 0.0 if slots else None
+        return min(waits)
+
+    def submit(self, inputs, timeout_ms=None, priority="interactive",
+               request_id=None):
+        """Route one request; returns a Future resolving to the
+        per-output array list.  ``priority`` picks the shed class;
+        ``request_id`` (optional) is the idempotency key — re-submitting
+        an id the router already completed is rejected."""
+        if self._closed.is_set():
+            raise MXNetError(f"router '{self.name}' is shut down")
+        if priority not in PRIORITIES:
+            raise MXNetError(
+                f"router '{self.name}': unknown priority {priority!r} "
+                f"(one of {', '.join(PRIORITIES)})")
+        # graceful degradation: shed the low classes FIRST when the
+        # fleet cannot keep up — interactive traffic rides out an N-1
+        # fleet because best-effort work was refused admission
+        wait = self._fleet_wait_s()
+        if wait is not None and wait * 1e3 > self.shed_ms[priority]:
+            self.metrics.record_shed(priority)
+            raise MXNetError(
+                f"router '{self.name}': overloaded — estimated fleet "
+                f"wait {wait * 1e3:.0f} ms exceeds the {priority} "
+                f"class's {self.shed_ms[priority]:g} ms shed threshold")
+        with self._lock:
+            self._rid_counter += 1
+            rid = request_id if request_id is not None \
+                else f"{self.name}/{self._rid_ns}-{self._rid_counter}"
+            if rid in self._completed or rid in self._inflight:
+                raise MXNetError(
+                    f"router '{self.name}': request id {rid!r} was "
+                    "already accepted (idempotency: it will not execute "
+                    "twice)")
+            req = _RouterRequest(rid, inputs, timeout_ms, priority,
+                                 self._clock())
+            self._inflight[rid] = req
+        self.metrics.record_request(len(self._inflight))
+        try:
+            self._dispatch(req)
+        except BaseException:
+            # ANY dispatch failure (including non-MXNetError injected
+            # faults) must release the rid, or _inflight leaks and a
+            # caller's retry of the same request_id is refused forever
+            with self._lock:
+                self._inflight.pop(rid, None)
+            raise
+        return req.future
+
+    def predict(self, inputs, timeout_ms=None, priority="interactive",
+                request_id=None):
+        wait = None if timeout_ms is None else timeout_ms / 1e3 + 60
+        return self.submit(inputs, timeout_ms=timeout_ms, priority=priority,
+                           request_id=request_id).result(wait)
+
+    def _dispatch(self, req, exclude=()):
+        while True:
+            slot = self._pick(exclude=exclude)
+            if slot is None:
+                with self._lock:
+                    states = {s.replica.replica_id: s.state
+                              for s in self._slots.values()}
+                raise MXNetError(
+                    f"router '{self.name}': no live replica to dispatch "
+                    f"to (fleet: {states or 'empty'})")
+            with self._lock:
+                if slot.state not in (HEALTHY, SUSPECT):
+                    # state flipped (swap/eviction) between pick and
+                    # claim: hand the probe token back and re-pick
+                    slot.breaker.release_probe()
+                    continue
+                # the swap fence: swap_weights waits for dispatching==0
+                # AFTER going SWAPPING, so no request claimed here can
+                # start executing while parameters are being replaced
+                slot.dispatching += 1
+            break
+        req.dispatches += 1
+        req.replica_id = slot.replica.replica_id
+        try:
+            _faults.fire("router.dispatch", replica=req.replica_id,
+                         rid=req.rid, attempt=req.dispatches)
+            try:
+                inner = slot.replica.submit(req.inputs,
+                                            timeout_ms=req.timeout_ms,
+                                            rid=req.rid,
+                                            priority=PRIORITY_RANK[
+                                                req.priority])
+            except ReplicaLostError:
+                self._on_replica_lost(slot)
+                return self._failover(req, exclude + (req.replica_id,))
+            except MXNetError:
+                # caller/backpressure error from a live replica: it
+                # would fail identically anywhere — surface it, no
+                # failover (but hand back the half-open probe token
+                # `allow()` may have consumed: nothing executed to
+                # settle it)
+                slot.breaker.release_probe()
+                self.metrics.record_class_reject(req.priority)
+                raise
+        finally:
+            with self._lock:
+                slot.dispatching -= 1
+        inner.add_done_callback(
+            lambda fut, req=req, slot=slot: self._on_done(req, slot, fut))
+
+    def _failover(self, req, exclude):
+        if req.dispatches >= self.max_dispatches:
+            self._resolve(req, error=MXNetError(
+                f"router '{self.name}': request {req.rid} failed on "
+                f"{req.dispatches} replica(s) "
+                f"({', '.join(exclude)}) — dispatch budget exhausted"))
+            return
+        with self._lock:
+            self.failovers += 1
+        _faults.note("failover", site="router.dispatch", rid=req.rid,
+                     attempt=req.dispatches + 1)
+        try:
+            self._dispatch(req, exclude=exclude)
+        except MXNetError as exc:
+            self._resolve(req, error=exc)
+
+    def _on_done(self, req, slot, inner):
+        """Completion callback for one dispatch attempt."""
+        try:
+            result = inner.result()
+            err = None
+        except Exception as exc:   # noqa: BLE001 — classified below
+            result, err = None, exc
+        if err is None:
+            slot.breaker.record_success()
+            slot.last_ok = self._clock()
+            self._resolve(req, result=result)
+            return
+        if isinstance(err, ReplicaLostError):
+            # replica death with this request unresolved: fail over —
+            # a dead replica cannot be executing it anymore, and the
+            # completed-rid check keeps an already-answered request
+            # from running again
+            self._on_replica_lost(slot)
+            with req.lock:
+                already = req.done
+            if not already:
+                self._failover(req, (req.replica_id or "",))
+            return
+        slot.breaker.record_failure()
+        self._resolve(req, error=err)
+
+    def _resolve(self, req, result=None, error=None):
+        """Complete the router future exactly once; late duplicates
+        (a replica wrongly presumed dead answering after failover) are
+        counted and dropped — the caller can never observe two
+        results."""
+        with req.lock:
+            if req.done:
+                with self._lock:
+                    self.duplicates_suppressed += 1
+                return
+            req.done = True
+        with self._lock:
+            self._inflight.pop(req.rid, None)
+            self._completed[req.rid] = True
+            while len(self._completed) > self._completed_cap:
+                # bounded, oldest-first: idempotency only needs to
+                # cover the failover horizon, which is recent by nature
+                self._completed.pop(next(iter(self._completed)))
+        try:
+            if error is not None:
+                req.future.set_exception(error)
+            else:
+                req.future.set_result(result)
+                self.metrics.record_response(
+                    self._clock() - req.t0, cls=req.priority)
+        except Exception:
+            pass   # caller cancelled it meanwhile
+
+    # -- health ---------------------------------------------------------------
+    def _on_replica_lost(self, slot):
+        with self._lock:
+            if slot.state == DEAD:
+                return
+            slot.state = DEAD
+            self.replicas_lost += 1
+        _faults.note("replica_lost", site="replica.health",
+                     replica=slot.replica.replica_id)
+        # fail everything it still holds so the failover callbacks fire
+        # now instead of at the transport timeout
+        mark = getattr(slot.replica, "_mark_lost", None)
+        if mark is not None:
+            mark("router declared the replica dead")
+
+    def _health_loop(self):
+        while not self._closed.wait(self.health_interval_s):
+            with self._lock:
+                slots = list(self._slots.values())
+            for slot in slots:
+                if slot.state in (DEAD, SWAPPING):
+                    continue
+                slot.probes += 1
+                deep = self.deepcheck_every > 0 and \
+                    slot.probes % self.deepcheck_every == 0
+                try:
+                    _faults.fire("replica.health",
+                                 replica=slot.replica.replica_id,
+                                 deep=deep)
+                    if deep:
+                        slot.deepchecks += 1
+                        slot.replica.probe()
+                    else:
+                        slot.replica.heartbeat()
+                    slot.last_ok = self._clock()
+                    slot.probe_failures = 0
+                    if slot.state == SUSPECT:
+                        slot.state = HEALTHY
+                except ReplicaLostError:
+                    self._on_replica_lost(slot)
+                except Exception:
+                    # a dropped/failed probe alone NEVER evicts: the
+                    # replica goes suspect (no new work) until either a
+                    # probe lands (healthy) or silence crosses the
+                    # deadline (dead).  Served requests also refresh
+                    # last_ok — a replica busy serving is alive even
+                    # when its probes are being dropped.
+                    slot.probe_failures += 1
+                    if slot.state == HEALTHY:
+                        slot.state = SUSPECT
+                if slot.state != DEAD and \
+                        self._clock() - slot.last_ok > \
+                        self.health_deadline_s:
+                    self._on_replica_lost(slot)
+
+    # -- hot weight swap ------------------------------------------------------
+    def swap_weights(self, checkpoint_dir=None, arg_params=None,
+                     aux_params=None, drain_timeout_s=60.0):
+        """Roll new weights through the fleet, one replica at a time.
+
+        Each replica: out of rotation -> drain in-flight -> swap (zero
+        XLA compiles: same shapes, same programs) -> deepcheck -> back
+        in rotation.  The remaining fleet serves throughout, so zero
+        requests are dropped; each request is served entirely at one
+        weight version.  On any failure the roll ABORTS with a
+        structured error naming swapped vs unswapped replicas — the
+        fleet keeps serving (briefly mixed-version across REPLICAS,
+        never within a request); re-issue to finish the roll.
+        """
+        if not self._swap_lock.acquire(blocking=False):
+            raise MXNetError(
+                f"router '{self.name}': a weight swap is already in "
+                "progress")
+        try:
+            with self._lock:
+                order = [s for s in self._slots.values() if s.state != DEAD]
+            swapped, failed = [], None
+            for slot in order:
+                replica = slot.replica
+                with self._lock:
+                    if slot.state == DEAD:
+                        continue
+                    slot.state = SWAPPING
+                try:
+                    deadline = self._clock() + float(drain_timeout_s)
+                    # drain BOTH the replica's queue and any dispatch
+                    # already claimed before the state flipped to
+                    # SWAPPING (the fence `_dispatch` increments under
+                    # the lock) — nothing may start executing while
+                    # parameters are being replaced
+                    while (replica.outstanding() or slot.dispatching) \
+                            and self._clock() < deadline:
+                        time.sleep(0.002)
+                    if replica.outstanding() or slot.dispatching:
+                        raise MXNetError(
+                            f"replica '{replica.replica_id}' did not "
+                            f"drain within {drain_timeout_s:g}s")
+                    _faults.fire("replica.swap",
+                                 replica=replica.replica_id,
+                                 version=replica.version + 1)
+                    replica.swap(arg_params=arg_params,
+                                 aux_params=aux_params,
+                                 checkpoint_dir=checkpoint_dir)
+                    replica.probe()   # deepcheck before rejoining
+                except ReplicaLostError as exc:
+                    self._on_replica_lost(slot)
+                    failed = (replica.replica_id, exc)
+                    break
+                except Exception as exc:
+                    with self._lock:
+                        if slot.state == SWAPPING:
+                            slot.state = HEALTHY
+                    failed = (replica.replica_id, exc)
+                    break
+                with self._lock:
+                    if slot.state == SWAPPING:
+                        slot.state = HEALTHY
+                    slot.last_ok = self._clock()
+                swapped.append(replica.replica_id)
+            if failed is not None:
+                rid, exc = failed
+                remaining = [s.replica.replica_id for s in order
+                             if s.replica.replica_id not in swapped
+                             and s.replica.replica_id != rid]
+                done_s = ", ".join(swapped) or "none"
+                left_s = ", ".join(remaining) or "none"
+                raise MXNetError(
+                    f"router '{self.name}': weight swap ABORTED at "
+                    f"replica '{rid}': {exc} — swapped [{done_s}], "
+                    f"untouched [{left_s}]; the fleet keeps serving "
+                    "(each request single-version); fix the source and "
+                    "re-issue swap_weights") from exc
+            with self._lock:
+                self.swaps_committed += 1
+            return {"swapped": swapped,
+                    "versions": {s.replica.replica_id: s.replica.version
+                                 for s in order}}
+        finally:
+            self._swap_lock.release()
+
+    # -- observability / lifecycle -------------------------------------------
+    def stats(self):
+        """Router snapshot: fleet counters, per-class latency/shed, and
+        per-replica state."""
+        with self._lock:
+            slots = dict(self._slots)
+            snap = {
+                "router": self.name,
+                "failovers": self.failovers,
+                "duplicates_suppressed": self.duplicates_suppressed,
+                "replicas_lost": self.replicas_lost,
+                "swaps_committed": self.swaps_committed,
+                "inflight": len(self._inflight),
+            }
+        snap.update(self.metrics.snapshot())
+        snap["replicas"] = {
+            rid: {"state": s.state,
+                  "outstanding": (0 if s.state == DEAD
+                                  else s.replica.outstanding()),
+                  "version": s.replica.version,
+                  "breaker": s.breaker.state,
+                  "probes": s.probes,
+                  "deepchecks": s.deepchecks,
+                  "probe_failures": s.probe_failures,
+                  "age_s": round(self._clock() - s.last_ok, 3)}
+            for rid, s in slots.items()}
+        return snap
+
+    def shutdown(self, drain=True):
+        self._closed.set()
+        self._health_thread.join(timeout=10)
+        with self._lock:
+            slots, self._slots = dict(self._slots), {}
+        for slot in slots.values():
+            try:
+                slot.replica.close(drain=drain)
+            except MXNetError:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown(drain=True)

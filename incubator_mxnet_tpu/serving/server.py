@@ -116,15 +116,19 @@ class ModelServer:
         return self._entry(name)[1]
 
     # -- request path --------------------------------------------------------
-    def submit(self, name, inputs, timeout_ms=None):
+    def submit(self, name, inputs, timeout_ms=None, priority=1):
         """Async request: returns a `concurrent.futures.Future` resolving
-        to the per-output NDArray list for exactly this request's rows."""
-        return self._entry(name)[1].submit(inputs, timeout_ms=timeout_ms)
+        to the per-output NDArray list for exactly this request's rows.
+        ``priority`` is the dispatch rank (0 first, 2 last; see
+        `MicroBatcher.submit`)."""
+        return self._entry(name)[1].submit(inputs, timeout_ms=timeout_ms,
+                                           priority=priority)
 
-    def predict(self, name, inputs, timeout_ms=None):
+    def predict(self, name, inputs, timeout_ms=None, priority=1):
         """Sync request through the batching path."""
         wait = None if timeout_ms is None else timeout_ms / 1e3 + 60
-        return self.submit(name, inputs, timeout_ms=timeout_ms).result(wait)
+        return self.submit(name, inputs, timeout_ms=timeout_ms,
+                           priority=priority).result(wait)
 
     # -- observability / lifecycle -------------------------------------------
     def stats(self):

@@ -1,0 +1,261 @@
+"""Replica worker process: one served model behind a transport endpoint.
+
+``python -m incubator_mxnet_tpu.serving.worker --prefix model --epoch 3
+--data-shapes data=1,784 --buckets 1,4,16`` loads the model, warms the
+bucket ladder (from the shared ``MXNET_PROGRAM_CACHE_DIR`` disk tier
+when one is configured — replica fleet spin-up is then zero-compile),
+prints ``REPLICA_PORT <n>`` / ``REPLICA_READY`` on stdout, and serves
+the replica control protocol over the same length-prefixed frames as
+the parameter server:
+
+* ``infer``  — run one request through the bucket ladder.  Deduplicated
+  by the ROUTER's request id: a resend of an rid this worker already
+  executed replays the cached outputs instead of executing twice (the
+  router's no-duplicate-execution guarantee at the worker boundary).
+* ``hb``     — cheap liveness + load (`outstanding`, weight `version`).
+* ``probe``  — deepcheck: a real bucket-1 inference.
+* ``swap``   — reload parameters from the newest valid checkpoint under
+  a directory; same shapes, same programs, zero XLA compiles.
+* ``stats``  — metrics snapshot + executed-rid diagnostics (bounded).
+* ``stop``   — drain and exit.
+
+The handler is deliberately single-model and thread-per-connection
+(`ThreadingTCPServer`): the router owns spreading and batching policy;
+a worker just executes.
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import os
+import socketserver
+import sys
+import threading
+
+import numpy as _np
+
+from .model import ServedModel
+
+__all__ = ["ReplicaWorker", "main"]
+
+
+class ReplicaWorker:
+    """The serving loop around one `ServedModel`."""
+
+    def __init__(self, model, host="127.0.0.1", port=0, dedup_window=16384):
+        self.model = model
+        self.version = 0
+        self._lock = threading.Lock()
+        self._outstanding = 0
+        self._executed = 0
+        self._dedup_hits = 0
+        # rid -> outputs, bounded: the idempotency window only needs to
+        # cover the router's failover horizon, not a week of traffic
+        self._done = collections.OrderedDict()
+        self._done_cap = int(dedup_window)
+        self._executed_rids = collections.deque(maxlen=self._done_cap)
+        # rid -> Event for executions still in flight: a transport
+        # resend of a rid the worker is CURRENTLY executing must wait
+        # and replay, not execute a second time
+        self._running = {}
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                from ..dist.transport import recv_msg, send_msg
+                while True:
+                    try:
+                        msg = recv_msg(self.request)
+                    except (EOFError, ConnectionError, OSError):
+                        break
+                    try:
+                        reply = outer._handle(msg)
+                    except Exception as exc:
+                        reply = {"error": f"replica dispatch failed: "
+                                          f"{exc}", "seq": msg.get("seq")}
+                    try:
+                        send_msg(self.request, reply)
+                    except (ConnectionError, OSError):
+                        break
+                    if msg.get("cmd") == "stop":
+                        os._exit(0)
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = None
+
+    # -- command dispatch ----------------------------------------------------
+    def _handle(self, msg):
+        cmd = msg.get("cmd")
+        seq = msg.get("seq")
+        if cmd == "infer":
+            return dict(self._infer(msg), seq=seq)
+        if cmd == "hb":
+            with self._lock:
+                out = {"ok": True, "outstanding": self._outstanding,
+                       "version": self.version}
+            return dict(out, seq=seq)
+        if cmd == "probe":
+            model = self.model
+            inputs = [_np.zeros((1,) + model._sample_shapes[n],
+                                model._dtype) for n in model.data_names]
+            model.infer(inputs)
+            return {"ok": True, "programs": model.program_count(),
+                    "version": self.version, "seq": seq}
+        if cmd == "swap":
+            # the `replica.swap` fault site fires ROUTER-side (it covers
+            # local and remote replicas uniformly); the worker just
+            # executes the reload
+            from .replica import _load_checkpoint_params
+            args, auxs = _load_checkpoint_params(msg["checkpoint_dir"])
+            self.model.set_params(args, auxs)
+            self.version += 1
+            return {"ok": True, "version": self.version,
+                    "programs": self.model.program_count(), "seq": seq}
+        if cmd == "stats":
+            from .. import compile as _compile
+            try:
+                cache = _compile.stats()["counters"]
+            except Exception:
+                cache = None
+            with self._lock:
+                return {"ok": True, "executed": self._executed,
+                        "dedup_hits": self._dedup_hits,
+                        "version": self.version,
+                        "programs": self.model.program_count(),
+                        "executed_rids": list(self._executed_rids),
+                        "cache": cache,
+                        "seq": seq}
+        if cmd == "stop":
+            return {"ok": True, "seq": seq}
+        return {"error": f"replica worker: unknown cmd {cmd!r}", "seq": seq}
+
+    def _infer(self, msg):
+        rid = msg.get("rid")
+        while True:
+            with self._lock:
+                if rid is not None and rid in self._done:
+                    # idempotent resend: replay, never re-execute
+                    self._dedup_hits += 1
+                    return {"ok": True, "outs": self._done[rid],
+                            "deduped": True}
+                running = self._running.get(rid) \
+                    if rid is not None else None
+                if running is None:
+                    if rid is not None:
+                        self._running[rid] = threading.Event()
+                    self._outstanding += 1
+                    break
+            # a resend raced a still-executing first copy: wait for it
+            # and replay its result (re-checking — if the first attempt
+            # FAILED, this one takes over and executes)
+            running.wait(timeout=600)
+        try:
+            outs = self.model.infer(msg["inputs"])
+            outs = [o.asnumpy() for o in outs]
+        except Exception:
+            with self._lock:
+                self._outstanding -= 1
+                ev = self._running.pop(rid, None)
+            if ev is not None:
+                ev.set()   # a waiting resend retries the execution
+            raise
+        with self._lock:
+            self._outstanding -= 1
+            self._executed += 1
+            if rid is not None:
+                self._executed_rids.append(rid)
+                self._done[rid] = outs
+                while len(self._done) > self._done_cap:
+                    self._done.popitem(last=False)
+                ev = self._running.pop(rid, None)
+            else:
+                ev = None
+        if ev is not None:
+            ev.set()
+        return {"ok": True, "outs": outs}
+
+    def serve_forever(self):
+        self._server.serve_forever(poll_interval=0.1)
+
+    def start(self):
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def _parse_shapes(spec):
+    shapes = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, dims = part.partition("=")
+        shapes.append((name, tuple(int(d) for d in dims.split(",") if d)))
+    if not shapes:
+        raise SystemExit("worker: --data-shapes required "
+                         "(name=d0,d1[;name=...])")
+    return shapes
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="serving.worker", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--name", default="model")
+    ap.add_argument("--prefix", default=None,
+                    help="classic checkpoint pair prefix")
+    ap.add_argument("--epoch", type=int, default=0)
+    ap.add_argument("--symbol-file", default=None)
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="elastic checkpoint dir (needs --symbol-file)")
+    ap.add_argument("--data-shapes", required=True,
+                    metavar="name=d0,d1[;name=...]")
+    ap.add_argument("--buckets", default="1,2,4,8")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--host", default="127.0.0.1")
+    args = ap.parse_args(argv)
+
+    shapes = _parse_shapes(args.data_shapes)
+    buckets = tuple(int(b) for b in args.buckets.split(",") if b)
+    common = dict(data_shapes=shapes, buckets=buckets, name=args.name)
+    if args.prefix is not None:
+        model = ServedModel.load(args.prefix, args.epoch, **common)
+    elif args.checkpoint_dir is not None:
+        if args.symbol_file is None:
+            raise SystemExit("worker: --checkpoint-dir needs --symbol-file")
+        model = ServedModel.from_checkpoint_dir(
+            args.symbol_file, args.checkpoint_dir, **common)
+    else:
+        raise SystemExit("worker: --prefix or --checkpoint-dir required")
+
+    worker = ReplicaWorker(model, host=args.host, port=args.port)
+    print("REPLICA_PORT %d" % worker.port, flush=True)
+    # warm AFTER the port is known so a spawning router can already
+    # connect; with a shared MXNET_PROGRAM_CACHE_DIR the ladder loads
+    # from disk — zero XLA compiles for every replica after the first
+    model.warmup()
+    from .. import compile as _compile
+    try:
+        c = _compile.stats()["counters"]
+        cache_note = " compiles=%d disk_hits=%d" % (c["compiles"],
+                                                    c["disk_hits"])
+    except Exception:
+        cache_note = ""
+    print("REPLICA_READY programs=%d%s" % (model.program_count(),
+                                           cache_note), flush=True)
+    worker.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,6 +25,13 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long multi-subprocess tests excluded from the "
+        "tier-1 run (-m 'not slow'); tools/run_chaos.py --serving covers "
+        "the same contracts as a gated artifact")
+
+
 @pytest.fixture(autouse=True)
 def _seeded():
     """Seed numpy + framework RNG per test (reference `with_seed()` decorator)."""

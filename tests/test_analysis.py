@@ -387,6 +387,47 @@ def test_bare_except_lint_fixtures():
     assert not analysis.check_source(sup, "s.py").findings
 
 
+def test_router_bypass_lint_fixtures():
+    """ISSUE-8 satellite: direct ServedModel.infer / ModelServer use in
+    a script that configures a ReplicaRouter bypasses failover + QoS."""
+    bad = (
+        "import incubator_mxnet_tpu as mx\n"                        # 1
+        "router = mx.serving.ReplicaRouter(reps)\n"                 # 2
+        "m = mx.serving.ServedModel(sym, a, x, data_shapes=ds)\n"   # 3
+        "out = m.infer({'data': batch})\n"                          # 4
+        "srv = mx.serving.ModelServer()\n"                          # 5
+        "y = mx.serving.ServedModel.load('p', 0).infer(batch)\n"    # 6
+    )
+    report = analysis.check_source(bad, "bypass.py")
+    locs = sorted(f.location for f in report if f.code == "router-bypass")
+    assert locs == ["bypass.py:4", "bypass.py:5", "bypass.py:6"]
+    assert "failover" in next(
+        f.message for f in report if f.code == "router-bypass")
+
+    # the SAME direct calls in a router-less script are fine (serving a
+    # single model without a fleet is a legitimate topology) ...
+    ok = (
+        "import incubator_mxnet_tpu as mx\n"
+        "m = mx.serving.ServedModel(sym, a, x, data_shapes=ds)\n"
+        "out = m.infer({'data': batch})\n"
+        "srv = mx.serving.ModelServer()\n"
+    )
+    assert not [f for f in analysis.check_source(ok, "ok.py")
+                if f.code == "router-bypass"]
+    # ... routed traffic is fine, and suppression is honored
+    routed = (
+        "import incubator_mxnet_tpu as mx\n"
+        "router = mx.serving.ReplicaRouter(reps)\n"
+        "out = router.predict({'data': batch})\n"
+    )
+    assert not analysis.check_source(routed, "routed.py").findings
+    sup = (
+        "router = ReplicaRouter(reps)\n"
+        "srv = ModelServer()  # mxlint: disable=router-bypass\n"
+    )
+    assert not analysis.check_source(sup, "s.py").findings
+
+
 def test_mxlint_cli_examples_zero_findings_and_seeded_defects(tmp_path,
                                                               capsys):
     import importlib

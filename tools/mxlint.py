@@ -7,7 +7,10 @@ Front ends (analysis/ package):
   outputs, aux races, f64 promotion, unbound inputs, TPU tile hints;
 * python scripts     — AST lints: `.asnumpy()`/`.asscalar()`/
   `.wait_to_read()`/`waitall()` inside loops (host-sync-in-loop),
-  literal ``kvstore='local'`` in TPU scripts.
+  literal ``kvstore='local'`` in TPU scripts, unbounded retry loops,
+  swallowing excepts, unsupervised collectives, and direct
+  `ServedModel.infer`/`ModelServer` use in router-configured scripts
+  (router-bypass).
 
 Usage:
     python tools/mxlint.py PATH [PATH ...]

@@ -21,12 +21,27 @@ one hung collective (the watchdog must convert the stall into a
 `JobSupervisor.stats()` dict — heartbeats, watchdog timeouts, hosts
 lost, and the PR 5 kvstore retry/breaker counters.
 
-Usage: python tools/run_chaos.py [--quick] [--pod] [--json] [--out PATH]
+Serving mode (``--serving``) runs the MULTI-REPLICA schedules over a
+real `ReplicaRouter` fronting three subprocess replica workers (spawned
+with a shared program-cache dir, so replicas 2-3 must spin up with ZERO
+XLA compiles): one worker SIGKILLed mid-flight (zero accepted requests
+lost, zero duplicate executions — certified from the survivors'
+executed-rid logs), a health-probe drop burst (suspicion, never a false
+eviction), a full rolling weight-swap under traffic (zero dropped
+requests, zero post-warmup compiles — certified via worker compile-
+cache stats), and a torn swap (clean abort, fleet keeps serving,
+re-issue completes).  The artifact is ``CHAOS_SERVING.json``.
+
+Usage: python tools/run_chaos.py [--quick] [--pod] [--serving] [--json]
+                                 [--out PATH]
     --quick   bounded test selection (the run_tpu_parity.py stage)
     --pod     run the elastic pod schedules (writes CHAOS_POD.json)
+    --serving run the multi-replica router schedules
+              (writes CHAOS_SERVING.json)
     --json    print only the JSON artifact on stdout
     --out     also write the artifact to PATH (default CHAOS_REPORT.json,
-              or CHAOS_POD.json with --pod)
+              CHAOS_POD.json with --pod, CHAOS_SERVING.json with
+              --serving)
 
 Exit status: 0 when every schedule's tests passed.
 """
@@ -41,6 +56,7 @@ import socket
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -304,13 +320,243 @@ def run_pod(as_json=False, out_path=None):
     return 0 if artifact["all_passed"] else 1
 
 
+# -- serving schedules: the replica router under sabotage ---------------------
+# a real 3-replica fleet (subprocess workers) behind an in-process
+# ReplicaRouter; router-side faults are seeded so every run replays the
+# same story.  Each schedule returns the acceptance verdicts the README
+# failure matrix promises.
+
+def _serving_fleet(tmp, n=3, buckets=(1, 2, 4)):
+    """(router, replicas, model artifacts) — a spawned remote fleet
+    warming from one shared program-cache dir."""
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import sym, io
+    np.random.seed(0)
+    net = sym.Variable("data")
+    net = sym.FullyConnected(net, num_hidden=64, name="fc0")
+    net = sym.Activation(net, act_type="tanh")
+    net = sym.FullyConnected(net, num_hidden=8, name="head")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[io.DataDesc("data", (4, 16))],
+             label_shapes=[io.DataDesc("softmax_label", (4,))],
+             for_training=False, grad_req="null")
+    mod.init_params(mx.initializer.Xavier())
+    prefix = os.path.join(tmp, "m")
+    mod.save_checkpoint(prefix, 0)
+    env = {"MXNET_PROGRAM_CACHE_DIR": os.path.join(tmp, "pcache"),
+           "JAX_PLATFORMS": "cpu"}
+    reps = [mx.serving.RemoteReplica.spawn(
+        prefix=prefix, epoch=0, data_shapes=[("data", (1, 16))],
+        buckets=buckets, name="m", replica_id="w%d" % i, env=env)
+        for i in range(n)]
+    router = mx.serving.ReplicaRouter(reps, health_interval_s=0.2,
+                                      health_deadline_s=3.0)
+    return router, reps, (mod, prefix)
+
+
+def _drive_router(router, n_threads=4, per=40, kill_at=None,
+                  kill_fn=None, priority="interactive", timeout_ms=30000):
+    """Closed-loop traffic; optionally fire `kill_fn` once `kill_at`
+    requests were accepted.  Returns (ok_count, errors)."""
+    results, errors = [], []
+    accepted = [0]
+    fired = [False]
+    lock = threading.Lock()
+
+    def client():
+        for _ in range(per):
+            try:
+                f = router.submit({"data": _drive_router._x},
+                                  timeout_ms=timeout_ms,
+                                  priority=priority)
+                with lock:
+                    accepted[0] += 1
+                    if kill_at is not None and accepted[0] == kill_at \
+                            and not fired[0]:
+                        fired[0] = True
+                        kill_fn()
+                results.append(f.result(60))
+            except Exception as exc:   # a lost request is the FINDING
+                errors.append(repr(exc))
+
+    import numpy as np
+    _drive_router._x = np.random.default_rng(5).standard_normal(
+        (2, 16)).astype(np.float32)
+    threads = [threading.Thread(target=client) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return len(results), errors
+
+
+def _survivor_rids(reps, skip=()):
+    rids = []
+    for r in reps:
+        if r.replica_id in skip:
+            continue
+        rids += r.stats().get("executed_rids", [])
+    return rids
+
+
+def run_serving_schedule(name, tmp, quiet=False):
+    """One serving schedule; returns a result dict with `passed`."""
+    from incubator_mxnet_tpu.resilience import faults as _f
+    import incubator_mxnet_tpu as mx
+    t0 = time.time()
+    checks = {}
+    router, reps, (mod, prefix) = _serving_fleet(tmp)
+    try:
+        # zero-compile fleet spin-up evidence (all schedules)
+        checks["spinup_zero_compiles"] = all(
+            r.ready_info.get("compiles") == 0 for r in reps[1:])
+        if name == "replica-kill":
+            _f.configure("seed=41")   # trace/log only; the kill is real
+            ok, errors = _drive_router(router, kill_at=60,
+                                       kill_fn=reps[1].kill)
+            rids = _survivor_rids(reps, skip=("w1",))
+            st = router.stats()
+            checks.update(
+                zero_lost=(ok == 160 and not errors),
+                zero_duplicate_execution=(len(rids) == len(set(rids))
+                                          and st["duplicates_suppressed"]
+                                          == 0),
+                replica_declared_dead=(st["replicas_lost"] == 1),
+                failovers=st["failovers"])
+        elif name == "probe-drop-burst":
+            _f.configure("seed=42;replica.health:drop(at=2-6)")
+            ok, errors = _drive_router(router, per=30)
+            time.sleep(1.0)   # let the probe schedule play out
+            st = router.stats()
+            drops = [e for e in _f.trace()
+                     if e.get("site") == "replica.health"]
+            checks.update(
+                zero_lost=(ok == 120 and not errors),
+                drops_fired=(len(drops) >= 3),
+                no_false_eviction=(st["replicas_lost"] == 0))
+        elif name in ("rolling-swap", "torn-swap"):
+            args, auxs = mod.get_params()
+            ckroot = os.path.join(tmp, "ckpts-" + name)
+            mgr = mx.checkpoint.CheckpointManager(ckroot,
+                                                  async_snapshots=False)
+            arrays = {"arg:%s" % k: v.asnumpy() * 2.0
+                      for k, v in args.items()}
+            arrays.update({"aux:%s" % k: v.asnumpy()
+                           for k, v in auxs.items()})
+            mgr.snapshot(arrays=arrays, step=1)
+            mgr.close()
+            if name == "torn-swap":
+                _f.configure("seed=43;replica.swap:torn(at=2)")
+            else:
+                _f.configure("seed=44")
+            base = [r.stats() for r in reps]
+            swap_err = [None]
+
+            def do_swap():
+                try:
+                    router.swap_weights(checkpoint_dir=ckroot)
+                except Exception as exc:
+                    swap_err[0] = repr(exc)
+
+            swapper = threading.Thread(target=do_swap)
+            swapper.start()
+            ok, errors = _drive_router(router, per=30)
+            swapper.join(120)
+            if name == "torn-swap":
+                # the roll must ABORT cleanly with the fleet serving;
+                # clearing the fault and re-issuing finishes it
+                checks["aborted_cleanly"] = (
+                    swap_err[0] is not None and "ABORTED" in swap_err[0])
+                _f.configure("seed=44")
+                router.swap_weights(checkpoint_dir=ckroot)
+            else:
+                checks["swap_completed"] = swap_err[0] is None
+            after = [r.stats() for r in reps]
+            versions = [s.get("version") for s in after]
+            compiles = [
+                (s.get("cache") or {}).get("compiles", 0) -
+                (b.get("cache") or {}).get("compiles", 0)
+                for b, s in zip(base, after)]
+            checks.update(
+                zero_lost=(ok == 120 and not errors),
+                all_swapped=(all(v and v >= 1 for v in versions)),
+                zero_swap_compiles=(all(c == 0 for c in compiles)),
+                # the compiled ladder is untouched by the swap (the
+                # program-count face of the recompile-auditor claim)
+                programs_stable=(all(s.get("programs") == 3
+                                     for s in after)),
+                versions=versions)
+        else:
+            raise ValueError("unknown serving schedule %r" % name)
+        errs = errors[:5] if errors else []
+    finally:
+        try:
+            router.shutdown(drain=False)
+        except Exception:
+            pass
+        for r in reps:
+            try:
+                r.kill()
+            except Exception:
+                pass
+        _f.clear()
+    bools = [v for v in checks.values() if isinstance(v, bool)]
+    result = {
+        "schedule": name,
+        "checks": checks,
+        "errors": errs,
+        "duration_s": round(time.time() - t0, 1),
+        "passed": bool(bools) and all(bools),
+    }
+    if not quiet:
+        print("chaos[serving/%s]: passed=%s checks=%s (%.1fs)" %
+              (name, result["passed"], checks, result["duration_s"]),
+              file=sys.stderr)
+    return result
+
+
+def run_serving(as_json=False, out_path=None):
+    runs = []
+    for name in ("replica-kill", "probe-drop-burst", "rolling-swap",
+                 "torn-swap"):
+        tmp = tempfile.mkdtemp(prefix="chaos-serving-%s-" % name)
+        try:
+            runs.append(run_serving_schedule(name, tmp, quiet=as_json))
+        except Exception as exc:
+            runs.append({"schedule": name, "passed": False,
+                         "error": repr(exc)})
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    artifact = {
+        "schedules": runs,
+        "all_passed": all(r["passed"] for r in runs),
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(artifact, f, indent=1)
+    if as_json:
+        print(json.dumps(artifact))
+    else:
+        print("chaos serving: %d schedule(s), all_passed=%s -> %s" %
+              (len(runs), artifact["all_passed"], out_path))
+    return 0 if artifact["all_passed"] else 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="run_chaos", description=__doc__)
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--pod", action="store_true")
+    ap.add_argument("--serving", action="store_true")
     ap.add_argument("--json", action="store_true", dest="as_json")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
+    if args.serving:
+        out = args.out if args.out is not None \
+            else os.path.join(REPO, "CHAOS_SERVING.json")
+        sys.path.insert(0, REPO)
+        return run_serving(as_json=args.as_json, out_path=out)
     if args.pod:
         out = args.out if args.out is not None \
             else os.path.join(REPO, "CHAOS_POD.json")

@@ -154,6 +154,27 @@ def chaos_pod_stage():
         return {"error": f"chaos pod stage failed: {exc!r}"}
 
 
+def chaos_serving_stage():
+    """Multi-replica serving stage: run tools/run_chaos.py --serving in
+    a throwaway process — a real 3-replica router fleet under a
+    SIGKILLed worker, a probe-drop burst, a rolling weight-swap, and a
+    torn swap — and attach its CHAOS_SERVING artifact (per-schedule
+    checks: zero lost, zero duplicate executions, no false eviction,
+    zero-compile spin-up and swap) to the round.  The serving
+    availability claims become checkable evidence next to the parity
+    outcomes."""
+    cmd = [sys.executable, os.path.join(REPO, "tools", "run_chaos.py"),
+           "--serving", "--json", "--out", ""]
+    try:
+        out = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                             timeout=1800)
+        summary = json.loads(out.stdout)
+        summary["rc"] = out.returncode
+        return summary
+    except Exception as exc:
+        return {"error": f"chaos serving stage failed: {exc!r}"}
+
+
 def coldstart_stage():
     """Cold-start stage: the warmup CLI's built-in probe, run cold then
     warm in fresh subprocesses (tools/warmup.py coldstart_probe) — the
@@ -190,6 +211,7 @@ def main():
         "serving": serving_stage(),
         "chaos": chaos_stage(),
         "chaos_pod": chaos_pod_stage(),
+        "chaos_serving": chaos_serving_stage(),
         "coldstart": coldstart_stage(),
         "cmd": " ".join(cmd[2:]),
         "tests": tests[:500],
