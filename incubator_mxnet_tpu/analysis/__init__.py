@@ -18,7 +18,11 @@ Two front ends over one Finding/Report currency (findings.py):
     `wait_to_read` calls inside `Module.fit` / `Trainer.step` loops to
     the source line that asked for them;
   - source_lint.py: the same hazards found statically in a script's AST
-    (the CLI's `.py` front end).
+    (the CLI's `.py` front end);
+  - tsan.py + locks.py: the MXNET_TSAN=1 concurrency sanitizer — lock-
+    order deadlock detection over the `analysis.locks` shims, lockset
+    race attribution on registered shared state, blocking-call and
+    thread-lifecycle audits (rendered by `mxlint --tsan-report`).
 
 Runtime passes activate with ``MXNET_ANALYSIS=1`` (or
 `analysis.enable()`); collected findings are read via
@@ -102,6 +106,9 @@ def runtime_report():
         report.extend(_supervisor.findings())
     except Exception:
         pass
+    from . import tsan as _tsan
+    if _tsan.enabled():
+        report.extend(_tsan.findings())
     return report
 
 
@@ -113,3 +120,5 @@ def reset_runtime():
         _supervisor.reset_findings()
     except Exception:
         pass
+    from . import tsan as _tsan
+    _tsan.reset()

@@ -24,15 +24,15 @@ check runs only inside exception handlers).
 """
 from __future__ import annotations
 
-import threading
 import weakref
 
 from ..base import MXNetError
+from . import locks as _locks
 
 __all__ = ["record", "explain", "consumed", "raise_if_consumed",
            "any_deleted", "is_deleted"]
 
-_lock = threading.Lock()
+_lock = _locks.make_lock("analysis.donation")
 # id(jax.Array) -> (weakref to the array, owner name, step description).
 # The weakref's callback removes the entry, so ids never dangle onto a
 # recycled object.

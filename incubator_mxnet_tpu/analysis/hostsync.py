@@ -20,6 +20,7 @@ import sys
 import threading
 
 from .findings import Finding, WARN
+from . import locks as _locks
 
 __all__ = ["hot_loop", "note", "findings", "reset", "active"]
 
@@ -30,7 +31,7 @@ _SKIP_SUFFIXES = (os.path.join("ndarray", "ndarray.py"), "engine.py",
                   os.path.join("analysis", "hostsync.py"))
 
 _tls = threading.local()
-_lock = threading.Lock()
+_lock = _locks.make_lock("analysis.hostsync")
 _findings = {}  # (kind, file, line) -> Finding
 
 # module-level fast-path flag: NDArray.asnumpy checks this before paying

@@ -1,0 +1,53 @@
+"""The audited lock-construction idiom (`mxtsan`'s instrumentation shims).
+
+Every lock, rlock, and condition in this codebase is built through this
+module instead of `threading` directly::
+
+    from ..analysis import locks as _locks
+    self._lock = _locks.make_lock("serving.batcher")
+    self._cond = _locks.make_condition(name="dist.membership")
+
+With ``MXNET_TSAN`` unset (the default) each factory returns the plain
+`threading` object — byte-identical hot paths, zero overhead, nothing
+imported beyond this three-function module.  With the sanitizer on
+(``MXNET_TSAN=1`` or `analysis.tsan.enable()`) the factories return
+`tsan` wrappers that feed the process-wide lock-acquisition-order graph
+(deadlock detection), the per-access locksets (race attribution), and
+the contended-lock set (blocking-call findings).
+
+The `name` is the lock's node in the order graph; instances constructed
+with the same name share a node (a pool of per-request locks is one
+hazard class, not ten thousand).  Name by subsystem:
+``"serving.router"``, ``"dist.membership"``, ``"compile.cache"``.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["make_lock", "make_rlock", "make_condition"]
+
+
+def make_lock(name=None):
+    """A `threading.Lock`, instrumented when the sanitizer is on."""
+    from . import tsan
+    if tsan.enabled():
+        return tsan.TsanLock(name)
+    return threading.Lock()
+
+
+def make_rlock(name=None):
+    """A `threading.RLock`, instrumented when the sanitizer is on."""
+    from . import tsan
+    if tsan.enabled():
+        return tsan.TsanRLock(name)
+    return threading.RLock()
+
+
+def make_condition(lock=None, name=None):
+    """A `threading.Condition`.  Pass a lock built by `make_lock` to
+    share it (the batcher's lock+condition pair), or just a `name` for a
+    standalone condition whose internal lock joins the order graph."""
+    from . import tsan
+    if tsan.enabled():
+        return tsan.make_condition(lock=lock, name=name)
+    return threading.Condition(lock)

@@ -13,13 +13,12 @@ state); findings surface through `analysis.runtime_report()`.
 """
 from __future__ import annotations
 
-import threading
-
 from .findings import Finding, WARN
+from . import locks as _locks
 
 __all__ = ["note", "register", "findings", "signatures", "reset"]
 
-_lock = threading.Lock()
+_lock = _locks.make_lock("analysis.recompile")
 _seen = {}       # key -> list of signatures in first-seen order
 _findings = []
 _MAX_SIGS = 64   # per program; beyond this something is deeply wrong

@@ -41,6 +41,26 @@ walking a script's AST:
   jit/pjit/shard_map-decorated function) are XLA's business and are not
   flagged.
 
+Concurrency lints (the static half of the mxtsan tier; ``mxlint
+--tsan-report`` runs exactly this subset over the package):
+
+* ``unnamed-thread`` — a ``threading.Thread(...)`` constructed without
+  ``name=``: sanitizer findings, resilience-fault JSONL events, and
+  profiler trace events all attribute by thread name; an anonymous
+  ``Thread-7`` in a chaos artifact is unactionable.
+* ``bare-acquire`` — a statement-level ``lock.acquire()``: no ``with``
+  scope means any exception between acquire and release leaks the lock
+  (and the sanitizer cannot pair the sites).  Try-acquires whose result
+  is consumed (``if lock.acquire(blocking=False):``) are fine.
+* ``sleep-under-lock`` — ``time.sleep`` lexically inside a ``with``
+  block whose context names a lock/condition: every thread queued on
+  that lock waits the sleep out too.
+* ``unjoined-thread-in-init`` — a class whose ``__init__`` (or
+  ``start``-named method) starts a ``Thread`` but that registers no
+  lifecycle method (``close``/``stop``/``shutdown``/``kill``/
+  ``join``/``reset``/``__exit__``/``__del__``): nothing can ever join
+  the worker, so it leaks by construction.
+
 Suppression: append ``# mxlint: disable`` (everything on the line) or
 ``# mxlint: disable=<code>[,<code>...]`` to the offending line.
 """
@@ -51,7 +71,13 @@ import re
 
 from .findings import Finding, Report, WARN
 
-__all__ = ["scan_source", "scan_file"]
+__all__ = ["scan_source", "scan_file", "CONCURRENCY_CODES"]
+
+# the static half of the mxtsan tier: `mxlint --tsan-report` restricts
+# its package sweep to exactly these codes
+CONCURRENCY_CODES = frozenset({"unnamed-thread", "bare-acquire",
+                               "sleep-under-lock",
+                               "unjoined-thread-in-init"})
 
 _SYNC_METHODS = {"asnumpy", "asscalar", "item", "wait_to_read"}
 _SYNC_FREE = {"waitall"}
@@ -84,7 +110,18 @@ _PASS_BY_CODE = {"host-sync-in-loop": "source.hostsync",
                  "unbounded-retry": "source.retry",
                  "bare-except": "source.except",
                  "unsupervised-collective": "source.supervisor",
-                 "router-bypass": "source.router"}
+                 "router-bypass": "source.router",
+                 "unnamed-thread": "source.thread",
+                 "bare-acquire": "source.locks",
+                 "sleep-under-lock": "source.locks",
+                 "unjoined-thread-in-init": "source.thread"}
+
+# identifiers that mark a with-scope as a critical section for the
+# sleep-under-lock lint (token substrings of the context expression)
+_LOCKISH = ("lock", "mutex", "cond", "idle")
+# lifecycle methods that make a thread-starting class joinable
+_LIFECYCLE_METHODS = {"close", "stop", "shutdown", "kill", "join",
+                      "reset", "__exit__", "__del__"}
 
 
 def _suppressed(lines, lineno, code):
@@ -112,6 +149,7 @@ class _Visitor(ast.NodeVisitor):
                                      # a router is configured
         self.supervised_depth = 0  # inside a supervisor/watchdog `with`
         self.device_depth = 0      # inside a jit/pjit/shard_map function
+        self.lock_with_depth = 0   # inside a `with <lock-ish>:` block
 
     # -- loops ---------------------------------------------------------------
     def _loop(self, node):
@@ -235,13 +273,60 @@ class _Visitor(ast.NodeVisitor):
             any(_supervised_name(ident) for ident in
                 self._idents(item.context_expr))
             for item in node.items)
+        lockish = any(
+            any(tok in ident.lower() for tok in _LOCKISH)
+            for item in node.items
+            for ident in self._idents(item.context_expr))
         if supervised:
             self.supervised_depth += 1
+        if lockish:
+            self.lock_with_depth += 1
         self.generic_visit(node)
         if supervised:
             self.supervised_depth -= 1
+        if lockish:
+            self.lock_with_depth -= 1
 
     visit_With = visit_AsyncWith = _visit_with
+
+    # -- classes (thread-lifecycle lint) -------------------------------------
+    def visit_ClassDef(self, node):
+        methods = {n.name for n in node.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+        if not (methods & _LIFECYCLE_METHODS):
+            for fn in node.body:
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                if fn.name != "__init__" and "start" not in fn.name:
+                    continue
+                for sub in ast.walk(fn):
+                    if isinstance(sub, ast.Call) and \
+                            "Thread" in self._idents(sub.func):
+                        self._add(
+                            "unjoined-thread-in-init", sub.lineno,
+                            f"class '{node.name}' starts a Thread in "
+                            f"{fn.name}() but registers no lifecycle "
+                            "method (close/stop/shutdown/join): nothing "
+                            "can ever join this worker, so it leaks by "
+                            "construction — add a close() that joins "
+                            "with a timeout (tsan.join_thread)")
+        self.generic_visit(node)
+
+    # -- statements (bare-acquire lint) --------------------------------------
+    def visit_Expr(self, node):
+        call = node.value
+        if isinstance(call, ast.Call) and \
+                isinstance(call.func, ast.Attribute) and \
+                call.func.attr == "acquire":
+            self._add("bare-acquire", node.lineno,
+                      "statement-level .acquire() without a 'with' "
+                      "scope: any exception before the matching "
+                      "release() leaks the lock and deadlocks the next "
+                      "acquirer — use 'with lock:' (or consume the "
+                      "try-acquire's result)")
+        self.generic_visit(node)
 
     # -- calls ---------------------------------------------------------------
     def _add(self, code, lineno, message):
@@ -270,6 +355,20 @@ class _Visitor(ast.NodeVisitor):
             self._add("host-sync-in-loop", node.lineno,
                       f"{name}() inside a loop drains ALL in-flight work "
                       "every iteration")
+        # -- concurrency lints (the mxtsan static half) ----------------------
+        if name == "Thread" and \
+                not any(kw.arg == "name" for kw in node.keywords):
+            self._add("unnamed-thread", node.lineno,
+                      "threading.Thread(...) without name=: sanitizer "
+                      "findings, resilience-fault JSONL events, and "
+                      "profiler traces attribute by thread name — name "
+                      "it 'mx-<subsystem>-<role>'")
+        if name == "sleep" and self.lock_with_depth > 0:
+            self._add("sleep-under-lock", node.lineno,
+                      "time.sleep() inside a 'with <lock>:' block parks "
+                      "every thread queued on that lock behind the "
+                      "sleep — move the wait outside the critical "
+                      "section (or use Condition.wait with a timeout)")
         if name in _KV_SINKS:
             for kw in node.keywords:
                 if kw.arg in _KV_KEYWORDS and \

@@ -1,0 +1,862 @@
+"""mxtsan — an opt-in runtime concurrency sanitizer (MXNET_TSAN=1).
+
+PRs 4-8 made this a genuinely concurrent system: router health loops,
+replica dispatch threads, MicroBatcher workers, supervisor heartbeat and
+watchdog threads, membership tables, async checkpoint writers.  The bug
+class most likely to take a serving fleet or a pod down — a lock-order
+deadlock, a racy shared counter, a leaked thread wedging shutdown — is
+invisible to mxlint's graph/AST passes because it only exists at
+runtime, between threads.  This module is the runtime half of the
+concurrency tier (the AST half lives in `source_lint`): it watches the
+instrumented primitives that `analysis.locks` hands out and turns
+hazards into ordinary `Finding`s *before* they hang anything.
+
+Four passes, all feeding `analysis.runtime_report()` /
+`tools/mxlint.py --tsan-report`:
+
+* **lock-order graph** (`lock-order-inversion` / `lock-order-cycle`,
+  error) — every instrumented acquire records "lock B taken while
+  holding lock A" edges into one process-wide graph, keyed by lock
+  *name* (instances of the same pool share a node, self-edges are
+  ignored).  A new edge that closes a cycle is a potential deadlock and
+  is reported immediately, naming both locks, both threads, and the two
+  `file:line` acquisition sites — the evidence a hang would never give
+  you.  `MXNET_TSAN_RAISE=1` escalates the finding to an `MXNetError`
+  at the acquisition site.
+
+* **shared-state race attribution** (`shared-state-race`, warn) —
+  objects registered with `instrument(obj, name)` (attribute writes)
+  and dicts built with `shared_dict(name)` (item reads + writes) carry
+  an Eraser-style lockset check: a key starts EXCLUSIVE to its creating
+  thread (initialization writes never report); the first access by a
+  second thread seeds the candidate lockset, every later access
+  intersects its held locks in, and the set going empty with a write
+  involved in the shared epoch is an unsynchronized write/write or
+  write/read pair, reported with both threads and both exact sites.
+  Publish-then-read-only data stays silent; state ordered by
+  happens-before alone (handed across a queue) should not be
+  registered.
+
+* **blocking-call-under-lock** (`blocking-under-lock`, warn) —
+  `time.sleep` and blocking `queue.Queue.get` are patched while the
+  sanitizer is on, and `dist.transport` reports its socket waits via
+  `note_blocking("socket.recv")`; any of them arriving while the
+  calling thread holds an instrumented lock that other threads also
+  take with BLOCKING acquires (contended — a token only ever
+  try-acquired, like a swap-in-progress guard, can never park a
+  waiter) is reported: that is a thread parking itself on a slow call
+  while everyone else queues on the lock.
+
+* **thread lifecycle** (`leaked-thread` / `thread-outlives-close` /
+  `join-no-timeout`, warn) — `threading.Thread.start`/`join` are
+  patched to record creation sites.  `findings()` reports non-daemon
+  threads (created by this repo's code or its tests, never by
+  third-party libraries) still alive and unjoined; `join_thread(t,
+  timeout, owner=...)` is the audited close-path join — a thread that
+  survives it is reported as outliving its owner's `close()`; a
+  package-internal `join()` with no timeout in a drain path is flagged
+  at its call site.
+
+Zero-overhead stance: nothing in this module runs unless
+``MXNET_TSAN=1`` (or `tsan.enable()`).  With the flag unset,
+`analysis.locks.make_lock` returns plain `threading.Lock` objects and
+no patch is installed — the hot paths are byte-identical to the
+pre-sanitizer build.  ``MXNET_TSAN_LOG=path`` dumps findings plus the
+lock-order graph as JSON at process exit (the artifact
+``mxlint --tsan-report`` renders).
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import sys
+import threading
+import time
+
+from .findings import Finding, Report, ERROR, WARN
+
+__all__ = ["enabled", "enable", "disable", "findings", "report", "reset",
+           "dump", "lock_graph", "instrument", "shared_dict",
+           "note_blocking", "join_thread", "TsanLock", "TsanRLock",
+           "make_condition"]
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO_DIR = os.path.dirname(_PKG_DIR)
+# frames inside these files are sanitizer/lock mechanism, not the code
+# under analysis; site attribution walks past them
+_SKIP_BASENAMES = ("tsan.py", "locks.py", "threading.py", "queue.py")
+
+_enabled = None          # tri-state: None = read MXNET_TSAN lazily
+_installed = False
+
+# all sanitizer bookkeeping lives under ONE private raw lock (never an
+# instrumented one: the sanitizer must not sanitize itself)
+_state_lock = threading.Lock()
+_tls = threading.local()
+
+_lock_infos = {}         # name -> _LockInfo (instances share a node)
+_edges = {}              # (a_name, b_name) -> edge record dict
+_adj = {}                # a_name -> set(b_name)
+_accesses = {}           # (state, key) -> {thread_name: {"write"/"read": (held, site)}}
+_threads = {}            # Thread -> {"site", "daemon", "joined"}
+_findings = {}           # dedup key -> Finding
+_MAX_FINDINGS = 512
+_MAX_ACCESS_KEYS = 8192
+_MAX_THREADS = 4096
+
+_orig = {}               # patched callables, for disable()
+
+
+# -- enablement ---------------------------------------------------------------
+
+def enabled():
+    """Whether the sanitizer is active (MXNET_TSAN, read lazily)."""
+    global _enabled
+    if _enabled is None:
+        from .. import config as _config
+        _enabled = bool(_config.get("MXNET_TSAN"))
+        if _enabled:
+            _install()
+    return _enabled
+
+
+def enable():
+    """Turn the sanitizer on programmatically (tests; equivalent to
+    MXNET_TSAN=1 for locks/state created *after* this call)."""
+    global _enabled
+    _enabled = True
+    _install()
+
+
+def disable():
+    """Turn the sanitizer off and remove the blocking/lifecycle patches.
+    Already-instrumented locks keep working (they just stop being
+    created); recorded findings survive until `reset()`."""
+    global _enabled
+    _enabled = False
+    _uninstall()
+
+
+def _raise_on_deadlock():
+    from .. import config as _config
+    try:
+        return bool(_config.get("MXNET_TSAN_RAISE"))
+    except Exception:
+        return False
+
+
+def _install():
+    global _installed
+    with _state_lock:
+        if _installed:
+            return
+        _installed = True
+    import queue as _queue
+    _orig["sleep"] = time.sleep
+    _orig["queue_get"] = _queue.Queue.get
+    _orig["thread_start"] = threading.Thread.start
+    _orig["thread_join"] = threading.Thread.join
+
+    def _sleep(seconds):
+        if seconds and seconds > 0:
+            note_blocking("time.sleep", detail=f"{seconds:g}s")
+        return _orig["sleep"](seconds)
+
+    def _get(self, block=True, timeout=None):
+        if block:
+            note_blocking("queue.get",
+                          detail="no timeout" if timeout is None
+                          else f"timeout={timeout:g}s")
+        return _orig["queue_get"](self, block, timeout)
+
+    def _start(self):
+        with _state_lock:
+            if len(_threads) < _MAX_THREADS:
+                _threads[self] = {"site": _site(), "daemon": self.daemon,
+                                  "joined": False}
+        return _orig["thread_start"](self)
+
+    def _join(self, timeout=None):
+        rec = _threads.get(self)
+        if rec is not None:
+            rec["joined"] = True
+        if timeout is None:
+            site = _site()
+            if _ours(site) and _PKG_DIR in os.path.abspath(
+                    site.rsplit(":", 1)[0]):
+                _add_finding(
+                    "lifecycle", "join-no-timeout", WARN,
+                    f"join() with no timeout on thread "
+                    f"'{self.name}': a wedged thread blocks this "
+                    "shutdown/drain path forever — join with a timeout "
+                    "and surface the leak (tsan.join_thread does both)",
+                    location=site, key=("join-no-timeout", site))
+        return _orig["thread_join"](self, timeout)
+
+    time.sleep = _sleep
+    _queue.Queue.get = _get
+    threading.Thread.start = _start
+    threading.Thread.join = _join
+
+    from .. import config as _config
+    log = _config.get("MXNET_TSAN_LOG")
+    if log:
+        atexit.register(dump, log)
+
+
+def _uninstall():
+    global _installed
+    with _state_lock:
+        if not _installed:
+            return
+        _installed = False
+    import queue as _queue
+    time.sleep = _orig.pop("sleep", time.sleep)
+    if "queue_get" in _orig:
+        _queue.Queue.get = _orig.pop("queue_get")
+    if "thread_start" in _orig:
+        threading.Thread.start = _orig.pop("thread_start")
+    if "thread_join" in _orig:
+        threading.Thread.join = _orig.pop("thread_join")
+
+
+# -- shared helpers -----------------------------------------------------------
+
+def _site():
+    """file:line of the nearest frame outside the sanitizer machinery."""
+    f = sys._getframe(2)
+    while f is not None:
+        base = os.path.basename(f.f_code.co_filename)
+        if base not in _SKIP_BASENAMES:
+            return f"{f.f_code.co_filename}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>:0"
+
+
+def _ours(site):
+    """Whether a site belongs to this repo (package, tests, tools) as
+    opposed to the stdlib or site-packages — third-party threads and
+    joins are not this sanitizer's business."""
+    path = site.rsplit(":", 1)[0]
+    if "site-packages" in path or "dist-packages" in path:
+        return False
+    return os.path.abspath(path).startswith(_REPO_DIR)
+
+
+def _held():
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+    return held
+
+
+def _add_finding(pass_suffix, code, severity, message, location=None,
+                 key=None):
+    with _state_lock:
+        k = key if key is not None else (code, location)
+        f = _findings.get(k)
+        if f is not None:
+            f.count += 1
+            return f
+        if len(_findings) >= _MAX_FINDINGS:
+            return None
+        f = Finding(f"tsan.{pass_suffix}", code, severity, message,
+                    location=location)
+        _findings[k] = f
+        return f
+
+
+# -- lock instrumentation -----------------------------------------------------
+
+class _LockInfo:
+    __slots__ = ("name", "threads", "blocking_threads")
+
+    def __init__(self, name):
+        self.name = name
+        self.threads = set()     # names of threads that ever acquired it
+        # threads that acquired it with blocking=True: a lock only ever
+        # TRY-acquired (a swap-in-progress token, a poll) can never park
+        # a waiter, so it must not feed the blocking-under-lock pass
+        self.blocking_threads = set()
+
+    @property
+    def contended(self):
+        return len(self.blocking_threads) > 1
+
+
+def _register_lock(name):
+    name = name or "anonymous"
+    with _state_lock:
+        info = _lock_infos.get(name)
+        if info is None:
+            info = _lock_infos[name] = _LockInfo(name)
+        return info
+
+
+def _note_acquired(info, reentry=False, blocking=True):
+    """Track one acquisition; returns an error message when this
+    acquisition closed a NEW lock-order cycle and MXNET_TSAN_RAISE is
+    set (the caller releases the lock and raises at the site)."""
+    site = _site()
+    held = _held()
+    tname = threading.current_thread().name
+    with _state_lock:
+        info.threads.add(tname)
+        if blocking:
+            info.blocking_threads.add(tname)
+    err = None
+    if not reentry:
+        for h_info, h_site in held:
+            if h_info.name != info.name:
+                e = _add_edge(h_info, h_site, info, site, tname)
+                err = err or e
+    held.append((info, site))
+    return err
+
+
+def _note_released(info):
+    held = _held()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i][0] is info:
+            del held[i]
+            return
+
+
+def _add_edge(a_info, a_site, b_info, b_site, tname):
+    """Record lock-order edge a -> b; closing a cycle is a potential
+    deadlock, reported (and optionally raised) at this acquisition."""
+    a, b = a_info.name, b_info.name
+    with _state_lock:
+        if (a, b) in _edges:
+            _edges[(a, b)]["count"] += 1
+            return
+        _edges[(a, b)] = {"from": a, "to": b, "thread": tname,
+                          "held_at": a_site, "acquired_at": b_site,
+                          "count": 1}
+        _adj.setdefault(a, set()).add(b)
+        # does b already reach a?  DFS over the name-level graph
+        path = _find_path(b, a)
+    if path is None:
+        return None
+    path = path + [a]   # the full cycle's node list (b ... a)
+    if len(path) == 2:
+        other = _edges.get((b, a), {})
+        msg = (f"lock-order inversion between '{a}' and '{b}': thread "
+               f"'{tname}' acquires '{b}' at {b_site} while holding "
+               f"'{a}' (taken at {a_site}), but thread "
+               f"'{other.get('thread', '?')}' acquires '{a}' at "
+               f"{other.get('acquired_at', '?')} while holding '{b}' "
+               f"(taken at {other.get('held_at', '?')}) — run these two "
+               "paths concurrently and both threads wait forever")
+        code = "lock-order-inversion"
+    else:
+        chain = " -> ".join(path + [path[0]])
+        msg = (f"lock-order cycle {chain}: thread '{tname}' closed it by "
+               f"acquiring '{b}' at {b_site} while holding '{a}' (taken "
+               f"at {a_site}) — some interleaving of the threads on this "
+               "cycle deadlocks")
+        code = "lock-order-cycle"
+    f = _add_finding("lockorder", code, ERROR, msg, location=b_site,
+                     key=(code, frozenset(path)))
+    if f is not None and f.count == 1 and _raise_on_deadlock():
+        return f"MXNET_TSAN_RAISE: {msg}"
+    return None
+
+
+def _find_path(src, dst):
+    """Name-level DFS src -> dst; returns the node path or None.
+    Caller holds _state_lock."""
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        for nxt in _adj.get(node, ()):
+            if nxt == dst:
+                return path
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+class TsanLock:
+    """Instrumented non-reentrant lock (`analysis.locks.make_lock`)."""
+
+    __slots__ = ("_lock", "_info")
+
+    def __init__(self, name=None):
+        self._lock = threading.Lock()
+        self._info = _register_lock(name)
+
+    @property
+    def name(self):
+        return self._info.name
+
+    def acquire(self, blocking=True, timeout=-1):
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            err = _note_acquired(self._info, blocking=blocking)
+            if err is not None:
+                # escalation mode: surface the deadlock at its site,
+                # WITHOUT leaving the lock held behind the exception
+                _note_released(self._info)
+                self._lock.release()
+                from ..base import MXNetError
+                raise MXNetError(err)
+        return ok
+
+    def release(self):
+        _note_released(self._info)
+        self._lock.release()
+
+    def locked(self):
+        return self._lock.locked()
+
+    def __enter__(self):
+        self.acquire()   # mxlint: disable=bare-acquire (wrapper mechanics)
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def __repr__(self):
+        return f"<TsanLock '{self._info.name}'>"
+
+
+class TsanRLock:
+    """Instrumented reentrant lock.  Exposes the `_is_owned` /
+    `_release_save` / `_acquire_restore` trio so `threading.Condition`
+    can wrap it, with held-stack bookkeeping kept consistent across
+    `wait()`'s full release."""
+
+    __slots__ = ("_lock", "_info", "_depth_by_thread")
+
+    def __init__(self, name=None):
+        self._lock = threading.RLock()
+        self._info = _register_lock(name)
+        self._depth_by_thread = {}
+
+    @property
+    def name(self):
+        return self._info.name
+
+    def _depth(self, delta):
+        ident = threading.get_ident()
+        d = self._depth_by_thread.get(ident, 0) + delta
+        if d <= 0:
+            self._depth_by_thread.pop(ident, None)
+        else:
+            self._depth_by_thread[ident] = d
+        return d
+
+    def acquire(self, blocking=True, timeout=-1):
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            reentry = self._depth(+1) > 1
+            err = _note_acquired(self._info, reentry=reentry,
+                                 blocking=blocking)
+            if err is not None:
+                self._depth(-1)
+                _note_released(self._info)
+                self._lock.release()
+                from ..base import MXNetError
+                raise MXNetError(err)
+        return ok
+
+    def release(self):
+        self._depth(-1)
+        _note_released(self._info)
+        self._lock.release()
+
+    # Condition protocol ------------------------------------------------------
+    def _is_owned(self):
+        return self._lock._is_owned()
+
+    def _release_save(self):
+        ident = threading.get_ident()
+        depth = self._depth_by_thread.pop(ident, 0)
+        for _ in range(max(depth, 1)):
+            _note_released(self._info)
+        return self._lock._release_save(), depth
+
+    def _acquire_restore(self, state):
+        inner, depth = state
+        self._lock._acquire_restore(inner)
+        for i in range(max(depth, 1)):
+            _note_acquired(self._info, reentry=i > 0)
+        ident = threading.get_ident()
+        self._depth_by_thread[ident] = max(depth, 1)
+
+    def __enter__(self):
+        self.acquire()   # mxlint: disable=bare-acquire (wrapper mechanics)
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def __repr__(self):
+        return f"<TsanRLock '{self._info.name}'>"
+
+
+def make_condition(lock=None, name=None):
+    """An instrumented `threading.Condition` (its lock participates in
+    the order graph and lockset checks)."""
+    if lock is None:
+        lock = TsanRLock(name)
+    return threading.Condition(lock)
+
+
+# -- shared-state race attribution -------------------------------------------
+
+def _held_names():
+    return frozenset(info.name for info, _ in _held())
+
+
+def _access(state, key, kind):
+    """Eraser-style lockset check for one access to `state[key]`.
+
+    Each key starts EXCLUSIVE to its creating thread (initialization
+    writes are ordered-before publication and never report).  The first
+    access by a second thread moves it SHARED and seeds the candidate
+    lockset from that access; every later access intersects its held
+    set in.  A report fires when the lockset goes empty while a write
+    is involved *in the shared epoch* — publish-then-read-only data
+    stays silent, a genuinely unsynchronized write/write or write/read
+    pair is attributed to both threads' exact sites."""
+    site = _site()
+    held = _held_names()
+    tname = threading.current_thread().name
+    race = None
+    with _state_lock:
+        k = (state, key)
+        rec = _accesses.get(k)
+        if rec is None:
+            if len(_accesses) >= _MAX_ACCESS_KEYS:
+                return
+            rec = _accesses[k] = {"owner": tname, "shared": False,
+                                  "written_shared": False,
+                                  "lockset": None, "entries": {}}
+        entries = rec["entries"]
+        if not rec["shared"] and tname == rec["owner"]:
+            entries.setdefault(tname, {})[kind] = (held, site)
+            return
+        if not rec["shared"]:
+            rec["shared"] = True
+            rec["lockset"] = set(held)
+        else:
+            rec["lockset"] &= held
+        fire = not rec["lockset"] and \
+            (kind == "write" or rec["written_shared"])
+        if kind == "write":
+            rec["written_shared"] = True
+        if fire:
+            # attribute: another thread's most recent conflicting access
+            # sharing no lock with this one (prefer its writes)
+            for other_t, kinds in entries.items():
+                if other_t == tname:
+                    continue
+                order = ("write",) if kind != "write" else \
+                    ("write", "read")
+                for other_kind in order:
+                    entry = kinds.get(other_kind)
+                    if entry is None:
+                        continue
+                    o_held, o_site = entry
+                    if held & o_held:
+                        continue
+                    race = (other_t, other_kind, o_held, o_site)
+                    break
+                if race is not None:
+                    break
+        entries.setdefault(tname, {})[kind] = (held, site)
+    if race is None:
+        return
+    other_t, other_kind, o_held, o_site = race
+    field = f"{state}[{key!r}]" if key is not None else state
+    what = "write/write" if (kind == "write" and other_kind == "write") \
+        else "write/read"
+    fmt = lambda s: "{" + ", ".join(sorted(s)) + "}" if s else "no lock"
+    _add_finding(
+        "race", "shared-state-race", WARN,
+        f"unsynchronized {what} on shared state {field}: thread "
+        f"'{tname}' {kind}s at {site} holding {fmt(held)}; thread "
+        f"'{other_t}' {other_kind}s at {o_site} holding {fmt(o_held)} — "
+        "no common lock orders these accesses",
+        location=site,
+        key=("shared-state-race", state, key, frozenset((site, o_site))))
+
+
+class _SharedDict(dict):
+    """Race-tracked dict: item reads and writes feed the lockset check."""
+
+    def _tsan(self, key, kind):
+        _access(getattr(self, "_tsan_state_name", "dict"), key, kind)
+
+    def __getitem__(self, key):
+        self._tsan(key, "read")
+        return dict.__getitem__(self, key)
+
+    def get(self, key, default=None):
+        self._tsan(key, "read")
+        return dict.get(self, key, default)
+
+    def __contains__(self, key):
+        self._tsan(key, "read")
+        return dict.__contains__(self, key)
+
+    def __setitem__(self, key, value):
+        self._tsan(key, "write")
+        dict.__setitem__(self, key, value)
+
+    def __delitem__(self, key):
+        self._tsan(key, "write")
+        dict.__delitem__(self, key)
+
+    def pop(self, key, *default):
+        self._tsan(key, "write")
+        return dict.pop(self, key, *default)
+
+    def setdefault(self, key, default=None):
+        self._tsan(key, "write")
+        return dict.setdefault(self, key, default)
+
+    def update(self, *a, **kw):
+        self._tsan(None, "write")
+        dict.update(self, *a, **kw)
+
+    def clear(self):
+        self._tsan(None, "write")
+        dict.clear(self)
+
+
+_state_seq = {}   # display name -> instances registered so far
+
+
+def _unique_state_name(name):
+    """Per-instance state key: two objects registered under one display
+    name must NOT share an access record — a fresh instance's
+    initialization writes would land in the old record's shared epoch
+    and read as races (the test-suite re-creates same-named metrics
+    constantly)."""
+    with _state_lock:
+        n = _state_seq.get(name, 0) + 1
+        _state_seq[name] = n
+    return name if n == 1 else f"{name}#{n}"
+
+
+def shared_dict(name, data=None):
+    """A dict whose item accesses are race-checked under MXNET_TSAN=1;
+    a plain dict otherwise (zero overhead)."""
+    if not enabled():
+        return dict(data or {})
+    d = _SharedDict(data or {})
+    d._tsan_state_name = _unique_state_name(name)
+    return d
+
+
+_instr_classes = {}   # original class -> instrumented subclass
+
+
+def instrument(obj, name):
+    """Register `obj` for attribute-write race tracking: every
+    ``obj.attr = value`` from here on records (thread, locks held,
+    file:line) and is checked against other threads' accesses.  Returns
+    `obj` unchanged when the sanitizer is off, or when the class cannot
+    be swapped (``__slots__`` layouts)."""
+    if not enabled():
+        return obj
+    cls = type(obj)
+    # __slots__ layouts have no instance dict to carry the state name
+    # (and their attribute writes cannot be hooked per-instance): leave
+    # the object untouched, as documented
+    if getattr(obj, "__dict__", None) is None:
+        return obj
+    sub = _instr_classes.get(cls)
+    if sub is None:
+        def __setattr__(self, attr, value,
+                        _base_set=cls.__setattr__):
+            if not attr.startswith("_tsan"):
+                sname = self.__dict__.get("_tsan_state_name")
+                if sname is not None:
+                    _access(sname, attr, "write")
+            _base_set(self, attr, value)
+        try:
+            sub = type("_Tsan" + cls.__name__, (cls,),
+                       {"__setattr__": __setattr__, "__slots__": ()})
+        except TypeError:
+            return obj
+        _instr_classes[cls] = sub
+    # name first, class swap second: a failed swap must leave a plain
+    # object, never an instrumented class without its state name
+    obj.__dict__["_tsan_state_name"] = _unique_state_name(name)
+    try:
+        obj.__class__ = sub
+    except TypeError:
+        del obj.__dict__["_tsan_state_name"]
+        return obj
+    return obj
+
+
+# -- blocking calls under contended locks -------------------------------------
+
+def note_blocking(kind, detail=""):
+    """Report that the calling thread is about to block in `kind`
+    (time.sleep / queue.get / socket.recv / device_get).  A finding
+    fires when the thread holds an instrumented lock another thread
+    also uses — everyone queued on that lock waits out this call too.
+    Patched callables route here automatically; long-wait sites the
+    patches cannot see (socket loops, device fetches) call it
+    directly.  No-op when the sanitizer is off."""
+    if not _installed and not enabled():
+        return
+    held = _held()
+    if not held:
+        return
+    contended = [(info, site) for info, site in held if info.contended]
+    if not contended:
+        return
+    info, lock_site = contended[-1]
+    site = _site()
+    _add_finding(
+        "blocking", "blocking-under-lock", WARN,
+        f"blocking {kind}({detail}) at {site} while holding contended "
+        f"lock '{info.name}' (taken at {lock_site}): thread "
+        f"'{threading.current_thread().name}' parks every thread queued "
+        "on that lock behind this wait — move the blocking call outside "
+        "the critical section",
+        location=site, key=("blocking-under-lock", info.name, site))
+
+
+# -- thread lifecycle ---------------------------------------------------------
+
+def join_thread(thread, timeout, owner=None):
+    """The audited close-path join: join with a timeout, and report a
+    `thread-outlives-close` finding when the thread is still alive
+    afterwards (its owner's close() returned with the worker running).
+    A plain `thread.join(timeout)` when the sanitizer is off."""
+    if thread is None:
+        return True
+    thread.join(timeout)
+    alive = thread.is_alive()
+    if alive and enabled():
+        rec = _threads.get(thread) or {}
+        born = rec.get("site", "<unknown>:0")
+        _add_finding(
+            "lifecycle", "thread-outlives-close", WARN,
+            f"thread '{thread.name}' (started at {born}) is still alive "
+            f"{timeout:g}s after "
+            + (f"{owner}.close()" if owner else "its owner's close()")
+            + " returned — the worker is wedged or the close path never "
+              "signals it; it will outlive its owner and leak",
+            location=_site(),
+            key=("thread-outlives-close", thread.name, born))
+    return not alive
+
+
+def _lifecycle_findings():
+    """Scan tracked threads for leaks (called from `findings()`)."""
+    out = []
+    with _state_lock:
+        snapshot = list(_threads.items())
+    for thread, rec in snapshot:
+        alive = thread.is_alive()
+        if not alive:
+            if rec.get("joined") or thread.daemon:
+                with _state_lock:
+                    _threads.pop(thread, None)
+            continue
+        if thread is threading.current_thread() or thread.daemon:
+            continue
+        if not _ours(rec.get("site", "")):
+            continue
+        key = ("leaked-thread", thread.name, rec.get("site"))
+        with _state_lock:
+            if key in _findings:
+                continue
+        _add_finding(
+            "lifecycle", "leaked-thread", WARN,
+            f"non-daemon thread '{thread.name}' started at "
+            f"{rec.get('site')} is still alive and was never joined — "
+            "it will wedge interpreter shutdown; join it in the owner's "
+            "close() (tsan.join_thread) or mark it a daemon",
+            location=rec.get("site"), key=key)
+    return out
+
+
+# -- reporting ----------------------------------------------------------------
+
+def findings():
+    """Everything collected so far as a list of Findings (lock-order
+    cycles first — they are the errors)."""
+    _lifecycle_findings()
+    with _state_lock:
+        out = list(_findings.values())
+    sev = {ERROR: 0, WARN: 1}
+    out.sort(key=lambda f: sev.get(f.severity, 2))
+    return out
+
+
+def report():
+    return Report(findings(), target="tsan")
+
+
+def lock_graph():
+    """The lock-acquisition-order graph: nodes (with the threads that
+    used each lock) and first-seen ordered edges with both sites."""
+    with _state_lock:
+        return {
+            "locks": [{"name": info.name,
+                       "threads": sorted(info.threads),
+                       "contended": info.contended}
+                      for info in _lock_infos.values()],
+            "edges": [dict(e) for e in _edges.values()],
+        }
+
+
+def dump(path=None):
+    """Write findings + lock graph as one JSON artifact (the
+    ``mxlint --tsan-report`` input).  Registered at atexit when
+    ``MXNET_TSAN_LOG`` is set; each process appends ONE json line with
+    a single O_APPEND write (the faults-JSONL convention), so the
+    subprocesses of a chaos run share a log without clobbering each
+    other's findings."""
+    found = [f.as_dict() for f in findings()]
+    with _state_lock:
+        states = sorted({state for (state, _k) in _accesses})
+    payload = {
+        "pid": os.getpid(),
+        "enabled": bool(_enabled),
+        "findings": found,
+        "lock_graph": lock_graph(),
+        "tracked_shared_states": states,
+    }
+    if path is None:
+        return payload
+    try:
+        fd = os.open(path, os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644)
+        try:
+            os.write(fd, (json.dumps(payload) + "\n").encode())
+        finally:
+            os.close(fd)
+    except OSError:
+        pass
+    return payload
+
+
+def reset():
+    """Clear findings, the order graph, and access history (lock
+    registrations survive — instances keep their identity)."""
+    with _state_lock:
+        _findings.clear()
+        _edges.clear()
+        _adj.clear()
+        _accesses.clear()
+        _threads.clear()
+        # _state_seq is NOT cleared: instrumented objects that survive a
+        # reset keep their unique keys, and a post-reset registration of
+        # the same display name must not collide with them (the exact
+        # false-positive class the per-instance suffix exists to stop)
+        for info in _lock_infos.values():
+            info.threads.clear()
+            info.blocking_threads.clear()

@@ -25,6 +25,9 @@ import pickle
 import shutil
 import struct
 import threading
+
+from ..analysis import locks as _alocks
+from ..analysis import tsan as _tsan
 import zlib
 
 import numpy as np
@@ -260,7 +263,7 @@ class SnapshotWriter:
     """Background serializer with double-buffering (one in-flight write)."""
 
     def __init__(self):
-        self._cond = threading.Condition()
+        self._cond = _alocks.make_condition(name="checkpoint.writer")
         self._job = None
         self._busy = False
         self._error = None
@@ -270,7 +273,7 @@ class SnapshotWriter:
     def _ensure_thread(self):
         if self._thread is None or not self._thread.is_alive():
             self._thread = threading.Thread(
-                target=self._run, name="checkpoint-writer", daemon=True)
+                target=self._run, name="mx-ckpt-writer", daemon=True)
             self._thread.start()
 
     def _run(self):
@@ -326,6 +329,6 @@ class SnapshotWriter:
             self._closed = True
             self._cond.notify_all()
         if self._thread is not None:
-            self._thread.join(timeout=10)
+            _tsan.join_thread(self._thread, 10, owner="SnapshotWriter")
             self._thread = None
         self._closed = False

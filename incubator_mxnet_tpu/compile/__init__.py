@@ -30,7 +30,8 @@ from __future__ import annotations
 
 import atexit
 import os
-import threading
+
+from ..analysis import locks as _alocks
 
 from .cache import ProgramCache, device_fingerprint, entry_key  # noqa: F401
 from .program import (CachedProgram, cached_jit,  # noqa: F401
@@ -45,7 +46,7 @@ __all__ = ["ProgramCache", "CachedProgram", "cached_jit", "get_cache",
            "device_fingerprint", "entry_key"]
 
 _cache = None
-_cache_lock = threading.Lock()
+_cache_lock = _alocks.make_lock("compile.registry")
 _enabled = None   # tri-state: None = read MXNET_PROGRAM_CACHE lazily
 _atexit_armed = False
 

@@ -39,7 +39,8 @@ import os
 import pickle
 import struct
 import tempfile
-import threading
+
+from ..analysis import locks as _alocks
 
 __all__ = ["ProgramCache", "device_fingerprint", "entry_key",
            "FORMAT_VERSION"]
@@ -118,7 +119,7 @@ class ProgramCache:
     to a recompile, never to an error on the caller's path."""
 
     def __init__(self, directory=None, sources=(), limit_mb=None):
-        self._lock = threading.Lock()
+        self._lock = _alocks.make_lock("compile.cache")
         self.directory = None
         self.sources = []
         self._limit_mb = limit_mb

@@ -32,7 +32,8 @@ from __future__ import annotations
 import hashlib
 import logging
 import re
-import threading
+
+from ..analysis import locks as _alocks
 
 __all__ = ["CachedProgram", "cached_jit", "graph_hash_of_jaxpr",
            "graph_hash_of_text"]
@@ -98,7 +99,7 @@ class CachedProgram:
         self.label = label or (graph_key[:12] if graph_key else "program")
         self._programs = {}     # sig -> executable | _PLAIN
         self._entry_keys = {}   # sig -> disk entry key (for export)
-        self._lock = threading.Lock()
+        self._lock = _alocks.make_lock("compile.program")
         self.compile_count = 0
         self.disk_hits = 0
         self.mem_hits = 0   # plain int: the warm path must not take locks

@@ -261,6 +261,26 @@ KNOBS = {
                        "donation tracking, host-sync attribution inside "
                        "Module.fit/Trainer.step, recompilation audit "
                        "(read with analysis.runtime_report())"),
+    # -- concurrency sanitizer (analysis/tsan.py) ----------------------------
+    "MXNET_TSAN": (_BOOL, False, "honored",
+                   "analysis/tsan.py: runtime concurrency sanitizer — "
+                   "locks built via analysis.locks feed a process-wide "
+                   "lock-order graph (deadlock cycles reported before "
+                   "they hang), registered shared state gets lockset "
+                   "race attribution, blocking calls under contended "
+                   "locks and leaked/unjoined threads are flagged; "
+                   "unset, the lock shims ARE the plain threading "
+                   "objects (zero overhead)"),
+    "MXNET_TSAN_LOG": (str, "", "honored",
+                       "write the sanitizer's findings + lock-order "
+                       "graph as one JSON artifact at process exit "
+                       "(rendered by tools/mxlint.py --tsan-report; "
+                       "the run_tpu_parity tsan stage gates on it)"),
+    "MXNET_TSAN_RAISE": (_BOOL, False, "honored",
+                         "escalate a NEW lock-order deadlock cycle to "
+                         "an MXNetError at the acquisition site instead "
+                         "of only recording a finding (the lock is "
+                         "released before raising)"),
 }
 
 _warned = set()

@@ -38,8 +38,10 @@ Three pieces:
 """
 from __future__ import annotations
 
-import threading
 import time
+
+from ..analysis import locks as _locks
+from ..analysis import tsan as _tsan
 
 __all__ = ["MembershipTable"]
 
@@ -67,10 +69,14 @@ class MembershipTable:
         self.expected = int(num_workers)   # current world size
         self.epoch = 0
         self._clock = clock
-        self._cond = threading.Condition()
-        self._hosts = {}                   # rank -> _Host
+        self._cond = _locks.make_condition(name="dist.membership")
+        # rank -> _Host; server handler threads (one per connection)
+        # all mutate it — every access holds _cond's lock, and the
+        # sanitizer checks exactly that when MXNET_TSAN=1
+        self._hosts = _tsan.shared_dict("dist.membership.hosts")
         self._shrink = None                # in-flight barrier state
         self._last_shrink = None           # committed result (replayed)
+        _tsan.instrument(self, "dist.membership")
 
     # -- liveness -------------------------------------------------------------
     def heartbeat(self, rank, epoch, step=None, step_time=None):

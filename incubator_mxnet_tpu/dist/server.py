@@ -31,6 +31,7 @@ import numpy as np
 
 from .membership import MembershipTable
 from .transport import recv_msg, send_msg
+from ..analysis import locks as _locks
 from ..resilience import faults as _faults
 
 # idempotent reads: re-executing a resend is safe and cheaper than
@@ -45,7 +46,7 @@ class _State:
     def __init__(self, num_workers, num_servers=1):
         self.num_workers = num_workers
         self.num_servers = num_servers
-        self.cond = threading.Condition()
+        self.cond = _locks.make_condition(name="dist.server")
         self.store = {}          # key -> np.ndarray
         self.version = {}        # key -> completed rounds
         # key -> list of open rounds, each {"sum": array, "got": set(ranks)};
@@ -142,7 +143,7 @@ class ParameterServer:
     # -- lifecycle -----------------------------------------------------------
     def start(self):
         self._thread = threading.Thread(target=self._server.serve_forever,
-                                        daemon=True)
+                                        daemon=True, name="mx-ps-server")
         self._thread.start()
         return self
 
@@ -169,7 +170,8 @@ class ParameterServer:
                 return
             st.crashed = True
             st.cond.notify_all()
-        threading.Thread(target=self.shutdown, daemon=True).start()
+        threading.Thread(target=self.shutdown, daemon=True,
+                         name="mx-ps-crash-shutdown").start()
 
     # -- request dispatch ----------------------------------------------------
     def _handle(self, msg):

@@ -25,6 +25,8 @@ import socket
 import struct
 import time
 
+_tsan = None   # analysis.tsan, memoized on first recv (lazy: low-level module)
+
 _LEN = struct.Struct(">Q")
 _TAG_LEN = 32
 
@@ -185,6 +187,18 @@ class Channel:
         (the server dedups on the exact (client, seq) pair)."""
         while True:
             self._faults.fire("transport.recv", sock=self._sock)
+            # mxtsan: a socket wait is the blocking call the patched
+            # primitives cannot see — report it so "recv while holding
+            # a contended lock" becomes a finding, not a stall.  The
+            # module is memoized and the call gated on the sanitizer
+            # being installed: the off path pays one boolean test
+            global _tsan
+            if _tsan is None:
+                from ..analysis import tsan
+                _tsan = tsan
+            if _tsan._installed:
+                _tsan.note_blocking("socket.recv",
+                                    detail=f"{self.host}:{self.port}")
             reply = recv_msg(self._sock)
             seq = reply.get("seq") if isinstance(reply, dict) else None
             if seq is None or seq == expect:

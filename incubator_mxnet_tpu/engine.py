@@ -26,12 +26,13 @@ from __future__ import annotations
 
 import os
 import weakref
-import threading
+
+from .analysis import locks as _alocks
 
 __all__ = ["waitall", "wait_to_read", "bulk", "set_bulk_size", "engine_type",
            "bulk_active", "stage", "flush_staged"]
 
-_lock = threading.Lock()
+_lock = _alocks.make_lock("engine")
 _in_flight = weakref.WeakSet()
 
 

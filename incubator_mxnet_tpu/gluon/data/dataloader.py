@@ -11,6 +11,8 @@ prefetch parallelism degree.
 from __future__ import annotations
 
 import threading
+
+from ...analysis import locks as _alocks
 import queue as _queue
 
 import numpy as np
@@ -77,8 +79,8 @@ class DataLoader:
         # threaded pipeline: workers fetch+batchify, consumer preserves order
         batches = list(self._batch_sampler)
         results = {}
-        results_lock = threading.Lock()
-        results_ready = threading.Condition(results_lock)
+        results_lock = _alocks.make_lock("gluon.dataloader")
+        results_ready = _alocks.make_condition(results_lock)
         task_q = _queue.Queue()
         for i, b in enumerate(batches):
             task_q.put((i, b))
@@ -94,8 +96,9 @@ class DataLoader:
                     results[i] = out
                     results_ready.notify_all()
 
-        threads = [threading.Thread(target=worker, daemon=True)
-                   for _ in range(self._num_workers)]
+        threads = [threading.Thread(target=worker, daemon=True,
+                                    name=f"mx-dataloader-worker-{i}")
+                   for i in range(self._num_workers)]
         for t in threads:
             t.start()
         for i in range(len(batches)):

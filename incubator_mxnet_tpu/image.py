@@ -18,6 +18,8 @@ import queue as _queue
 
 import numpy as np
 
+from .analysis import locks as _alocks
+
 from .base import MXNetError
 from .io import DataIter, DataBatch, DataDesc
 from .ndarray.ndarray import NDArray, array
@@ -667,13 +669,14 @@ class _BatchPool:
         self._n = n_batches
         self._stop_evt = threading.Event()
         self._results = {}
-        self._cond = threading.Condition()
+        self._cond = _alocks.make_condition(name="image.batchpool")
         self._next_out = 0
         self._max_ahead = max(prefetch, n_threads + 1)
         self._task = iter(range(n_batches))
-        self._task_lock = threading.Lock()
-        self._threads = [threading.Thread(target=self._work, daemon=True)
-                         for _ in range(n_threads)]
+        self._task_lock = _alocks.make_lock("image.batchpool.tasks")
+        self._threads = [threading.Thread(target=self._work, daemon=True,
+                                          name=f"mx-image-worker-{i}")
+                         for i in range(n_threads)]
         for t in self._threads:
             t.start()
 

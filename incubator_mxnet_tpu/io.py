@@ -415,7 +415,8 @@ class PrefetchingIter(DataIter):
 
     def _start(self):
         self._stop.clear()
-        self._thread = threading.Thread(target=self._producer, daemon=True)
+        self._thread = threading.Thread(target=self._producer, daemon=True,
+                                        name="mx-io-prefetch")
         self._thread.start()
 
     def reset(self):

@@ -11,13 +11,14 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
+
+from .analysis import locks as _alocks
 
 _SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "src")
 _LIB_PATH = os.path.join(_SRC_DIR, "libmxtpu_io.so")
 
-_lock = threading.Lock()
+_lock = _alocks.make_lock("native")
 _lib = None
 _tried = False
 

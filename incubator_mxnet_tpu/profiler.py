@@ -11,8 +11,10 @@ from __future__ import annotations
 
 import json
 import os
-import time
 import threading
+import time
+
+from .analysis import locks as _alocks
 
 __all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
            "resume", "Task", "Frame", "Counter", "Marker",
@@ -24,7 +26,7 @@ _config = {"profile_all": False, "profile_symbolic": False,
            "aggregate_stats": False}
 _state = {"running": False, "dir": None}
 _custom_events = []
-_lock = threading.Lock()
+_lock = _alocks.make_lock("profiler")
 
 
 _kvstore_handle = [None]
@@ -127,6 +129,16 @@ def _emit(event):
         _custom_events.append(event)
 
 
+def _tid():
+    """Stable small int for the chrome-trace tid lane (trace viewers
+    reject non-int tids; the thread NAME rides in args['thread'])."""
+    return threading.get_ident() & 0xFFFF
+
+
+def _tname():
+    return threading.current_thread().name
+
+
 def _imperative_active():
     """True when eager ops should be timed (reference
     `profile_imperative` config, `MXSetProcessProfilerConfig`)."""
@@ -174,7 +186,8 @@ def record_serving(name, dur_us, **args):
         return
     _emit({"name": name, "cat": "serving", "ph": "X",
            "ts": time.perf_counter() * 1e6 - float(dur_us),
-           "dur": float(dur_us), "pid": 0, "tid": 0, "args": args})
+           "dur": float(dur_us), "pid": 0, "tid": _tid(),
+           "args": dict(args, thread=_tname())})
 
 
 def record_supervisor(event, **args):
@@ -186,8 +199,8 @@ def record_supervisor(event, **args):
     if not _state["running"]:
         return
     _emit({"name": f"supervisor:{event}", "cat": "supervisor", "ph": "i",
-           "s": "g", "ts": time.perf_counter() * 1e6, "pid": 0, "tid": 0,
-           "args": args})
+           "s": "g", "ts": time.perf_counter() * 1e6, "pid": 0,
+           "tid": _tid(), "args": dict(args, thread=_tname())})
 
 
 def record_fault(site, kind, **args):
@@ -198,8 +211,8 @@ def record_fault(site, kind, **args):
     if not _state["running"]:
         return
     _emit({"name": f"fault:{site}", "cat": "fault", "ph": "i", "s": "g",
-           "ts": time.perf_counter() * 1e6, "pid": 0, "tid": 0,
-           "args": dict(args, kind=kind)})
+           "ts": time.perf_counter() * 1e6, "pid": 0, "tid": _tid(),
+           "args": dict(args, kind=kind, thread=_tname())})
 
 
 class _Named:

@@ -18,8 +18,9 @@ half-open/close sequences are testable without sleeping.
 """
 from __future__ import annotations
 
-import threading
 import time
+
+from ..analysis import locks as _alocks
 
 __all__ = ["CircuitBreaker"]
 
@@ -32,7 +33,7 @@ class CircuitBreaker:
         self.failure_threshold = int(failure_threshold)
         self.reset_timeout = float(reset_timeout)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = _alocks.make_lock("resilience.breaker")
         self._state = CLOSED
         self._failures = 0          # consecutive failures while closed
         self._opened_at = None

@@ -58,10 +58,10 @@ import json
 import os
 import random
 import re
-import threading
 import time
 
 from ..base import MXNetError
+from ..analysis import locks as _alocks
 
 __all__ = ["FaultInjected", "TornWrite", "configure", "inject", "clear",
            "reset", "trace", "fire", "note", "active", "parse_spec"]
@@ -174,7 +174,7 @@ def parse_spec(spec, seed=0):
 # ACTIVE is the hot-path gate: False means fire() returns after ONE global
 # read.  None means "MXNET_FAULTS not parsed yet" (first fire parses it).
 ACTIVE = None
-_lock = threading.Lock()      # taken only while faults are configured
+_lock = _alocks.make_lock("resilience.faults")   # taken only while faults are configured
 _clauses = []
 _trace = []
 _seed = 0
@@ -256,11 +256,16 @@ def trace():
 
 
 def _record(event):
-    # every event names its emitting process: pid always, the dmlc rank
-    # when the launcher set one (read per event — the shrink-and-resume
-    # path re-ranks a live process mid-run)
+    # every event names its emitting process AND thread: pid always, the
+    # dmlc rank when the launcher set one (read per event — the
+    # shrink-and-resume path re-ranks a live process mid-run), and the
+    # worker-thread name so chaos/sanitizer artifacts attribute a fired
+    # fault to the router health loop vs a dispatch thread vs a
+    # supervisor heartbeat, not just to "the process"
+    import threading as _threading
     rank = os.environ.get("DMLC_RANK")
     event["pid"] = os.getpid()
+    event["thread"] = _threading.current_thread().name
     event["rank"] = int(rank) if rank is not None and rank.isdigit() \
         else None
     _trace.append(event)

@@ -42,6 +42,8 @@ import os
 import threading
 import time
 
+from ..analysis import locks as _locks
+from ..analysis import tsan as _tsan
 from ..base import MXNetError
 from . import faults as _faults
 
@@ -118,7 +120,7 @@ class ShrinkResult:
 
 # -- the active supervisor (one per process) ----------------------------------
 _current = [None]
-_lock = threading.Lock()
+_lock = _locks.make_lock("supervisor.findings")
 _findings = []          # straggler / host-loss findings for runtime_report
 
 
@@ -248,7 +250,7 @@ class JobSupervisor:
         self._thread = None
         self._dispatcher = None
         self._stop = threading.Event()
-        self._view_lock = threading.Lock()
+        self._view_lock = _locks.make_lock("supervisor.view")
         self._view = None
         self._fenced = False
         self._kvstore = None
@@ -256,10 +258,17 @@ class JobSupervisor:
         self._ewma = None
         self._dead_seen = {}      # rank -> monotonic time first seen dead
         self._stragglers = set()  # ranks already flagged
-        self._stats = {"heartbeats": 0, "heartbeats_dropped": 0,
-                       "heartbeats_failed": 0, "collectives": 0,
-                       "collective_timeouts": 0, "stragglers_flagged": 0,
-                       "hosts_lost": 0}
+        # counters shared between the heartbeat thread and the fit
+        # thread: every update holds _view_lock (mxtsan flagged the
+        # bare `+= 1` pattern as write/write races between the beat
+        # loop and the collective/watchdog path)
+        self._stats = _tsan.shared_dict(
+            f"supervisor.stats[rank{self.rank}]",
+            {"heartbeats": 0, "heartbeats_dropped": 0,
+             "heartbeats_failed": 0, "collectives": 0,
+             "collective_timeouts": 0, "stragglers_flagged": 0,
+             "hosts_lost": 0})
+        _tsan.instrument(self, f"supervisor[rank{self.rank}]")
 
     @classmethod
     def for_kvstore(cls, kv, **kw):
@@ -287,7 +296,7 @@ class JobSupervisor:
                              timeout=max(self.deadline_s, 1.0))
         self._beat()
         self._thread = threading.Thread(target=self._beat_loop, daemon=True,
-                                        name=f"supervisor-hb-{self.rank}")
+                                        name=f"mx-supervisor-hb-{self.rank}")
         self._thread.start()
         return self
 
@@ -297,7 +306,9 @@ class JobSupervisor:
             self._dispatcher.close()
             self._dispatcher = None
         if self._thread is not None:
-            self._thread.join(timeout=max(self.deadline_s, 1.0) + 1.0)
+            _tsan.join_thread(self._thread,
+                              max(self.deadline_s, 1.0) + 1.0,
+                              owner=f"JobSupervisor[rank{self.rank}]")
             self._thread = None
         if self._chan is not None:
             try:
@@ -319,20 +330,24 @@ class JobSupervisor:
             # an injected (or genuinely lossy) dropped heartbeat: skip
             # this beat — the deadline tolerates deadline_s/heartbeat_s
             # consecutive losses before declaring death
-            self._stats["heartbeats_dropped"] += 1
+            with self._view_lock:
+                self._stats["heartbeats_dropped"] += 1
             return
         msg = {"cmd": "hb", "rank": self.rank, "epoch": self.epoch,
                "step": self._step, "step_time": self._ewma}
         try:
             reply = self._chan.request(msg)
         except Exception:
-            self._stats["heartbeats_failed"] += 1
+            with self._view_lock:
+                self._stats["heartbeats_failed"] += 1
             return
-        self._stats["heartbeats"] += 1
+        with self._view_lock:
+            self._stats["heartbeats"] += 1
         err = reply.get("error") if isinstance(reply, dict) else None
         if err is not None:
             if "stale epoch" in err:
-                self._fenced = True
+                with self._view_lock:
+                    self._fenced = True
                 _faults.note("fenced", site="supervisor", rank=self.rank,
                              epoch=self.epoch)
             return
@@ -349,7 +364,8 @@ class JobSupervisor:
             if r == self.rank or r in self._dead_seen:
                 continue
             self._dead_seen[r] = now
-            self._stats["hosts_lost"] += 1
+            with self._view_lock:
+                self._stats["hosts_lost"] += 1
             age = view.get("age", {}).get(r)
             _add_finding(
                 "host-lost",
@@ -395,7 +411,8 @@ class JobSupervisor:
             if v - mid > self.straggler_k * max(sigma, 0.05 * mid) and \
                     v > 1.2 * mid:
                 self._stragglers.add(r)
-                self._stats["stragglers_flagged"] += 1
+                with self._view_lock:
+                    self._stats["stragglers_flagged"] += 1
                 _add_finding(
                     "straggler-host",
                     f"host rank {r} step time {v * 1e3:.1f}ms diverges "
@@ -463,7 +480,8 @@ class JobSupervisor:
                 "epoch")
         deadline = float(timeout if timeout is not None
                          else self.collective_timeout_s)
-        self._stats["collectives"] += 1
+        with self._view_lock:
+            self._stats["collectives"] += 1
 
         def _run():
             _faults.fire("collective.dispatch", collective=name,
@@ -472,13 +490,14 @@ class JobSupervisor:
 
         if self._dispatcher is None:
             self._dispatcher = _Dispatcher(
-                f"collective-worker-{self.rank}")
+                f"mx-collective-worker-{self.rank}")
         box, done = self._dispatcher.submit(_run)
         if not done.wait(deadline):
             # the worker is wedged inside the hung collective: abandon
             # it (thread and all) — the next collective gets a fresh one
             self._dispatcher = None
-            self._stats["collective_timeouts"] += 1
+            with self._view_lock:
+                self._stats["collective_timeouts"] += 1
             absent, detail = self._absent_hosts()
             _faults.note("collective-timeout", site="supervisor",
                          collective=name, rank=self.rank,
@@ -523,7 +542,8 @@ class JobSupervisor:
                 pass
         if "error" in reply:
             if "stale epoch" in reply["error"]:
-                self._fenced = True
+                with self._view_lock:
+                    self._fenced = True
                 raise StaleEpochError(reply["error"])
             raise MXNetError(f"shrink failed: {reply['error']}")
         rank_map = {int(k): int(v) for k, v in reply["rank_map"].items()}
@@ -555,8 +575,9 @@ class JobSupervisor:
             "step_time_ewma_s": self._ewma,
             "alive": list(v.get("alive", ())),
             "dead": list(v.get("dead", ())),
-            **self._stats,
         }
+        with self._view_lock:
+            out.update(self._stats)
         kv = self._kvstore
         if kv is not None and hasattr(kv, "stats"):
             try:

@@ -45,6 +45,8 @@ from concurrent.futures import Future
 
 import numpy as _np
 
+from ..analysis import locks as _locks
+from ..analysis import tsan as _tsan
 from ..base import MXNetError
 from ..resilience import CircuitBreaker, faults as _faults
 
@@ -90,8 +92,8 @@ class MicroBatcher:
         self._carry = None         # request admitted but deferred to the
                                    # next batch (would overflow this one)
         self._outstanding = 0
-        self._lock = threading.Lock()
-        self._idle = threading.Condition(self._lock)
+        self._lock = _locks.make_lock("serving.batcher")
+        self._idle = _locks.make_condition(self._lock)
         self._stop = threading.Event()
         self._killed = False       # abrupt death: sweep, don't execute
         self._draining = threading.Event()
@@ -107,6 +109,7 @@ class MicroBatcher:
         self._retry = retry_policy     # None = batch failures don't retry
         self._rid_counter = 0
         self._pending = {}             # rid -> _Request (admitted, unresolved)
+        _tsan.instrument(self, f"serving.batcher[{model.name}]")
         self._thread = threading.Thread(
             target=self._worker, daemon=True,
             name=f"mx-serving-{model.name}")
@@ -244,7 +247,8 @@ class MicroBatcher:
                     lambda: self._outstanding == 0, timeout=timeout)
         stuck = self.pending_request_ids() if not drained else []
         self._stop.set()
-        self._thread.join(timeout=10)
+        _tsan.join_thread(self._thread, 10,
+                          owner=f"MicroBatcher[{self._model.name}]")
         self._sweep_failed()   # non-drain shutdown: fail what is queued
         if stuck:
             raise MXNetError(
@@ -265,7 +269,8 @@ class MicroBatcher:
         self._draining.set()
         self._stop.set()
         self._paused.clear()
-        self._thread.join(timeout=10)
+        _tsan.join_thread(self._thread, 10,
+                          owner=f"MicroBatcher[{self._model.name}]")
         self._sweep_failed()
 
     def _sweep_failed(self):

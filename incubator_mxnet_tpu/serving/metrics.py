@@ -20,10 +20,12 @@ from __future__ import annotations
 
 import collections
 import random
-import threading
 import time
 
 import numpy as _np
+
+from ..analysis import locks as _locks
+from ..analysis import tsan as _tsan
 
 __all__ = ["ServingMetrics", "LatencyReservoir"]
 
@@ -76,7 +78,10 @@ class ServingMetrics:
 
     def __init__(self, model_name, window=4096):
         self.model_name = model_name
-        self._lock = threading.Lock()
+        self._lock = _locks.make_lock("serving.metrics")
+        # every counter write below must hold _lock; under MXNET_TSAN=1
+        # an unsynchronized update is attributed to its exact site
+        _tsan.instrument(self, f"serving.metrics[{model_name}]")
         self._lat_ms = LatencyReservoir(window)
         self._window = int(window)
         # priority-class plane: class -> {"responses", "shed",

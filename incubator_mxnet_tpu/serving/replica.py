@@ -42,6 +42,7 @@ from concurrent.futures import Future
 
 import numpy as _np
 
+from ..analysis import locks as _locks
 from ..base import MXNetError
 
 __all__ = ["Replica", "LocalReplica", "RemoteReplica", "ReplicaLostError"]
@@ -260,7 +261,7 @@ class RemoteReplica(Replica):
         self._seq_counter = 0
         self._lost = threading.Event()
         self._inflight = {}          # rid -> _Pending (on the wire)
-        self._lock = threading.Lock()
+        self._lock = _locks.make_lock("serving.replica")
         self._ewma_s = None          # recent per-request round-trip
         self._chans = []
         self._threads = []
@@ -340,7 +341,9 @@ class RemoteReplica(Replica):
         # drain the pipe in the background or the worker blocks on a
         # full stdout once it starts logging
         threading.Thread(target=lambda: proc.stdout.read(),
-                         daemon=True).start()
+                         daemon=True,
+                         name=f"mx-replica-{replica_id or name}-stdout"
+                         ).start()
         self = cls("127.0.0.1", port, replica_id=replica_id, process=proc,
                    concurrency=concurrency)
         self.ready_info = ready_info

@@ -52,6 +52,8 @@ import time
 
 from concurrent.futures import Future
 
+from ..analysis import locks as _locks
+from ..analysis import tsan as _tsan
 from ..base import MXNetError
 from ..resilience import CircuitBreaker, faults as _faults
 from .metrics import ServingMetrics
@@ -96,7 +98,7 @@ class _RouterRequest:
         self.dispatches = 0
         self.replica_id = None
         self.t0 = now
-        self.lock = threading.Lock()
+        self.lock = _locks.make_lock("serving.router.request")
         self.done = False
 
 
@@ -128,7 +130,7 @@ class ReplicaRouter:
             "interactive": float(
                 _config.get("MXNET_ROUTER_SHED_INTERACTIVE_MS"))}
         self.metrics = ServingMetrics(self.name)
-        self._lock = threading.Lock()
+        self._lock = _locks.make_lock("serving.router")
         self._slots = {}               # replica_id -> _Slot
         self._inflight = {}            # rid -> _RouterRequest
         # resolved rids, insertion-ordered so the bounded trim drops the
@@ -140,8 +142,9 @@ class ReplicaRouter:
         # collide with a caller-supplied request_id
         import uuid
         self._rid_ns = uuid.uuid4().hex[:8]
-        self._swap_lock = threading.Lock()
+        self._swap_lock = _locks.make_lock("serving.router.swap")
         self._closed = threading.Event()
+        _tsan.instrument(self, f"serving.router[{self.name}]")
         # fleet counters
         self.failovers = 0
         self.duplicates_suppressed = 0
@@ -162,13 +165,15 @@ class ReplicaRouter:
                 _config.get("MXNET_SERVING_BREAKER_THRESHOLD")),
             reset_timeout=float(
                 _config.get("MXNET_SERVING_BREAKER_RESET_S")))
+        slot = _tsan.instrument(
+            _Slot(replica, breaker, self._clock()),
+            f"serving.router.slot[{replica.replica_id}]")
         with self._lock:
             if replica.replica_id in self._slots:
                 raise MXNetError(
                     f"router '{self.name}': duplicate replica id "
                     f"{replica.replica_id!r}")
-            self._slots[replica.replica_id] = _Slot(replica, breaker,
-                                                    self._clock())
+            self._slots[replica.replica_id] = slot
         return replica
 
     def remove_replica(self, replica_id, drain=True):
@@ -350,7 +355,11 @@ class ReplicaRouter:
             result, err = None, exc
         if err is None:
             slot.breaker.record_success()
-            slot.last_ok = self._clock()
+            with self._lock:
+                # proof of life: a served request refreshes liveness.
+                # Written under the router lock — the health thread
+                # updates the same field (mxtsan: shared-state-race)
+                slot.last_ok = self._clock()
             self._resolve(req, result=result)
             return
         if isinstance(err, ReplicaLostError):
@@ -411,28 +420,38 @@ class ReplicaRouter:
             mark("router declared the replica dead")
 
     def _health_loop(self):
+        # slot bookkeeping (probes, state, last_ok) is written under the
+        # router lock — the dispatch path and `_on_done` write the same
+        # fields from other threads (mxtsan flagged the lock-free
+        # version as shared-state races).  The probe's network call
+        # itself runs OUTSIDE the lock: a slow replica must not block
+        # dispatch, and a blocking call under a contended lock is
+        # exactly what the sanitizer's blocking pass exists to catch.
         while not self._closed.wait(self.health_interval_s):
             with self._lock:
                 slots = list(self._slots.values())
             for slot in slots:
-                if slot.state in (DEAD, SWAPPING):
-                    continue
-                slot.probes += 1
-                deep = self.deepcheck_every > 0 and \
-                    slot.probes % self.deepcheck_every == 0
+                with self._lock:
+                    if slot.state in (DEAD, SWAPPING):
+                        continue
+                    slot.probes += 1
+                    deep = self.deepcheck_every > 0 and \
+                        slot.probes % self.deepcheck_every == 0
+                    if deep:
+                        slot.deepchecks += 1
                 try:
                     _faults.fire("replica.health",
                                  replica=slot.replica.replica_id,
                                  deep=deep)
                     if deep:
-                        slot.deepchecks += 1
                         slot.replica.probe()
                     else:
                         slot.replica.heartbeat()
-                    slot.last_ok = self._clock()
-                    slot.probe_failures = 0
-                    if slot.state == SUSPECT:
-                        slot.state = HEALTHY
+                    with self._lock:
+                        slot.last_ok = self._clock()
+                        slot.probe_failures = 0
+                        if slot.state == SUSPECT:
+                            slot.state = HEALTHY
                 except ReplicaLostError:
                     self._on_replica_lost(slot)
                 except Exception:
@@ -442,12 +461,15 @@ class ReplicaRouter:
                     # deadline (dead).  Served requests also refresh
                     # last_ok — a replica busy serving is alive even
                     # when its probes are being dropped.
-                    slot.probe_failures += 1
-                    if slot.state == HEALTHY:
-                        slot.state = SUSPECT
-                if slot.state != DEAD and \
+                    with self._lock:
+                        slot.probe_failures += 1
+                        if slot.state == HEALTHY:
+                            slot.state = SUSPECT
+                with self._lock:
+                    overdue = slot.state != DEAD and \
                         self._clock() - slot.last_ok > \
-                        self.health_deadline_s:
+                        self.health_deadline_s
+                if overdue:
                     self._on_replica_lost(slot)
 
     # -- hot weight swap ------------------------------------------------------
@@ -565,7 +587,8 @@ class ReplicaRouter:
 
     def shutdown(self, drain=True):
         self._closed.set()
-        self._health_thread.join(timeout=10)
+        _tsan.join_thread(self._health_thread, 10,
+                          owner=f"ReplicaRouter[{self.name}]")
         with self._lock:
             slots, self._slots = dict(self._slots), {}
         for slot in slots.values():

@@ -11,8 +11,7 @@ drains every model.
 """
 from __future__ import annotations
 
-import threading
-
+from ..analysis import locks as _locks
 from ..base import MXNetError
 from .batcher import MicroBatcher
 from .metrics import ServingMetrics
@@ -31,7 +30,7 @@ class ModelServer:
                           "max_queue": max_queue}
         self._ctx = ctx
         self._models = {}
-        self._lock = threading.Lock()
+        self._lock = _locks.make_lock("serving.server")
         self._closed = False
 
     # -- model lifecycle -----------------------------------------------------

@@ -34,6 +34,8 @@ import threading
 
 import numpy as _np
 
+from ..analysis import locks as _locks
+from ..analysis import tsan as _tsan
 from .model import ServedModel
 
 __all__ = ["ReplicaWorker", "main"]
@@ -45,7 +47,8 @@ class ReplicaWorker:
     def __init__(self, model, host="127.0.0.1", port=0, dedup_window=16384):
         self.model = model
         self.version = 0
-        self._lock = threading.Lock()
+        self._lock = _locks.make_lock("serving.worker")
+        _tsan.instrument(self, "serving.worker")
         self._outstanding = 0
         self._executed = 0
         self._dedup_hits = 0
@@ -113,8 +116,13 @@ class ReplicaWorker:
             from .replica import _load_checkpoint_params
             args, auxs = _load_checkpoint_params(msg["checkpoint_dir"])
             self.model.set_params(args, auxs)
-            self.version += 1
-            return {"ok": True, "version": self.version,
+            with self._lock:
+                # handler threads are per-connection: the version bump
+                # must hold the same lock the hb/stats readers take
+                # (mxtsan: shared-state-race on a lock-free increment)
+                self.version += 1
+                version = self.version
+            return {"ok": True, "version": version,
                     "programs": self.model.program_count(), "seq": seq}
         if cmd == "stats":
             from .. import compile as _compile
@@ -184,7 +192,8 @@ class ReplicaWorker:
 
     def start(self):
         self._thread = threading.Thread(target=self.serve_forever,
-                                        daemon=True)
+                                        daemon=True,
+                                        name="mx-replica-worker-server")
         self._thread.start()
         return self
 

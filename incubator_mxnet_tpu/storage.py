@@ -18,9 +18,10 @@ What remains of the reference's storage layer on this design, honestly:
 """
 from __future__ import annotations
 
-import threading
 
 import numpy as np
+
+from .analysis import locks as _alocks
 
 __all__ = ["HostStagingPool", "default_pool", "memory_stats",
            "device_memory_info"]
@@ -37,7 +38,7 @@ class HostStagingPool:
 
     def __init__(self, max_bytes=1 << 30):
         self._free = {}                 # rounded nbytes -> [np buffers]
-        self._lock = threading.Lock()
+        self._lock = _alocks.make_lock("storage.pool")
         self._max_bytes = max_bytes
         self._held = 0
         self.hits = 0
@@ -98,7 +99,7 @@ class HostStagingPool:
 
 
 _default = None
-_default_lock = threading.Lock()
+_default_lock = _alocks.make_lock("storage.default")
 
 
 def default_pool():

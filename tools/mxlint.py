@@ -21,6 +21,13 @@ Usage:
                        --shape data=64,3,224,224
     --suppress codes   comma list of finding codes to drop
     --json             machine-readable summary (one JSON object)
+    --tsan-report      concurrency report: the mxtsan AST lints
+                       (unnamed-thread, bare-acquire, sleep-under-lock,
+                       unjoined-thread-in-init) over PATHS (default:
+                       the package), plus any MXNET_TSAN_LOG runtime
+                       dump among PATHS rendered as the lock-order
+                       graph + findings
+    --cache-report DIR program-cache hit rates / churn from stats.json
 
 Exit status: 0 when no error/warn findings survive, 1 otherwise (hints
 never fail the run).  Inline suppression: ``# mxlint: disable[=code]``
@@ -137,6 +144,94 @@ def cache_report(cache_dir, as_json=False):
     return 0
 
 
+def tsan_report(paths, as_json=False):
+    """Concurrency report: the mxtsan AST lint subset (unnamed-thread,
+    bare-acquire, sleep-under-lock, unjoined-thread-in-init) over the
+    given ``.py`` paths (default: the package), plus a render of any
+    ``MXNET_TSAN_LOG`` JSON dumps passed in — the runtime sanitizer's
+    findings and its lock-acquisition-order graph.  Exit 1 when any
+    lint or runtime finding survives: the run_tpu_parity ``tsan`` stage
+    gates on exactly this."""
+    from incubator_mxnet_tpu import analysis
+    from incubator_mxnet_tpu.analysis.source_lint import CONCURRENCY_CODES
+
+    if not paths:
+        paths = [os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "incubator_mxnet_tpu")]
+    py_files, json_files = _collect(paths)
+    lint_findings = []
+    scanned = 0
+    for path in py_files:
+        scanned += 1
+        rep = analysis.check_source_file(path)
+        lint_findings.extend(f for f in rep
+                             if f.code in CONCURRENCY_CODES)
+
+    runtime = {"findings": [], "lock_graph": None, "dumps": 0}
+    payloads = []
+    for path in json_files:
+        try:
+            with open(path, encoding="utf-8") as f:
+                lines = [ln for ln in f.read().splitlines()
+                         if ln.strip()]
+        except OSError:
+            continue
+        for ln in lines:   # MXNET_TSAN_LOG: one json line per process
+            try:
+                p = json.loads(ln)
+            except ValueError:
+                break      # not a tsan dump (symbol JSON etc.)
+            if isinstance(p, dict) and "lock_graph" in p:
+                payloads.append(p)
+    for payload in payloads:
+        runtime["dumps"] += 1
+        runtime["findings"].extend(payload.get("findings", []))
+        graph = payload.get("lock_graph") or {}
+        if runtime["lock_graph"] is None:
+            runtime["lock_graph"] = graph
+        else:   # merge multi-process dumps (chaos runs)
+            seen = {lk["name"] for lk in runtime["lock_graph"]["locks"]}
+            runtime["lock_graph"]["locks"].extend(
+                lk for lk in graph.get("locks", ())
+                if lk["name"] not in seen)
+            have = {(e["from"], e["to"])
+                    for e in runtime["lock_graph"]["edges"]}
+            runtime["lock_graph"]["edges"].extend(
+                e for e in graph.get("edges", ())
+                if (e["from"], e["to"]) not in have)
+
+    failing = len(lint_findings) + len(runtime["findings"])
+    report = {
+        "scanned": scanned,
+        "lint_findings": len(lint_findings),
+        "runtime_findings": len(runtime["findings"]),
+        "failing": failing,
+        "items": [f.as_dict() for f in lint_findings[:200]],
+        "runtime": runtime if runtime["dumps"] else None,
+    }
+    if as_json:
+        print(json.dumps(report, indent=1))
+    else:
+        for f in lint_findings:
+            print(f.format())
+        for f in runtime["findings"]:
+            loc = f.get("location") or ""
+            print(f"{loc}: {f.get('severity')} [{f.get('code')}] "
+                  f"{f.get('message')}")
+        graph = runtime["lock_graph"]
+        if graph:
+            print("lock-order graph: %d lock(s), %d edge(s)"
+                  % (len(graph.get("locks", ())),
+                     len(graph.get("edges", ()))))
+            for e in graph.get("edges", ()):
+                print("  %s -> %s  [%s; held at %s, acquired at %s]"
+                      % (e["from"], e["to"], e.get("thread"),
+                         e.get("held_at"), e.get("acquired_at")))
+        print(f"mxlint --tsan-report: {scanned} file(s) scanned, "
+              f"{failing} finding(s)")
+    return 1 if failing else 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="mxlint", description=__doc__,
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -151,10 +246,17 @@ def main(argv=None):
     ap.add_argument("--cache-report", metavar="CACHE_DIR",
                     help="report program-cache hit rates and churn-"
                          "attributed compiles from CACHE_DIR/stats.json")
+    ap.add_argument("--tsan-report", action="store_true",
+                    help="concurrency report: the mxtsan AST lints over "
+                         "PATHS (default: the package) + any MXNET_TSAN_"
+                         "LOG runtime dumps among PATHS rendered as the "
+                         "lock-order graph and findings")
     args = ap.parse_args(argv)
 
     if args.cache_report:
         return cache_report(args.cache_report, as_json=args.as_json)
+    if args.tsan_report:
+        return tsan_report(args.paths, as_json=args.as_json)
     if not args.paths:
         ap.error("paths required (or --cache-report DIR)")
 

@@ -384,7 +384,9 @@ def _drive_router(router, n_threads=4, per=40, kill_at=None,
     import numpy as np
     _drive_router._x = np.random.default_rng(5).standard_normal(
         (2, 16)).astype(np.float32)
-    threads = [threading.Thread(target=client) for _ in range(n_threads)]
+    threads = [threading.Thread(target=client,
+                                name=f"mx-chaos-client-{i}")
+               for i in range(n_threads)]
     for t in threads:
         t.start()
     for t in threads:
@@ -460,7 +462,8 @@ def run_serving_schedule(name, tmp, quiet=False):
                 except Exception as exc:
                     swap_err[0] = repr(exc)
 
-            swapper = threading.Thread(target=do_swap)
+            swapper = threading.Thread(target=do_swap,
+                                       name="mx-chaos-swapper")
             swapper.start()
             ok, errors = _drive_router(router, per=30)
             swapper.join(120)

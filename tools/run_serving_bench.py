@@ -74,7 +74,8 @@ def drive(server, name, n_threads, n_requests, in_dim, timeout_ms=None):
             except Exception as exc:  # count, don't die mid-bench
                 errors.append(str(exc))
 
-    threads = [threading.Thread(target=client, args=(t,))
+    threads = [threading.Thread(target=client, args=(t,),
+                                name=f"mx-bench-client-{t}")
                for t in range(n_threads)]
     t0 = time.monotonic()
     for th in threads:
@@ -130,7 +131,9 @@ def _ramp(router, in_dim, slo_ms, requests, max_level=64, kill_at_level=None,
                 with lock:
                     lat_ms.append((time.monotonic() - t0) * 1e3)
 
-        threads = [threading.Thread(target=client) for _ in range(level)]
+        threads = [threading.Thread(target=client,
+                                    name=f"mx-bench-ramp-{level}-{i}")
+                   for i in range(level)]
         t0 = time.monotonic()
         for t in threads:
             t.start()
@@ -213,9 +216,10 @@ def _degradation_run(router, in_dim, slo_ms, requests, concurrency=8,
                "best_effort": (max(concurrency // 8, 2), depth * 4)}
 
     def drive():
-        threads = [threading.Thread(target=client, args=(cls, d))
+        threads = [threading.Thread(target=client, args=(cls, d),
+                                    name=f"mx-bench-{cls}-{i}")
                    for cls, (n, d) in cls_cfg.items()
-                   for _ in range(n)]
+                   for i in range(n)]
         for t in threads:
             t.start()
         for t in threads:
@@ -234,8 +238,9 @@ def _degradation_run(router, in_dim, slo_ms, requests, concurrency=8,
     # ms number a noisy CPU container could never hit
     n_i, d_i = cls_cfg["interactive"]
     base_threads = [threading.Thread(target=client,
-                                     args=("interactive", d_i))
-                    for _ in range(n_i)]
+                                     args=("interactive", d_i),
+                                     name=f"mx-bench-base-{i}")
+                    for i in range(n_i)]
     for t in base_threads:
         t.start()
     for t in base_threads:

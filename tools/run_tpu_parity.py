@@ -175,6 +175,73 @@ def chaos_serving_stage():
         return {"error": f"chaos serving stage failed: {exc!r}"}
 
 
+def tsan_stage():
+    """Concurrency-sanitizer stage: a tier-1-representative subset
+    (the tsan fixtures + zero-FP gate + the router battery) runs in a
+    throwaway process under ``MXNET_TSAN=1`` with ``MXNET_TSAN_LOG``
+    pointed at a scratch artifact; afterwards ``mxlint --tsan-report``
+    sweeps the package with the concurrency AST lints and renders the
+    runtime dump.  The stage's contract is **zero findings**: seeded
+    fixtures assert their own findings and then reset, so anything left
+    in the dump is a real lock-order cycle, race, blocking-under-lock,
+    or leaked thread in the production code paths the subset drove."""
+    import tempfile
+    log = os.path.join(tempfile.mkdtemp(prefix="mxtsan_"), "tsan.json")
+    env = dict(os.environ, MXNET_TSAN="1", MXNET_TSAN_LOG=log,
+               JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, "-m", "pytest", "tests/test_tsan.py",
+           "tests/test_router.py", "-q", "-m", "not slow",
+           "-p", "no:cacheprovider"]
+    out = {"cmd": " ".join(cmd[2:])}
+    try:
+        proc = subprocess.run(cmd, cwd=REPO, capture_output=True,
+                              text=True, timeout=1800, env=env)
+        out["rc"] = proc.returncode
+        tail = (proc.stdout + proc.stderr).strip().splitlines()
+        out["tail"] = "\n".join(tail[-3:])[-500:]
+    except Exception as exc:
+        return {"error": f"tsan stage failed: {exc!r}"}
+    try:
+        with open(log) as f:
+            dumps = [json.loads(ln) for ln in f.read().splitlines()
+                     if ln.strip()]
+        found = [fi for d in dumps for fi in d.get("findings", [])]
+        out["processes"] = len(dumps)
+        out["runtime_findings"] = len(found)
+        out["findings"] = [
+            {k: fi.get(k) for k in ("code", "severity", "location")}
+            for fi in found][:50]
+        locks, edges = set(), set()
+        states = set()
+        for d in dumps:
+            graph = d.get("lock_graph") or {}
+            locks.update(lk["name"] for lk in graph.get("locks", ()))
+            edges.update((e["from"], e["to"])
+                         for e in graph.get("edges", ()))
+            states.update(d.get("tracked_shared_states", ()))
+        out["lock_graph"] = {"locks": len(locks), "edges": len(edges)}
+        out["tracked_shared_states"] = len(states)
+    except Exception as exc:
+        out["runtime_findings"] = None
+        out["dump_error"] = repr(exc)
+    lint_cmd = [sys.executable, os.path.join(REPO, "tools", "mxlint.py"),
+                "--tsan-report", "--json",
+                os.path.join(REPO, "incubator_mxnet_tpu"), log]
+    try:
+        lint = subprocess.run(lint_cmd, cwd=REPO, capture_output=True,
+                              text=True, timeout=600)
+        summary = json.loads(lint.stdout)
+        out["lint_findings"] = summary["lint_findings"]
+        out["scanned"] = summary["scanned"]
+    except Exception as exc:
+        out["lint_findings"] = None
+        out["lint_error"] = repr(exc)
+    out["clean"] = (out.get("rc") == 0
+                    and out.get("runtime_findings") == 0
+                    and out.get("lint_findings") == 0)
+    return out
+
+
 def coldstart_stage():
     """Cold-start stage: the warmup CLI's built-in probe, run cold then
     warm in fresh subprocesses (tools/warmup.py coldstart_probe) — the
@@ -213,6 +280,7 @@ def main():
         "chaos_pod": chaos_pod_stage(),
         "chaos_serving": chaos_serving_stage(),
         "coldstart": coldstart_stage(),
+        "tsan": tsan_stage(),
         "cmd": " ".join(cmd[2:]),
         "tests": tests[:500],
         "tail": "\n".join(output.strip().splitlines()[-12:])[-2000:],
