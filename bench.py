@@ -738,6 +738,32 @@ def main():
                    vs_baseline=round(img_s / BASELINE_IMG_S, 3),
                    init_s=round(init_s, 2), compile_s=round(compile_s, 2))
 
+    # -- guardian overhead probe -------------------------------------------
+    # the headline lane above ran with the training guardian ON (its
+    # default): the in-graph health word + conditional update must cost
+    # <2% — re-measure with the guardian OFF and gate the ratio
+    if os.environ.get("BENCH_GUARDIAN", "1") == "1" and left() > 120 and \
+            os.environ.get("MXNET_GUARDIAN", "1") not in ("0", "false"):
+        # skipped when the user disabled the guardian: the headline lane
+        # already ran guardian-off and the probe would measure nothing
+        _RESULT["phase"] = f"guardian-off-{dtype}"
+        try:
+            prev = os.environ.get("MXNET_GUARDIAN")
+            os.environ["MXNET_GUARDIAN"] = "0"
+            try:
+                _, _, img_off = _run_framework(batch, image, steps, dtype)
+            finally:
+                if prev is None:
+                    os.environ.pop("MXNET_GUARDIAN", None)
+                else:
+                    os.environ["MXNET_GUARDIAN"] = prev
+            overhead = 1.0 - img_s / img_off if img_off else 0.0
+            _RESULT["guardian_off_img_s"] = round(img_off, 2)
+            _RESULT["guardian_overhead"] = round(overhead, 4)
+            _RESULT["guardian_overhead_ok"] = bool(overhead <= 0.02)
+        except Exception as e:
+            _RESULT["guardian_error"] = repr(e)[:200]
+
     # -- pure-JAX control at the same dtype --------------------------------
     if want_control and left() > 90:
         _RESULT["phase"] = f"control-{dtype}"

@@ -106,6 +106,11 @@ def runtime_report():
         report.extend(_supervisor.findings())
     except Exception:
         pass
+    try:
+        from ..resilience import guardian as _guardian
+        report.extend(_guardian.findings())
+    except Exception:
+        pass
     from . import tsan as _tsan
     if _tsan.enabled():
         report.extend(_tsan.findings())
@@ -118,6 +123,11 @@ def reset_runtime():
     try:
         from ..resilience import supervisor as _supervisor
         _supervisor.reset_findings()
+    except Exception:
+        pass
+    try:
+        from ..resilience import guardian as _guardian
+        _guardian.reset_findings()
     except Exception:
         pass
     from . import tsan as _tsan

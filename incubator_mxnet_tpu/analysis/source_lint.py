@@ -31,6 +31,16 @@ walking a script's AST:
   death or one overload burst takes exactly that traffic down.  Route
   requests through ``router.submit()/predict()`` (or keep the script
   router-less on purpose and say so with a suppression).
+* ``nan-swallow`` — a ``try`` whose body runs a training update
+  (`Module.fit` / `fit_step` / a trainer's ``.step``) with an
+  exception handler that swallows the failure and keeps looping
+  (optionally after an ``isnan``/``isfinite`` check): the classic
+  hand-rolled "skip the NaN batch and hope" pattern.  It silently
+  loses steps, desynchronizes multi-worker runs, and leaves no
+  quarantine trail — the training guardian (MXNET_GUARDIAN,
+  resilience/guardian.py) does this correctly: in-graph skip with
+  deterministic RNG/optimizer advance, loss-spike rollback, and a
+  quarantine log.
 * ``unsupervised-collective`` — a host-level cross-host collective
   dispatch (`collectives.all_reduce` / `all_gather` / `reduce_scatter` /
   `ppermute` / a collective plane's `allreduce`) outside a supervisor/
@@ -109,6 +119,7 @@ _PASS_BY_CODE = {"host-sync-in-loop": "source.hostsync",
                  "kvstore-local-on-tpu": "source.kvstore",
                  "unbounded-retry": "source.retry",
                  "bare-except": "source.except",
+                 "nan-swallow": "source.guardian",
                  "unsupervised-collective": "source.supervisor",
                  "router-bypass": "source.router",
                  "unnamed-thread": "source.thread",
@@ -207,7 +218,62 @@ class _Visitor(ast.NodeVisitor):
                       "resilience.RetryPolicy")
 
     # -- exception handling --------------------------------------------------
+    def _train_update_call(self, node):
+        """Line of the first training-update call lexically inside
+        `node` — Module.fit / fit_step / forward_backward, or .step()
+        on a receiver whose name mentions a trainer — else None."""
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call) or \
+                    not isinstance(sub.func, ast.Attribute):
+                continue
+            attr = sub.func.attr
+            if attr in ("fit", "fit_step", "forward_backward"):
+                return sub.lineno
+            if attr == "step":
+                recv = sub.func.value
+                ident = recv.id if isinstance(recv, ast.Name) else \
+                    recv.attr if isinstance(recv, ast.Attribute) else ""
+                if "trainer" in ident.lower():
+                    return sub.lineno
+        return None
+
+    def _check_nan_swallow(self, node):
+        """try around a training update whose handler swallows and keeps
+        going (continue/pass, no raise) — hand-rolled NaN tolerance."""
+        update_line = None
+        for stmt in node.body:
+            update_line = self._train_update_call(stmt)
+            if update_line is not None:
+                break
+        if update_line is None:
+            return
+        for handler in node.handlers:
+            if any(isinstance(s, ast.Raise) for s in ast.walk(handler)):
+                continue
+            swallows = any(isinstance(s, ast.Continue)
+                           for s in ast.walk(handler)) or \
+                all(isinstance(s, ast.Pass) for s in handler.body)
+            mentions_nan = any(
+                isinstance(s, ast.Call) and (
+                    (isinstance(s.func, ast.Attribute) and
+                     s.func.attr in ("isnan", "isfinite")) or
+                    (isinstance(s.func, ast.Name) and
+                     s.func.id in ("isnan", "isfinite")))
+                for s in ast.walk(handler))
+            if swallows or mentions_nan:
+                self._add(
+                    "nan-swallow", handler.lineno,
+                    "exception swallowed around a training update (line "
+                    f"{update_line}) with the loop continuing: "
+                    "hand-rolled NaN/failure tolerance silently loses "
+                    "steps, desynchronizes multi-worker runs, and leaves "
+                    "no quarantine trail — use the training guardian "
+                    "(MXNET_GUARDIAN: in-graph skip-batch, loss-spike "
+                    "rollback, quarantine) instead")
+                return
+
     def visit_Try(self, node):
+        self._check_nan_swallow(node)
         for handler in node.handlers:
             bare = handler.type is None
             broad = isinstance(handler.type, ast.Name) and \

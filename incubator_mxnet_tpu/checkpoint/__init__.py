@@ -24,10 +24,11 @@ from __future__ import annotations
 from . import manifest
 from . import snapshot
 from . import state
+from .manifest import latest_healthy
 from .manager import (CheckpointManager, CheckpointData, latest, load,
                       install_preemption_hook)
 from .handler import ElasticCheckpointHandler
 
 __all__ = ["CheckpointManager", "CheckpointData", "latest", "load",
-           "install_preemption_hook", "ElasticCheckpointHandler",
-           "manifest", "snapshot", "state"]
+           "latest_healthy", "install_preemption_hook",
+           "ElasticCheckpointHandler", "manifest", "snapshot", "state"]

@@ -148,6 +148,31 @@ def latest(root, deep=True):
     return ckpts[-1][1] if ckpts else None
 
 
+def latest_healthy(root, max_step=None, deep=True):
+    """Path of the newest VALID checkpoint stamped healthy, or None.
+
+    The training guardian (resilience/guardian.py) stamps every
+    manifest's ``meta.health``; rollback-to-last-good selects with this:
+    checkpoints stamped ``suspect`` (taken inside an active anomaly) are
+    passed over, and ``max_step`` bounds the search to snapshots at or
+    before the last known-good step — the newest checkpoint may already
+    carry a loss spike's damage.  Manifests without a stamp (pre-
+    guardian, foreign writers) count as healthy.
+    """
+    for step, path in reversed(list_checkpoints(root, valid_only=True,
+                                                deep=deep)):
+        if max_step is not None and step > int(max_step):
+            continue
+        try:
+            manifest = read_manifest(path)
+        except (OSError, ValueError, MXNetError):
+            continue
+        health = (manifest.get("meta") or {}).get("health") or {}
+        if health.get("status", "healthy") == "healthy":
+            return path
+    return None
+
+
 def gc(root, keep_last):
     """Retention: drop all but the newest `keep_last` VALID checkpoints,
     plus any torn directory older than the newest valid one (a torn dir

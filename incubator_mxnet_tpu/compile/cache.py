@@ -124,10 +124,20 @@ class ProgramCache:
         self.sources = []
         self._limit_mb = limit_mb
         self.counters = {"compiles": 0, "mem_hits": 0, "disk_hits": 0,
-                         "stores": 0, "corrupt": 0, "evicted": 0,
-                         "errors": 0, "fallbacks": 0}
+                         "live_hits": 0, "stores": 0, "corrupt": 0,
+                         "evicted": 0, "errors": 0, "fallbacks": 0}
         self.events = []       # per-compile: {label, signature} (capped)
         self._programs = []    # weakrefs of live CachedPrograms
+        # live tier: entry-key -> the loaded executable THIS process
+        # already holds.  An in-process restart (fit failover, guardian
+        # rollback, supervisor shrink-and-resume) rebuilds its fused
+        # steps; without this tier the rebuilt wrapper would deserialize
+        # a CLONE of an executable that is still alive in this process —
+        # wasted work, and with the original alive the clone's teardown
+        # double-frees runtime state on this jaxlib (observed glibc heap
+        # corruption).  Bounded LRU; entries are dropped oldest-first.
+        self._live = {}
+        self._live_cap = 64
         # keys whose entry was found corrupt/stale in a READ-ONLY source
         # (we cannot delete there): the next export of that key rewrites
         # instead of skipping the existing bad file
@@ -180,6 +190,25 @@ class ProgramCache:
 
     def enabled(self):
         return self.directory is not None or bool(self.sources)
+
+    # -- live tier (in-process executables) ----------------------------------
+    def live_get(self, key):
+        """The already-loaded executable for `key`, if this process holds
+        one (compiled or deserialized earlier) — the in-process restart
+        fast path: no compile, no deserialize."""
+        with self._lock:
+            exe = self._live.get(key)
+            if exe is not None:
+                self.counters["live_hits"] += 1
+                # LRU touch
+                self._live[key] = self._live.pop(key)
+            return exe
+
+    def live_put(self, key, exe):
+        with self._lock:
+            self._live[key] = exe
+            while len(self._live) > self._live_cap:
+                self._live.pop(next(iter(self._live)))
 
     # -- lookup / store ------------------------------------------------------
     def _paths(self, key):
@@ -403,11 +432,12 @@ class ProgramCache:
             })
         counters["mem_hits"] = counters.get("mem_hits", 0) + mem_hits
         lookups = counters["compiles"] + counters["mem_hits"] + \
-            counters["disk_hits"]
+            counters["disk_hits"] + counters.get("live_hits", 0)
         return {
             "counters": counters,
             "hit_rate": round((counters["mem_hits"] +
-                               counters["disk_hits"]) / lookups, 4)
+                               counters["disk_hits"] +
+                               counters.get("live_hits", 0)) / lookups, 4)
             if lookups else None,
             "disk_enabled": self.enabled(),
             "directory": self.directory,

@@ -137,21 +137,36 @@ class CachedProgram:
         if not _enabled():
             return _PLAIN
         key = None
-        if self.graph_key is not None and cache.enabled():
+        if self.graph_key is not None:
             key = self._entry_key(sig)
-            exe = cache.load(key)
+            # live tier first: an in-process restart (fit failover,
+            # guardian rollback, supervisor shrink-and-resume) rebuilds
+            # its wrappers around executables this process ALREADY holds
+            # — reuse them directly.  Deserializing a disk clone of a
+            # still-live executable is never correct here: wasted work,
+            # and the clone's coexistence with the original corrupts
+            # runtime state on teardown (see ProgramCache._live).
+            exe = cache.live_get(key)
             if exe is not None:
-                self.disk_hits += 1
                 self._entry_keys[sig] = key
                 return exe
+            if cache.enabled():
+                exe = cache.load(key)
+                if exe is not None:
+                    self.disk_hits += 1
+                    self._entry_keys[sig] = key
+                    cache.live_put(key, exe)
+                    return exe
         sig_repr = "%d leaves: %s" % (len(sig[1]), repr(sig[1])[:160])
         cache.note_compile(self.label, sig_repr)
         self.compile_count += 1
         exe = self._jit.lower(*args).compile()
         if key is not None:
-            if cache.store(key, exe, meta={"label": self.label,
-                                           "graph": self.graph_key,
-                                           "donate": list(self._donate)}):
+            cache.live_put(key, exe)
+            if cache.enabled() and \
+                    cache.store(key, exe, meta={"label": self.label,
+                                                "graph": self.graph_key,
+                                                "donate": list(self._donate)}):
                 self._entry_keys[sig] = key
         return exe
 

@@ -183,6 +183,41 @@ KNOBS = {
                                          "which even interactive "
                                          "requests are shed (the last "
                                          "line before queue collapse)"),
+    # -- training guardian (resilience/guardian.py) --------------------------
+    "MXNET_GUARDIAN": (_BOOL, True, "honored",
+                       "training health guardian in Module.fit: in-graph "
+                       "all-finite + gradient-norm health word on the "
+                       "fused step, skip-batch on non-finite updates, "
+                       "rollback-to-last-good on loss spikes (with a "
+                       "checkpoint_dir), bad-batch quarantine"),
+    "MXNET_GUARDIAN_INTERVAL": (int, 8, "honored",
+                                "trained steps between health-word "
+                                "polls: the device scalars accumulate "
+                                "and are gathered in ONE host read per "
+                                "interval (no per-step host sync)"),
+    "MXNET_GUARDIAN_SPIKE_WINDOW": (int, 16, "honored",
+                                    "EWMA window (and warmup step "
+                                    "count) of the loss-spike detector "
+                                    "over the gradient-norm signal"),
+    "MXNET_GUARDIAN_SPIKE_K": (float, 6.0, "honored",
+                               "k-sigma divergence of the health "
+                               "signal over its EWMA diagnosed as a "
+                               "loss spike (rollback trigger)"),
+    "MXNET_GUARDIAN_MAX_FAILURES": (int, 3, "honored",
+                                    "consecutive unhealthy steps "
+                                    "before the guardian escalates to "
+                                    "TrainingDivergedError naming "
+                                    "step, signal, and data shard"),
+    "MXNET_GUARDIAN_MAX_ROLLBACKS": (int, 2, "honored",
+                                     "rollback-to-last-good budget per "
+                                     "fit; past it a spike escalates "
+                                     "to TrainingDivergedError"),
+    "MXNET_GUARDIAN_QUARANTINE": (str, "", "honored",
+                                  "bad-data quarantine JSONL path "
+                                  "(default: <checkpoint_dir>/"
+                                  "quarantine.jsonl); quarantined "
+                                  "positions/records are skipped on "
+                                  "resume"),
     "MXNET_FIT_MAX_RESTARTS": (int, 2, "honored",
                                "Module.fit auto-restarts from the last "
                                "checkpoint after ServerLostError or "

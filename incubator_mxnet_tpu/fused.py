@@ -165,6 +165,49 @@ def _apply_traced(opt, indices, ws, gs, ss, ctx, lr_vec, wd_vec, t_vec,
         opt.__dict__.update(saved)
 
 
+def reown_for_donation(tree):
+    """Re-materialize every array leaf of `tree` through one jitted XLA
+    copy, so the returned buffers are exclusively owned by this
+    process's XLA computations.
+
+    Why: a donated dispatch through an AOT executable (the unified
+    program cache's `jit.lower().compile()` path, or an executable
+    deserialized from the disk tier) silently corrupts buffers that
+    came from `jax.device_put` of HOST memory — checkpoint restores,
+    external `set_params`, epoch-boundary param syncs all stage arrays
+    that way.  The plain `jax.jit` dispatch path defensively copies
+    such inputs; the AOT call path does not, and XLA's in-place reuse
+    of the donated buffer then races whatever still aliases the staged
+    host copy (observed: nondeterministically wrong resumed-training
+    params at ~30-50%, and glibc heap corruption for the in-process
+    deserialize variant).  Fused steps call this on every COLD dispatch
+    — the only time externally-staged buffers can enter the donated
+    carry; the steady-state fast path (our own previous outputs) never
+    pays it.  The copy is one fused program per signature (jax.jit's
+    own cache), not a per-leaf dispatch."""
+    import jax
+    import jax.numpy as jnp
+
+    def copy_leaf(x):
+        if not hasattr(x, "dtype"):
+            return x
+        if x.dtype == jnp.bool_:
+            return jnp.logical_or(x, False)
+        # multiply by one: bitwise identity for every float/int/uint
+        # dtype, and inside a non-donating jit the output is a FRESH
+        # buffer (a bare identity could be forwarded/aliased by XLA)
+        return x * jnp.ones((), x.dtype)
+
+    global _REOWN_JIT
+    if _REOWN_JIT is None:
+        _REOWN_JIT = jax.jit(
+            lambda t: jax.tree_util.tree_map(copy_leaf, t))
+    return _REOWN_JIT(tree)
+
+
+_REOWN_JIT = None
+
+
 # NOTE on donation safety (formerly a _AotCall pre-validation wrapper):
 # donation consumes the caller's persistent buffers only when the compiled
 # executable actually RUNS — a failed trace or compile raises before
@@ -677,9 +720,29 @@ class FusedTrainStep:
         self._carry = None  # steady-state fast-path cache (see _dispatch)
         self._block_view = None  # per-step metric exposure for bursts
         self._derive_ws = False  # set by _build_core (see _master_positions)
+        self._guardian = None    # resilience.guardian.TrainingGuardian
+        self._guard = False      # in-graph health word armed (see below)
         FusedTrainStep._seq = getattr(FusedTrainStep, "_seq", 0) + 1
         self._audit_key = f"FusedTrainStep#{FusedTrainStep._seq}"
         self._step_no = 0   # donation-tracker step counter
+
+    def attach_guardian(self, guardian):
+        """Arm (or disarm, with None) the training guardian's in-graph
+        health word: the step core gains an all-finite + gradient-norm
+        reduction and a conditional update (a non-finite step's weight/
+        state/aux/metric updates are `where`-selected away while RNG key
+        and update counts advance — the deterministic skip-batch path).
+        Flipping the armed state drops the traced cores so the next
+        dispatch rebuilds with (or without) the health machinery."""
+        armed = guardian is not None and getattr(guardian, "in_graph",
+                                                 True)
+        self._guardian = guardian
+        if armed != self._guard:
+            self._guard = armed
+            self._core_closed = None
+            self._core_cache = {}
+            self._carry = None
+            self._t_vec = None
 
     # -- placement of persistent buffers -------------------------------------
     # Every call normalizes buffer shardings (a no-op once placed): other
@@ -841,10 +904,16 @@ class FusedTrainStep:
                           for n in self._param_names]
         derive = self._derive_ws
         w_dtypes = self._w_dtypes
+        guard = self._guard
 
         def core(inner, x, fixed, rescale):
             ws, ss, auxs, mcarry, key, t_vec = inner
-            inputs, lr_vec, wd_vec = x
+            if guard:
+                # gmul: the guardian's per-step gradient multiplier (1.0
+                # in production; NaN / spike-scale under fault injection)
+                inputs, lr_vec, wd_vec, gmul = x
+            else:
+                inputs, lr_vec, wd_vec = x
             if derive:
                 ws = [jax.tree_util.tree_leaves(s)[p].astype(dt)
                       for s, p, dt in zip(ss, mp_pos, w_dtypes)]
@@ -881,8 +950,53 @@ class FusedTrainStep:
                 if jnp.issubdtype(o.dtype, jnp.floating)
                 else jnp.zeros(o.shape, o.dtype) for o in outs)
             (grads,) = vjp(cts)
+            if guard:
+                grads = [g * jnp.asarray(gmul, g.dtype) for g in grads]
             new_ws, new_ss = _apply_traced(opt, indices, ws, grads, ss, ctx,
                                            lr_vec, wd_vec, t_vec, rescale)
+            if guard:
+                # the health word, computed where the data lives: one
+                # all-finite reduction over grads + floating outputs +
+                # the applied update, and the spike detector's signal —
+                # the parameter-DISPLACEMENT ratio ||new_w - w|| / ||w||.
+                # (A gradient norm is a poor damage proxy: a wrecked
+                # model can saturate into normal-looking gradients, and
+                # a converged model's gradient noise spans decades.  The
+                # displacement ratio measures the damage itself.)
+                parts = [jnp.isfinite(g).all() for g in grads]
+                parts += [jnp.isfinite(o).all() for o in outs
+                          if jnp.issubdtype(o.dtype, jnp.floating)]
+                parts += [jnp.isfinite(nw).all() for nw in new_ws]
+                finite = parts[0]
+                for p in parts[1:]:
+                    finite = jnp.logical_and(finite, p)
+                unorm2 = sum(
+                    jnp.sum(jnp.square(nw.astype(jnp.float32)
+                                       - w.astype(jnp.float32)))
+                    for nw, w in zip(new_ws, ws))
+                wnorm2 = sum(
+                    jnp.sum(jnp.square(w.astype(jnp.float32)))
+                    for w in ws)
+                signal = jnp.sqrt(unorm2) / (jnp.sqrt(wnorm2)
+                                             + jnp.float32(1e-12))
+                # skip-batch: a non-finite step's updates are refused IN
+                # THE PROGRAM — weights/optimizer state/aux keep their
+                # input values; RNG key and update counts still advance,
+                # so a skipped step is deterministic and reproducible
+                def keep(new, old):
+                    return jax.tree_util.tree_map(
+                        lambda n, o: jnp.where(finite, n,
+                                               o.astype(n.dtype)),
+                        new, old)
+
+                new_ws = [jnp.where(finite, nw, w.astype(nw.dtype))
+                          for nw, w in zip(new_ws, ws)]
+                new_ss = tuple(keep(ns, s) for ns, s in zip(new_ss, ss))
+            if guard:
+                # BN aux updated by a non-finite forward is refused too
+                new_aux = tuple(
+                    jnp.where(finite, na, a.astype(na.dtype))
+                    for na, a in zip(new_aux, auxs))
             # keep the persistent carries in their input layout (replicated
             # for DP; whatever the user sharded for TP/ZeRO)
             new_ss = tuple(_constrain_like(s, sh)
@@ -899,12 +1013,22 @@ class FusedTrainStep:
             new_mcarry = []
             for (fn, _), (msum, mnum) in zip(metric_fns, mcarry):
                 dsum, dnum = fn(list(labels), list(outs))
+                dsum = jnp.asarray(dsum, jnp.float32)
+                dnum = jnp.asarray(dnum, jnp.int32)
+                if guard:
+                    # a skipped batch must not poison the metric totals
+                    dsum = jnp.where(finite, dsum, jnp.zeros_like(dsum))
+                    dnum = jnp.where(finite, dnum, jnp.zeros_like(dnum))
                 # counts carry as int32: float32 would silently stop
                 # incrementing past 2^24 samples
-                new_mcarry.append((msum + jnp.asarray(dsum, jnp.float32),
-                                   mnum + jnp.asarray(dnum, jnp.int32)))
+                new_mcarry.append((msum + dsum, mnum + dnum))
             new_inner = (new_ws, new_ss, tuple(new_aux), tuple(new_mcarry),
                          key, t_vec)
+            if guard:
+                # per-step health word: fetched asynchronously by the
+                # guardian (device scalars; no host sync on this path)
+                return new_inner, (tuple(outs),
+                                   (finite.astype(jnp.float32), signal))
             return new_inner, tuple(outs)
 
         return core
@@ -1077,6 +1201,11 @@ class FusedTrainStep:
                                                for s in states)
                 self._call_a_shardings = [getattr(a, "sharding", None)
                                           for a in auxs]
+                # cold dispatch: these arrays may be externally staged
+                # (checkpoint restore, set_params at epoch boundaries) —
+                # donating host-staged buffers into an AOT executable
+                # corrupts them; re-own through one XLA copy first
+                ws, ss, auxs = reown_for_donation((ws, ss, auxs))
 
             mcarry = []
             for fn, m in metric_fns:
@@ -1132,16 +1261,27 @@ class FusedTrainStep:
         t_vec = getattr(self, "_t_vec", None) if carry is not None else None
         if t_vec is None:
             # seed the in-graph counter with counts BEFORE this block (the
-            # program itself adds +1 per step)
-            t_vec = jax.device_put(_np.asarray(
+            # program itself adds +1 per step); re-owned — it is donated,
+            # and device_put of host memory must not be (see
+            # reown_for_donation)
+            t_vec = reown_for_donation(jax.device_put(_np.asarray(
                 [opt._index_update_count[i] - k for i in self._indices],
-                _np.float32), self._rep_sharding)
+                _np.float32), self._rep_sharding))
 
         inner = (() if self._derive_ws and self._core_closed is not None
                  else tuple(ws), ss, tuple(auxs), tuple(mcarry),
                  self._key, t_vec)
-        xs = [(tuple(inp), lr_j, wd_j)
-              for inp, (lr_j, wd_j) in zip(xs_inputs, rows)]
+        if self._guard:
+            # the guardian's per-step gradient multipliers (1.0 outside
+            # fault injection) ride the per-step inputs, and the site
+            # hooks grad.nonfinite / loss.spike fire here — once per step
+            gmuls = self._guardian.step_multipliers(k)
+            xs = [(tuple(inp), lr_j, wd_j, gm)
+                  for inp, (lr_j, wd_j), gm
+                  in zip(xs_inputs, rows, gmuls)]
+        else:
+            xs = [(tuple(inp), lr_j, wd_j)
+                  for inp, (lr_j, wd_j) in zip(xs_inputs, rows)]
 
         if _analysis.enabled():
             # name every donated carry leaf BEFORE the consuming dispatch:
@@ -1181,6 +1321,10 @@ class FusedTrainStep:
         except Exception as e:
             opt._index_update_count = counts_before
             opt.num_update = num_update_before
+            if self._guard:
+                # the block never dispatched: the guardian's step counter
+                # must not count it (the unfused fallback is unguarded)
+                self._guardian._gstep -= k
             try:
                 _raise_if_unrecoverable("fused train step", e,
                                         self._donation_groups(ws, ss, auxs))
@@ -1200,6 +1344,16 @@ class FusedTrainStep:
                          str(e)[:300])
             return False
 
+        health = None
+        if self._guard:
+            # step_out is (outputs, (ok, signal)): split the health word
+            # off the output views (device arrays — the guardian gathers
+            # them asynchronously, never on this path)
+            if ys is not None:
+                ys, health = ys
+                outs = outs[0]
+            else:
+                outs, health = outs
         new_ws, new_ss, new_aux, new_mcarry, new_key, new_t = new_inner
         finals = []
         for (fn, m), pend in zip(metric_fns, new_mcarry):
@@ -1249,6 +1403,8 @@ class FusedTrainStep:
             # first step of a signature: write through immediately so the
             # `_seen_*` identity snapshots exist for the fast-path check
             self.flush()
+        if health is not None:
+            self._guardian.record_health(k, health[0], health[1])
         return True
 
     def _donation_groups(self, ws, ss, auxs):

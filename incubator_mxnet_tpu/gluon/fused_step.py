@@ -310,6 +310,12 @@ class GluonFusedStep:
             ws = [p._data[0]._data for p in self._train_params]
             auxs = tuple(p._data[0]._data for p in self._aux_params)
             ss = tuple(_state_data(s) for s in states)
+            # cold dispatch: params/states may be externally staged
+            # (initialize, load_parameters, trainer-state restore) —
+            # donated host-staged buffers corrupt under the AOT path;
+            # re-own through one XLA copy (fused.reown_for_donation)
+            from ..fused import reown_for_donation
+            ws, auxs, ss = reown_for_donation((ws, auxs, ss))
 
         mcarry = []
         for m in self._metrics:
@@ -327,9 +333,10 @@ class GluonFusedStep:
                                                dev)
         t_vec = self._t_vec if carry is not None else None
         if t_vec is None:
-            t_vec = jax.device_put(_np.asarray(
+            from ..fused import reown_for_donation
+            t_vec = reown_for_donation(jax.device_put(_np.asarray(
                 [opt._index_update_count[i] - k for i in self._indices],
-                _np.float32), dev)
+                _np.float32), dev))
 
         inner = (tuple(ws), tuple(auxs), ss, tuple(mcarry), t_vec)
         xs = [(dval, lval, lr_j, wd_j)

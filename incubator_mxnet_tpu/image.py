@@ -292,7 +292,52 @@ class ImageIter(DataIter):
         self.cur = 0
         self.data_name = data_name
         self.label_name = label_name
+        self.corrupt_records = 0   # undecodable/corrupt samples skipped
+        self._quarantine = None
         self.reset()
+
+    def set_quarantine(self, log):
+        """Attach a quarantine log (resilience.guardian.QuarantineLog):
+        corrupt samples this iterator skips append one entry each, and
+        the underlying RecordIO reader's structural skips do too."""
+        self._quarantine = log
+        if self.imgrec is not None and hasattr(self.imgrec,
+                                               "set_quarantine"):
+            self.imgrec.set_quarantine(log)
+
+    def apply_quarantine(self, entries):
+        """Drop records previously quarantined for this source (resume
+        path): their ids never enter the epoch sequence again."""
+        if self.seq is None:
+            return
+        bad = {int(e["record"]) for e in entries
+               if e.get("record") is not None and e.get("source") in (
+                   None, getattr(self.imgrec, "uri", None))}
+        if bad:
+            self.seq = [k for k in self.seq if k not in bad]
+
+    def _corrupt_sample(self, idx, exc):
+        self.corrupt_records += 1
+        import logging
+        logging.getLogger(__name__).warning(
+            "ImageIter: skipping corrupt record %s (%s) — "
+            "corrupt_records=%d", idx, str(exc)[:120],
+            self.corrupt_records)
+        if self._quarantine is not None:
+            try:
+                self._quarantine.append(
+                    reason="corrupt_record",
+                    source=getattr(self.imgrec, "uri", None),
+                    record=idx if isinstance(idx, int) else None,
+                    detail=str(exc)[:200])
+            except Exception:
+                pass
+        try:
+            from .resilience import faults as _faults
+            _faults.note("corrupt-record", site="io.corrupt_record",
+                         record=idx if isinstance(idx, int) else -1)
+        except Exception:
+            pass
 
     @property
     def provide_data(self):
@@ -312,11 +357,13 @@ class ImageIter(DataIter):
         self.cur = 0
 
     def next_sample(self):
+        self._last_idx = None
         if self.seq is not None:
             if self.cur >= len(self.seq):
                 raise StopIteration
             idx = self.seq[self.cur]
             self.cur += 1
+            self._last_idx = idx
             if self.imgrec is not None:
                 s = self.imgrec.read_idx(idx)
                 header, img = _recordio.unpack(s)
@@ -338,8 +385,17 @@ class ImageIter(DataIter):
         pad = 0
         try:
             while i < self.batch_size:
-                label, buf = self.next_sample()
-                img = imdecode(buf)
+                try:
+                    label, buf = self.next_sample()
+                    img = imdecode(buf)
+                except StopIteration:
+                    raise
+                except Exception as e:
+                    # a corrupt record (torn payload, bit-flipped JPEG,
+                    # bad header) must not kill the epoch: skip it with
+                    # a counted warning and feed the quarantine log
+                    self._corrupt_sample(self._last_idx, e)
+                    continue
                 for aug in self.auglist:
                     img = aug(img)
                 arr = img.asnumpy()
@@ -420,10 +476,22 @@ class ImageRecordIterImpl(DataIter):
         self._device_augment = bool(device_augment)
 
         import mmap
+        self._path_imgrec = path_imgrec
         self._file = open(path_imgrec, "rb")
         self._buf = mmap.mmap(self._file.fileno(), 0,
                               access=mmap.ACCESS_READ)
-        self._records = _index_records(self._buf)
+        self._records, n_corrupt = _index_records_tolerant(self._buf)
+        # structural damage found at index time (torn tail, bad magic)
+        # plus per-sample decode failures found by the batch builders
+        self.corrupt_records = n_corrupt
+        self._corrupt_lock = _alocks.make_lock("image.corrupt")
+        self._quarantine = None
+        if n_corrupt:
+            import logging
+            logging.getLogger(__name__).warning(
+                "ImageRecordIter: %s holds %d corrupt region(s); the "
+                "damaged records are skipped (corrupt_records counts "
+                "them)", path_imgrec, n_corrupt)
         if num_parts > 1:
             # contiguous shards; the remainder spreads over the first
             # parts so every record belongs to exactly one part
@@ -474,6 +542,62 @@ class ImageRecordIterImpl(DataIter):
                      n % self.batch_size else n // self.batch_size)
         self._pool = _BatchPool(self._build_batch, n_batches, self._threads,
                                 self._prefetch)
+
+    def set_quarantine(self, log):
+        """Attach a quarantine log: corrupt records the batch builders
+        skip append one entry each (source path + record id)."""
+        self._quarantine = log
+
+    def apply_quarantine(self, entries):
+        """Drop previously quarantined record ids for this .rec file
+        from the epoch order (resume path: a poisoned record is read
+        exactly zero times after diagnosis).  `self._records` is left
+        INTACT — record ids must stay stable so entries this run logs
+        later still attribute correctly on the next resume; only the
+        epoch order loses the poisoned ids."""
+        bad = {int(e["record"]) for e in entries
+               if e.get("record") is not None and
+               e.get("source") in (None, self._path_imgrec)}
+        if bad:
+            self._order = np.asarray(
+                [i for i in self._order if int(i) not in bad])
+            # rebuild the batch pool for the shorter order without
+            # advancing the epoch counter (reset() increments it, and
+            # the augmentation RNG streams key on the epoch)
+            self._epoch -= 1
+            self.reset()
+
+    def record_range(self, nbatch):
+        """(source, lo, hi) record-position range batch `nbatch` of this
+        epoch draws from — the guardian's shard attribution for
+        quarantine entries and TrainingDivergedError."""
+        lo = int(nbatch) * self.batch_size
+        return (self._path_imgrec, lo,
+                min(lo + self.batch_size, len(self._order)))
+
+    def _corrupt_record(self, rec_id, exc):
+        with self._corrupt_lock:
+            self.corrupt_records += 1
+            n = self.corrupt_records
+        import logging
+        logging.getLogger(__name__).warning(
+            "ImageRecordIter: record %d of %s is corrupt (%s) — "
+            "substituting zeros and quarantining (corrupt_records=%d)",
+            rec_id, self._path_imgrec, str(exc)[:120], n)
+        if self._quarantine is not None:
+            try:
+                self._quarantine.append(reason="corrupt_record",
+                                        source=self._path_imgrec,
+                                        record=int(rec_id),
+                                        detail=str(exc)[:200])
+            except Exception:
+                pass
+        try:
+            from .resilience import faults as _faults
+            _faults.note("corrupt-record", site="io.corrupt_record",
+                         record=int(rec_id))
+        except Exception:
+            pass
 
     def close(self):
         if self._pool is not None:
@@ -530,16 +654,26 @@ class ImageRecordIterImpl(DataIter):
         imgs = []
         # row-major per-field layout: each row is contiguous for ctypes
         dims = np.empty((4, bs), np.int64)  # rows: ih, iw, y0, x0
+        from .resilience import faults as _faults
         for i in range(bs):
-            rec_id = self._order[(base + i) % n_rec]
-            segs = self._records[rec_id]
-            header, payload = _recordio.unpack(
-                _record_payload(self._buf, segs))
-            img = self._decode(payload, cv2, need)
-            if img is None:
-                raise MXNetError(
-                    f"ImageRecordIter: record {int(rec_id)} is not a "
-                    "decodable image")
+            rec_id = int(self._order[(base + i) % n_rec])
+            header = img = None
+            try:
+                raw = _record_payload(self._buf, self._records[rec_id])
+                # the payload fault site: a `corrupt` clause bit-flips
+                # this record's bytes deterministically
+                raw = _faults.mutate("io.corrupt_record", bytes(raw),
+                                     record=rec_id)
+                header, payload = _recordio.unpack(raw)
+                img = self._decode(payload, cv2, need)
+                if img is None:
+                    raise MXNetError("not a decodable image")
+            except Exception as e:
+                # a corrupt record must not kill the epoch: substitute a
+                # zero image (deterministic), count, and quarantine —
+                # the resumed run drops the record entirely
+                self._corrupt_record(rec_id, e)
+                header, img = None, np.zeros((h, w, c), np.uint8)
             if self._resize:
                 ih, iw = img.shape[:2]
                 if ih > iw:
@@ -561,9 +695,10 @@ class ImageRecordIterImpl(DataIter):
                 img = np.ascontiguousarray(img)
             imgs.append(img)
             dims[:, i] = (ih, iw, y0, x0)
-            lab = np.asarray(header.label, dtype="float32").reshape(-1)
-            label[i, :min(len(lab), self.label_width)] = \
-                lab[:self.label_width]
+            if header is not None:
+                lab = np.asarray(header.label, dtype="float32").reshape(-1)
+                label[i, :min(len(lab), self.label_width)] = \
+                    lab[:self.label_width]
 
         # fresh buffer each batch: handed to jax ZERO-COPY below (cpu) or
         # consumed by an async transfer (accelerator) — never recycled, so
@@ -726,31 +861,37 @@ def _group_parts(parts):
     """Group (offset, length, cflag) physical parts into logical records:
     cflag 0 stands alone; 1/2*/3 sequences form one multi-part record
     (dmlc writers split payloads containing the magic word; see
-    `recordio.MXRecordIO.read`)."""
+    `recordio.MXRecordIO.read`).  Structural violations — a truncated
+    multi-part sequence, a continuation without a start — drop the
+    damaged record and count it instead of raising: a torn tail must not
+    make the whole .rec unreadable.  Returns (records, n_corrupt)."""
     records = []
     pending = None
+    corrupt = 0
     for off, ln, cf in parts:
         if cf == 0:
             if pending is not None:
-                raise MXNetError("RecordIO: truncated multi-part record")
+                corrupt += 1     # interrupted multi-part: drop it
+                pending = None
             records.append([(off, ln)])
         elif cf == 1:
             if pending is not None:
-                raise MXNetError("RecordIO: nested multi-part record start")
+                corrupt += 1
             pending = [(off, ln)]
         elif cf in (2, 3):
             if pending is None:
-                raise MXNetError(
-                    f"RecordIO: continuation flag {cf} without a start part")
+                corrupt += 1     # continuation without a start
+                continue
             pending.append((off, ln))
             if cf == 3:
                 records.append(pending)
                 pending = None
         else:
-            raise MXNetError(f"RecordIO: invalid cflag {cf}")
+            corrupt += 1
+            pending = None
     if pending is not None:
-        raise MXNetError("RecordIO: truncated multi-part record at EOF")
-    return records
+        corrupt += 1             # truncated multi-part record at EOF
+    return records, corrupt
 
 
 _REC_MAGIC = __import__("struct").pack("<I", 0xced7230a)
@@ -766,12 +907,20 @@ def _record_payload(buf, segments):
     return _REC_MAGIC.join(bytes(buf[off:off + ln]) for off, ln in segments)
 
 
-def _index_records(buf):
+def _index_records_tolerant(buf):
     """Segment lists of every logical record payload — native scan when
-    the library is built, struct-walk fallback otherwise.  Each entry is a
-    list of (offset, length) parts; pass to `_record_payload`."""
+    the library is built, struct-walk fallback otherwise.  Each entry is
+    a list of (offset, length) parts; pass to `_record_payload`.
+
+    Tolerant of damage: a magic mismatch resynchronizes on the next
+    magic word (the bytes in between are one counted corrupt region), a
+    truncated tail record stops the scan, and broken multi-part
+    sequences are dropped — see `_group_parts`.  A native scan that
+    reports invalid structure (-1) falls back to the tolerant walk
+    instead of raising.  Returns (records, n_corrupt)."""
     nat = _native.lib()
     parts = None
+    corrupt = 0
     if nat is not None:
         cap = max(1024, len(buf) // 12)
         offs = np.empty(cap, dtype=np.int64)
@@ -784,26 +933,50 @@ def _index_records(buf):
             offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             cfls.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), cap)
-        if n == -1:
-            raise MXNetError("Invalid RecordIO magic")
         if n >= 0:
-            parts = zip(offs[:n].tolist(), lens[:n].tolist(),
-                        cfls[:n].tolist())
+            parts = list(zip(offs[:n].tolist(), lens[:n].tolist(),
+                             cfls[:n].tolist()))
+            # the native scan stops silently at a truncated tail; any
+            # unconsumed bytes past the last indexed part are one
+            # corrupt region (a torn header/payload a writer left)
+            end = 0
+            if parts:
+                off, ln, _ = parts[-1]
+                end = off + ln + (4 - ln % 4) % 4
+            if len(buf) - end > 0:
+                corrupt += 1
+        # n == -1: the native scan found invalid structure — take the
+        # tolerant python walk below instead of refusing the file
     if parts is None:
         import struct as _struct
+        magic_bytes = _struct.pack("<I", 0xced7230a)
         out = []
         pos = 0
         while pos + 8 <= len(buf):
             magic, lrec = _struct.unpack_from("<II", buf, pos)
             if magic != 0xced7230a:
-                raise MXNetError("Invalid RecordIO magic")
+                # resynchronize on the next magic word; the skipped
+                # bytes are one corrupt region
+                corrupt += 1
+                hit = buf.find(magic_bytes, pos + 1)
+                if hit == -1:
+                    break
+                pos = hit
+                continue
             length = lrec & ((1 << 29) - 1)
             if pos + 8 + length > len(buf):
+                corrupt += 1     # truncated tail record
                 break
             out.append((pos + 8, length, lrec >> 29))
             pos += 8 + length + (4 - length % 4) % 4
         parts = out
-    return _group_parts(parts)
+    records, n_bad = _group_parts(parts)
+    return records, corrupt + n_bad
+
+
+def _index_records(buf):
+    """Back-compat face of `_index_records_tolerant`: records only."""
+    return _index_records_tolerant(buf)[0]
 
 
 # detection pipeline shares this namespace in the reference (mx.image.*)

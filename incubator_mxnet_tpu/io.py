@@ -180,6 +180,14 @@ class DataIter:
     def set_checkpoint_state(self, state, nbatch=0):
         self.seek(nbatch)
 
+    # -- guardian attribution (resilience/guardian.py) -------------------------
+    def record_range(self, nbatch):
+        """(source, lo, hi) describing where batch `nbatch` of this epoch
+        draws its records from — the training guardian's shard
+        attribution for quarantine entries and TrainingDivergedError.
+        None when the iterator cannot attribute (the default)."""
+        return None
+
 
 class NDArrayIter(DataIter):
     """Iterate over in-memory arrays (reference `io.py:546 NDArrayIter`):
@@ -294,6 +302,15 @@ class NDArrayIter(DataIter):
         if "epoch_cursor0" in state:
             self._epoch_cursor0 = int(state["epoch_cursor0"])
         self.seek(nbatch)
+
+    def record_range(self, nbatch):
+        """Sample-index window batch `nbatch` of this epoch draws from
+        (guardian shard attribution; the shuffle permutation maps the
+        window onto actual rows).  Batch n's data starts at the
+        epoch-start cursor plus (n+1) strides (see `seek`)."""
+        lo = max(self._epoch_cursor0 + (int(nbatch) + 1) * self.batch_size,
+                 0)
+        return ("ndarray", lo, min(lo + self.batch_size, self.num_data))
 
 
 def _init_data(data, allow_empty, default_name):

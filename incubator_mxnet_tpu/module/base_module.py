@@ -26,6 +26,7 @@ class BaseModule:
         self._symbol = None
         self._total_exec_bytes = 0
         self._supervisor = None   # JobSupervisor of the last dist fit
+        self._guardian = None     # TrainingGuardian of the current fit
 
     # -- high-level API --------------------------------------------------------
     def forward_backward(self, data_batch):
@@ -228,13 +229,27 @@ class BaseModule:
         (dense) rank, and the run restarts from the last committed
         checkpoint at the smaller world size — a fenced-out stale host
         can never rejoin and corrupt the shrunk pod.
+
+        Training guardian (resilience/guardian.py, MXNET_GUARDIAN): the
+        fused step computes an in-graph health word (all-finite + grad
+        norm) and refuses non-finite updates (**skip-batch**, positions
+        quarantined); a diagnosed loss spike triggers
+        **rollback-to-last-good** — the newest checkpoint whose manifest
+        carries a healthy ``health`` stamp at or before the last
+        in-bounds step is restored, the intervening good batches replay
+        bit-identically, and the quarantined spike window is skipped;
+        past the failure/rollback budget a structured
+        `TrainingDivergedError` names the step, signal, and data shard.
         """
         import os as _os
         from ..resilience import ServerLostError, CollectiveTimeoutError
+        from ..resilience import guardian as _guardian_mod
         if max_restarts is None:
             from .. import config as _config
             max_restarts = int(_config.get("MXNET_FIT_MAX_RESTARTS"))
         failed_over = False
+        self._guardian = _guardian_mod.TrainingGuardian.maybe_create(
+            checkpoint_dir, logger=self.logger)
         # every attempt gets the same fixed arguments; the restart loop
         # below only flips resume/force flags (one dict, not a second
         # copy of the parameter list to keep in sync)
@@ -258,6 +273,24 @@ class BaseModule:
                 return self._fit_attempt(
                     train_data, force_rebind=force_rebind,
                     force_init=force_init, resume=resume, **fixed)
+            except _guardian_mod.RollbackRequested as e:
+                # the guardian diagnosed a loss spike whose update was
+                # already applied: restore the newest HEALTHY checkpoint
+                # at or before the last in-bounds step (the guardian's
+                # pending_rollback_step bounds the resume selection) and
+                # replay — the spike window itself is quarantined, so
+                # the resumed run skips it.  Budgeted inside the
+                # guardian: past MXNET_GUARDIAN_MAX_ROLLBACKS the spike
+                # escalates to TrainingDivergedError instead.
+                if checkpoint_dir is None or self._guardian is None:
+                    raise
+                self.logger.warning(
+                    "fit: %s — restarting from the last healthy "
+                    "checkpoint in %r", e, checkpoint_dir)
+                self._teardown_kvstore()
+                resume = True
+                force_rebind = True
+                force_init = True
             except (ServerLostError, CollectiveTimeoutError,
                     ConnectionError, EOFError, TimeoutError) as e:
                 # raw connection/timeout errors are recoverable only on a
@@ -370,7 +403,15 @@ class BaseModule:
                 # read-only: the manager (writer, retention, rank layout)
                 # is built AFTER init_optimizer, when the kvstore — and
                 # with it this process's rank — is known
-                path = _ckpt.latest(checkpoint_dir)
+                g = getattr(self, "_guardian", None)
+                if g is not None and g.pending_rollback_step is not None:
+                    # rollback-to-last-good: newer checkpoints may carry
+                    # the spike's damage — select by health stamp AND
+                    # the guardian's last in-bounds step
+                    path = _ckpt.latest_healthy(
+                        checkpoint_dir, max_step=g.pending_rollback_step)
+                else:
+                    path = _ckpt.latest(checkpoint_dir)
                 ckpt_resume = _ckpt.load(path) if path is not None else None
             elif _ckpt.latest(checkpoint_dir, deep=False) is not None:
                 # a fresh run must not share a directory with an old run's
@@ -435,6 +476,19 @@ class BaseModule:
             _ckpt.state.restore_module_optimizer(
                 self, ckpt_resume.blobs.get(_ckpt.state.OPTIMIZER_BLOB))
             _ckpt.state.restore_rng(ckpt_resume.rng)
+        guardian = getattr(self, "_guardian", None)
+        if guardian is not None:
+            if guardian.pending_rollback_step is not None:
+                # the restore landed (or no healthy checkpoint existed
+                # and this attempt restarts from the caller's params) —
+                # either way the rollback is committed and the spike
+                # detector's history starts fresh
+                guardian.rollback_committed(
+                    ckpt_resume.step if ckpt_resume is not None else 0)
+            # attach AFTER every fused-step rebuild path (init_optimizer
+            # and the optimizer-state restore both construct fresh ones)
+            guardian.attach(self)
+            guardian.attach_iterator(train_data)
         if validation_metric is None:
             validation_metric = eval_metric
         if not isinstance(eval_metric, _metric.EvalMetric):
@@ -535,6 +589,7 @@ class BaseModule:
                     begin_epoch, num_epoch, ckpt_mgr, ckpt_resume,
                     resume_nbatch, gstep, last_snap_step, checkpoint_period):
         from ..resilience import faults as _faults
+        guardian = getattr(self, "_guardian", None)
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
@@ -557,6 +612,19 @@ class BaseModule:
                 end_of_batch = True
                 next_data_batch = None
             while not end_of_batch:
+                if guardian is not None and \
+                        guardian.should_skip(epoch, nbatch):
+                    # quarantined stream position: consume it, never
+                    # train on it — the position still advances so
+                    # resume bookkeeping stays aligned with the run
+                    # that wrote the quarantine entry
+                    guardian.note_skipped(epoch, nbatch)
+                    nbatch += 1
+                    try:
+                        next_data_batch = next(data_iter)
+                    except StopIteration:
+                        end_of_batch = True
+                    continue
                 # pod chaos site: a `kill` here is a whole-host SIGKILL
                 # at a step boundary (the membership deadline detects it,
                 # the survivors' watchdogs convert the stalled round)
@@ -571,6 +639,12 @@ class BaseModule:
                 block = [data_batch]
                 block_k = 1 if monitor is not None else self._fit_block_k()
                 while len(block) < block_k and not end_of_batch:
+                    if guardian is not None and guardian.should_skip(
+                            epoch, nbatch_at_entry + len(block)):
+                        # a quarantined position mid-block: stop the
+                        # block before it (it becomes the next head and
+                        # the loop-top skip consumes it)
+                        break
                     try:
                         block.append(next(data_iter))
                     except StopIteration:
@@ -622,6 +696,13 @@ class BaseModule:
                     nbatch += 1
 
                 gstep += nbatch - nbatch_at_entry
+                if guardian is not None and nbatch > nbatch_at_entry:
+                    # pair the block's health tokens with their stream
+                    # positions, then run the policy ladder every
+                    # MXNET_GUARDIAN_INTERVAL steps (one device gather;
+                    # raises RollbackRequested / TrainingDivergedError)
+                    guardian.tag(epoch, nbatch_at_entry, train_data)
+                    guardian.maybe_poll(gstep)
                 if self._supervisor is not None and nbatch > nbatch_at_entry:
                     # per-step wall time feeds the heartbeat EWMA the
                     # coordinator's straggler detection compares across
@@ -641,6 +722,10 @@ class BaseModule:
                                                nbatch, gstep)
                         last_snap_step = gstep
 
+            if guardian is not None:
+                # drain the tail of the epoch's health tokens before the
+                # boundary snapshot stamps its manifest
+                guardian.maybe_poll(gstep, force=True)
             # epoch boundary: eval scoring, param syncs, callbacks and
             # snapshots legitimately block once per epoch — not hot-loop
             # host-sync hazards (analysis.hostsync would misattribute)
@@ -698,6 +783,13 @@ class BaseModule:
         """Checkpoint gathers block by design — not hot-loop host syncs
         (hence the `paused()` wrapper above)."""
         from .. import checkpoint as _ckpt
+        guardian = getattr(self, "_guardian", None)
+        if guardian is not None:
+            # drain pending health tokens FIRST: a snapshot must never
+            # stamp itself healthy on stale evidence (an undetected
+            # spike raises here and the snapshot is not taken at all)
+            guardian.maybe_poll(step, force=True)
+            meta = dict(meta or {}, health=guardian.health_stamp())
         if mgr.rank != 0:
             # non-primary ranks publish ONLY rank-local state (this
             # worker's iterator position/permutation; its updater slots

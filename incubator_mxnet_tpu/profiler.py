@@ -18,7 +18,8 @@ from .analysis import locks as _alocks
 
 __all__ = ["set_config", "set_state", "state", "dump", "dumps", "pause",
            "resume", "Task", "Frame", "Counter", "Marker",
-           "record_memory", "record_serving", "record_supervisor"]
+           "record_memory", "record_serving", "record_supervisor",
+           "record_guardian"]
 
 _config = {"profile_all": False, "profile_symbolic": False,
            "profile_imperative": False, "profile_memory": False,
@@ -190,29 +191,38 @@ def record_serving(name, dur_us, **args):
            "args": dict(args, thread=_tname())})
 
 
+def _record_instant(cat, name, **args):
+    """One global instant event in the chrome trace with the emitting
+    thread's lane — the shared emitter behind the supervisor/guardian/
+    fault event lanes.  A no-op unless a profile is running."""
+    if not _state["running"]:
+        return
+    _emit({"name": f"{cat}:{name}", "cat": cat, "ph": "i", "s": "g",
+           "ts": time.perf_counter() * 1e6, "pid": 0, "tid": _tid(),
+           "args": dict(args, thread=_tname())})
+
+
 def record_supervisor(event, **args):
     """Record one elastic-supervisor event (host lost, straggler flagged,
     collective watchdog timeout, shrink commit — resilience.supervisor
-    feeds this) as an instant event in the chrome trace, so pod-level
-    membership churn lines up against the training steps it disrupted.
-    A no-op unless a profile is running."""
-    if not _state["running"]:
-        return
-    _emit({"name": f"supervisor:{event}", "cat": "supervisor", "ph": "i",
-           "s": "g", "ts": time.perf_counter() * 1e6, "pid": 0,
-           "tid": _tid(), "args": dict(args, thread=_tname())})
+    feeds this), so pod-level membership churn lines up against the
+    training steps it disrupted."""
+    _record_instant("supervisor", event, **args)
+
+
+def record_guardian(event, **args):
+    """Record one training-guardian event (skip-batch, rollback,
+    quarantine, divergence — resilience.guardian feeds this), so
+    numerical-health interventions line up against the training steps
+    they protected."""
+    _record_instant("guardian", event, **args)
 
 
 def record_fault(site, kind, **args):
     """Record one fired fault / resilience event (resilience.faults feeds
-    this) as an instant event in the chrome trace, so chaos-run failure
-    injections line up against the serving batches and XLA work they
-    disrupted.  A no-op unless a profile is running."""
-    if not _state["running"]:
-        return
-    _emit({"name": f"fault:{site}", "cat": "fault", "ph": "i", "s": "g",
-           "ts": time.perf_counter() * 1e6, "pid": 0, "tid": _tid(),
-           "args": dict(args, kind=kind, thread=_tname())})
+    this), so chaos-run failure injections line up against the serving
+    batches and XLA work they disrupted."""
+    _record_instant("fault", site, kind=kind, **args)
 
 
 class _Named:

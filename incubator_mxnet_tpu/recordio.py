@@ -5,10 +5,23 @@ Byte-compatible with the reference format so existing `.rec` datasets work:
 records are [magic uint32 0xced7230a][lrecord uint32][data][pad to 4B],
 where lrecord encodes cflag (3 bits) | length (29 bits).  `IRHeader`
 (flag, label, id, id2) matches `mx.recordio.IRHeader` for image records.
+
+Corruption tolerance (training-guardian io tier): a truncated/torn tail
+record, a magic mismatch, or a broken multi-part sequence used to raise
+`MXNetError` mid-epoch.  The reader now SKIPS the damaged region — it
+resynchronizes on the next magic word where possible, otherwise treats
+the tail as EOF — emits one structured warning per event (capped), and
+counts every skip on ``corrupt_records``; a quarantine log attached via
+`set_quarantine` receives one entry per skip (source + byte offset), so
+a resumed run can avoid the region entirely.  The
+``io.corrupt_record`` fault site (`resilience.faults.mutate`) fires on
+every successfully read record, so chaos schedules can bit-flip payloads
+deterministically without hand-built fixture files.
 """
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 import struct
 import numbers
@@ -16,6 +29,9 @@ import numbers
 import numpy as np
 
 from .base import MXNetError
+
+_log = logging.getLogger(__name__)
+_WARN_CAP = 5   # per-reader structured warnings before dropping to debug
 
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
            "pack_img", "unpack_img"]
@@ -31,6 +47,8 @@ class MXRecordIO:
         self.uri = uri
         self.flag = flag
         self.handle = None
+        self.corrupt_records = 0
+        self._quarantine = None
         self.open()
 
     def open(self):
@@ -43,6 +61,55 @@ class MXRecordIO:
         else:
             raise ValueError("Invalid flag %s" % self.flag)
         self.is_open = True
+        self.corrupt_records = 0
+
+    def set_quarantine(self, log):
+        """Attach a `resilience.guardian.QuarantineLog`: every corrupt
+        region this reader skips appends one entry (source + offset)."""
+        self._quarantine = log
+
+    def _corrupt(self, reason, offset=None):
+        """Count + report one skipped corrupt region (never raises)."""
+        self.corrupt_records += 1
+        where = self.uri if offset is None else f"{self.uri}@{offset}"
+        if self.corrupt_records <= _WARN_CAP:
+            _log.warning("RecordIO: skipping corrupt record in %s: %s "
+                         "(corrupt_records=%d)", where, reason,
+                         self.corrupt_records)
+        else:
+            _log.debug("RecordIO: skipping corrupt record in %s: %s",
+                       where, reason)
+        if self._quarantine is not None:
+            try:
+                self._quarantine.append(reason="corrupt_record",
+                                        source=self.uri,
+                                        offset=offset, detail=reason)
+            except Exception:
+                pass
+        try:
+            from .resilience import faults as _faults
+            _faults.note("corrupt-record", site="io.corrupt_record",
+                         uri=self.uri, detail=str(reason)[:200])
+        except Exception:
+            pass
+
+    def _resync(self):
+        """Scan forward for the next magic word; position the handle at
+        it and report success.  The skipped bytes are one counted
+        corrupt region; no magic until EOF means the tail is garbage."""
+        magic = struct.pack("<I", _MAGIC)
+        window = b""
+        while True:
+            chunk = self.handle.read(1 << 16)
+            if not chunk:
+                return False
+            window += chunk
+            hit = window.find(magic)
+            if hit != -1:
+                # rewind to the magic word (handle sits past the window)
+                self.handle.seek(hit - len(window), os.SEEK_CUR)
+                return True
+            window = window[-3:]   # a magic may straddle the boundary
 
     def close(self):
         if self.is_open:
@@ -99,19 +166,37 @@ class MXRecordIO:
             self._write_part(cflag, part)
 
     def _read_part(self):
-        header = self.handle.read(8)
-        if len(header) < 8:
-            return None, None
-        magic, lrecord = struct.unpack("<II", header)
-        if magic != _MAGIC:
-            raise MXNetError("Invalid RecordIO magic")
-        cflag = lrecord >> _CFLAG_BITS
-        length = lrecord & ((1 << _CFLAG_BITS) - 1)
-        buf = self.handle.read(length)
-        pad = (4 - length % 4) % 4
-        if pad:
-            self.handle.read(pad)
-        return cflag, buf
+        while True:
+            offset = self.handle.tell()
+            header = self.handle.read(8)
+            if not header:
+                return None, None           # clean EOF
+            if len(header) < 8:
+                # torn tail: a writer died mid-header
+                self._corrupt("short header (%d of 8 bytes)"
+                              % len(header), offset)
+                return None, None
+            magic, lrecord = struct.unpack("<II", header)
+            if magic != _MAGIC:
+                # bit-flip / foreign bytes: resynchronize on the next
+                # magic word (one counted skip); no magic -> EOF
+                self._corrupt("magic mismatch (0x%08x)" % magic, offset)
+                self.handle.seek(offset + 1)
+                if not self._resync():
+                    return None, None
+                continue
+            cflag = lrecord >> _CFLAG_BITS
+            length = lrecord & ((1 << _CFLAG_BITS) - 1)
+            buf = self.handle.read(length)
+            if len(buf) < length:
+                # torn tail: payload cut short by a dying writer
+                self._corrupt("short payload (%d of %d bytes)"
+                              % (len(buf), length), offset)
+                return None, None
+            pad = (4 - length % 4) % 4
+            if pad:
+                self.handle.read(pad)
+            return cflag, buf
 
     def read(self):
         """Read one logical record, reassembling multi-part sequences.
@@ -121,29 +206,52 @@ class MXRecordIO:
         at each split point; readers re-insert the magic between parts
         (dmlc-core recordio semantics mirrored by reference
         `src/io/` iterators).
+
+        Corrupt structure never raises: damaged regions are skipped and
+        counted on ``corrupt_records`` (see the module docstring), and
+        the assembled record passes through the ``io.corrupt_record``
+        fault site so chaos schedules can damage payloads in flight.
         """
         assert not self.writable
-        cflag, buf = self._read_part()
-        if cflag is None:
-            return None
-        if cflag == 0:
-            return buf
-        if cflag != 1:
-            raise MXNetError(
-                f"RecordIO: unexpected continuation flag {cflag} at record "
-                "start (corrupt file or reader desynchronized)")
-        parts = [buf]
         while True:
             cflag, buf = self._read_part()
             if cflag is None:
-                raise MXNetError("RecordIO: truncated multi-part record")
-            if cflag not in (2, 3):
-                raise MXNetError(
-                    f"RecordIO: invalid flag {cflag} inside multi-part record")
-            parts.append(buf)
-            if cflag == 3:
-                break
-        return struct.pack("<I", _MAGIC).join(parts)
+                return None
+            if cflag == 0:
+                return self._deliver(buf)
+            if cflag != 1:
+                # a continuation part at record start: the reader lost
+                # the sequence head (corrupt region) — skip forward
+                self._corrupt("unexpected continuation flag %d at "
+                              "record start" % cflag)
+                continue
+            parts = [buf]
+            while True:
+                cflag, buf = self._read_part()
+                if cflag is None:
+                    self._corrupt("truncated multi-part record at EOF")
+                    return None
+                if cflag == 2:
+                    parts.append(buf)
+                    continue
+                if cflag == 3:
+                    parts.append(buf)
+                    return self._deliver(
+                        struct.pack("<I", _MAGIC).join(parts))
+                # a fresh record START interrupted the sequence: the
+                # previous record is torn — drop it, adopt this part
+                self._corrupt("multi-part record interrupted by flag %d"
+                              % cflag)
+                if cflag == 0:
+                    return self._deliver(buf)
+                parts = [buf]
+
+    def _deliver(self, rec):
+        """Route one assembled record through the ``io.corrupt_record``
+        payload fault site (one global read without a configured
+        schedule — `faults.mutate`'s own fast path)."""
+        from .resilience import faults as _faults
+        return _faults.mutate("io.corrupt_record", rec, uri=self.uri)
 
     def tell(self):
         return self.handle.tell()
@@ -185,8 +293,27 @@ class MXIndexedRecordIO(MXRecordIO):
             self.fidx.close()
 
     def read_idx(self, idx):
+        """Record `idx`'s payload, or None when the region at its index
+        offset is damaged.  `read()`'s magic-mismatch resync must NOT
+        leak here: resyncing forward salvages the NEXT record, and
+        returning it as `idx`'s would silently train a misaligned
+        sample/label pair — worse than the corruption itself.  The
+        damaged id feeds the quarantine log so resume drops it."""
         self.seek(self.idx[idx])
-        return self.read()
+        before = self.corrupt_records
+        rec = self.read()
+        if self.corrupt_records != before:
+            if self._quarantine is not None:
+                try:
+                    self._quarantine.append(reason="corrupt_record",
+                                            source=self.uri,
+                                            record=int(idx)
+                                            if isinstance(idx, int)
+                                            else None)
+                except Exception:
+                    pass
+            return None
+        return rec
 
     def write_idx(self, idx, buf):
         key = self.key_type(idx)

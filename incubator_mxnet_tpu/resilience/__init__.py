@@ -39,12 +39,16 @@ from .breaker import CircuitBreaker
 from . import supervisor
 from .supervisor import (JobSupervisor, CollectiveTimeoutError,
                          HostLostError, StaleEpochError)
+from . import guardian
+from .guardian import (TrainingGuardian, TrainingDivergedError,
+                       RollbackRequested, QuarantineLog)
 
 __all__ = ["faults", "FaultInjected", "TornWrite", "configure", "inject",
            "clear", "reset", "trace", "fire", "active", "RetryPolicy",
            "RetryBudget", "CircuitBreaker", "ServerLostError", "supervisor",
            "JobSupervisor", "CollectiveTimeoutError", "HostLostError",
-           "StaleEpochError"]
+           "StaleEpochError", "guardian", "TrainingGuardian",
+           "TrainingDivergedError", "RollbackRequested", "QuarantineLog"]
 
 
 class ServerLostError(MXNetError):

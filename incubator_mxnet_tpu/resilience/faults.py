@@ -18,7 +18,9 @@ programmatic `inject()` API.  Spec grammar (clauses joined with ``;``)::
                  | server.dispatch | serving.execute | checkpoint.commit
                  | heartbeat.send | collective.dispatch | host.step
                  | router.dispatch | replica.health | replica.swap
+                 | grad.nonfinite | loss.spike | io.corrupt_record
     kind         = refuse | drop | slow | crash | torn | error | hang | kill
+                 | corrupt
 
 Firing controls (any clause):
 
@@ -26,6 +28,10 @@ Firing controls (any clause):
 * ``n=N``                — fire on the first N matching hits
 * ``p=F``                — fire with probability F from the SEEDED stream
 * ``cmd=NAME``           — only hits whose context carries ``cmd=NAME``
+* ``record=N``           — only hits whose context carries ``record=N``
+  (exact record targeting at payload sites: hit-count controls are
+  schedule-order dependent when a multi-threaded reader drives the
+  site, ``record=`` is deterministic regardless of thread interleaving)
 
 The supervisor sites model pod-scale failures: ``heartbeat.send`` with a
 ``drop`` skips one heartbeat (lossy control network), ``collective.
@@ -42,6 +48,23 @@ burst is a lossy probe network — it must cause suspicion, not
 eviction), and ``replica.swap`` fires before each replica's weight swap
 (a ``torn`` there is a swap that dies mid-roll — the fleet must keep
 serving and the roll must abort cleanly).
+
+The training-guardian sites model SILENT training failures
+(resilience/guardian.py): ``grad.nonfinite`` fires once per fused train
+step — an ``error`` there is converted by the guardian into an in-graph
+non-finite gradient for exactly that step (the skip-batch path's
+deterministic trigger); ``loss.spike`` fires the same way but scales the
+step's gradients by a large factor instead (the rollback path's
+trigger); and ``io.corrupt_record`` fires per record read through the
+`mutate()` payload hook — a ``corrupt`` clause there bit-flips the
+record's bytes deterministically, so record-level corruption is
+injectable without hand-built fixture files.
+
+The ``corrupt`` kind only fires through `mutate(site, payload)` (it
+needs bytes to damage); `fire()` ignores corrupt clauses entirely, so a
+site instrumented with both hooks keeps deterministic hit counting.
+Clause args: ``bytes=N`` bytes flipped (default 16), ``offset=K`` pins
+the first flipped byte.
 
 Every fired fault appends an event to an in-process trace
 (`resilience.trace()`), and — when ``MXNET_FAULTS_LOG`` names a file —
@@ -64,7 +87,8 @@ from ..base import MXNetError
 from ..analysis import locks as _alocks
 
 __all__ = ["FaultInjected", "TornWrite", "configure", "inject", "clear",
-           "reset", "trace", "fire", "note", "active", "parse_spec"]
+           "reset", "trace", "fire", "mutate", "note", "active",
+           "parse_spec"]
 
 
 class FaultInjected(Exception):
@@ -81,7 +105,7 @@ class TornWrite(FaultInjected):
 
 
 _KINDS = ("refuse", "drop", "slow", "crash", "torn", "error", "hang",
-          "kill")
+          "kill", "corrupt")
 _CLAUSE_RE = re.compile(
     r"^(?P<site>[\w.]+):(?P<kind>\w+)(?:\((?P<args>[^)]*)\))?$")
 
@@ -109,6 +133,7 @@ class _Clause:
         self.limit = int(args["n"]) if "n" in args else None
         self.prob = float(args["p"]) if "p" in args else None
         self.cmd = args.get("cmd")
+        self.record = int(args["record"]) if "record" in args else None
         # each probabilistic clause draws from its OWN seeded stream so
         # adding a clause never perturbs another clause's schedule
         self._rng = random.Random((seed, site, kind, repr(sorted(
@@ -118,6 +143,8 @@ class _Clause:
         if site != self.site:
             return False
         if self.cmd is not None and ctx.get("cmd") != self.cmd:
+            return False
+        if self.record is not None and ctx.get("record") != self.record:
             return False
         return True
 
@@ -305,7 +332,10 @@ def note(event, **ctx):
 def fire(site, **ctx):
     """The site hook.  Returns instantly when no faults are configured;
     otherwise evaluates each matching clause's deterministic schedule and
-    executes the first fault that fires (raise / sleep / socket close)."""
+    executes the first fault that fires (raise / sleep / socket close).
+    ``corrupt`` clauses never fire here — they need bytes to damage and
+    only fire through `mutate()` (payload sites call that hook instead),
+    so they neither advance nor consume hits on a `fire()`-only site."""
     if not ACTIVE:
         if ACTIVE is None:
             active()
@@ -320,6 +350,8 @@ def fire(site, **ctx):
         # not — so one clause's schedule never perturbs another's; only
         # the clause actually executed consumes its n= budget
         for c in _clauses:
+            if c.kind == "corrupt":
+                continue
             if c.matches(site, ctx) and c.evaluate() and clause is None:
                 clause = c
         if clause is None:
@@ -331,6 +363,57 @@ def fire(site, **ctx):
                          if isinstance(v, (str, int, float, bool))}}
         _record(event)
     _execute(clause, site, ctx)
+
+
+def mutate(site, payload, **ctx):
+    """The payload-site hook: `fire()` plus the ``corrupt`` kind.
+
+    Called on paths that hold the bytes a fault could damage (record
+    reads at ``io.corrupt_record``).  Returns `payload` untouched when
+    nothing fires; a firing ``corrupt`` clause returns a deterministic
+    bit-flipped copy (seeded by the schedule seed x site x hit, so the
+    same spec always damages the same bytes of the same record); any
+    other firing kind executes exactly as `fire()` would (raise/sleep).
+    """
+    if not ACTIVE:
+        if ACTIVE is None:
+            active()
+            if not ACTIVE:
+                return payload
+        else:
+            return payload
+    clause = None
+    with _lock:
+        for c in _clauses:
+            if c.matches(site, ctx) and c.evaluate() and clause is None:
+                clause = c
+        if clause is None:
+            return payload
+        clause.fired += 1
+        event = {"event": "fault", "site": site, "kind": clause.kind,
+                 "hit": clause.hits, "seq": len(_trace) + 1,
+                 "ctx": {k: v for k, v in ctx.items()
+                         if isinstance(v, (str, int, float, bool))}}
+        _record(event)
+        hit = clause.hits
+    if clause.kind != "corrupt":
+        _execute(clause, site, ctx)
+        return payload
+    data = bytearray(payload)
+    if not data:
+        return payload
+    n = min(int(clause.args.get("bytes", 16)), len(data))
+    rng = random.Random((_seed, site, hit).__repr__())
+    if "offset" in clause.args:
+        start = int(clause.args["offset"]) % len(data)
+        positions = [(start + i) % len(data) for i in range(n)]
+    else:
+        positions = rng.sample(range(len(data)), n)
+    for pos in positions:
+        # XOR with a non-zero seeded byte: every chosen position is
+        # guaranteed to actually change
+        data[pos] ^= rng.randint(1, 255)
+    return bytes(data)
 
 
 def _execute(clause, site, ctx):

@@ -38,7 +38,11 @@ def test_nhwc_pass_matches_nchw(monkeypatch, train):
         exe = out.simple_bind(mx.cpu(), grad_req="write" if train else "null",
                               **shapes)
         for name, arr in exe.arg_dict.items():
-            r = np.random.RandomState(hash(name) % (2**31))
+            # stable per-name seed: builtin hash() is randomized per
+            # process (PYTHONHASHSEED), and unlucky draws made this
+            # tolerance comparison flaky (~25% of hash seeds)
+            import zlib
+            r = np.random.RandomState(zlib.crc32(name.encode()) % (2**31))
             if name == "softmax_label":
                 arr[:] = nd.array(r.randint(0, 5, arr.shape).astype("f4"))
             else:

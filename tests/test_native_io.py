@@ -152,7 +152,10 @@ def test_record_iter_partial_batch_pad(tmp_path):
     assert sum(b.data[0].shape[0] - b.pad for b in batches) == 25
 
 
-def test_record_iter_worker_error_propagates(tmp_path):
+def test_record_iter_corrupt_record_skips_not_raises(tmp_path):
+    """Guardian io tier: an undecodable record must not kill the epoch —
+    it is substituted with zeros, counted on corrupt_records, and the
+    rest of the file still trains (the old behavior raised mid-epoch)."""
     rec = recordio.MXRecordIO(str(tmp_path / "bad.rec"), "w")
     rec.write(recordio.pack(recordio.IRHeader(0, 0.0, 0, 0),
                             b"not an image at all"))
@@ -160,8 +163,10 @@ def test_record_iter_worker_error_propagates(tmp_path):
     it = ImageRecordIterImpl(path_imgrec=str(tmp_path / "bad.rec"),
                              data_shape=(3, 32, 32), batch_size=1,
                              preprocess_threads=2)
-    with pytest.raises(mx.MXNetError, match="decodable"):
-        next(iter(it))
+    batch = next(iter(it))
+    assert batch.data[0].shape == (1, 3, 32, 32)
+    np.testing.assert_array_equal(batch.data[0].asnumpy(), 0.0)
+    assert it.corrupt_records == 1
 
 
 def test_record_iter_seed_reproducible(tmp_path):

@@ -248,7 +248,12 @@ mod.fit(it, num_epoch=2 if resume else 1, optimizer="sgd",
         checkpoint_dir=%r, checkpoint_period=8, resume=resume,
         kvstore=None)
 assert mod._fused_step is not None and not mod._fused_step.broken
-print(json.dumps(mxc.stats()["counters"]))
+import hashlib
+args, _ = mod.get_params()
+h = hashlib.sha256()
+for k in sorted(args):
+    h.update(args[k].asnumpy().tobytes())
+print(json.dumps(dict(mxc.stats()["counters"], sha=h.hexdigest())))
 ''' % (cache, ckpt, ckpt)
     counters = []
     for _ in range(2):
@@ -264,6 +269,25 @@ print(json.dumps(mxc.stats()["counters"]))
         assert _unframe(open(p, "rb").read()) is not None
     assert resumed["compiles"] == 0, resumed   # restart skips XLA entirely
     assert resumed["disk_hits"] >= 1
+    # the payload-loaded executable must also be CORRECT: a control run
+    # resuming from the same first-run checkpoint with the program
+    # cache OFF (plain jax.jit) must reach the identical params.
+    # Regression for the donated host-staged-buffer corruption the
+    # fused steps now defuse with reown_for_donation: before that fix
+    # the payload-resumed sha differed nondeterministically (~30-50%).
+    import shutil
+    for d in os.listdir(ckpt):
+        # drop the checkpoints the RESUMED run committed so the control
+        # resumes from the same state the resumed run started at
+        if d.startswith("ckpt-") and int(d.split("-")[1]) > 8:
+            shutil.rmtree(os.path.join(ckpt, d), ignore_errors=True)
+    r = subprocess.run([sys.executable, "-c", script], cwd=REPO,
+                       capture_output=True, text=True, timeout=300,
+                       env=dict(os.environ, MXNET_PROGRAM_CACHE="0"))
+    assert r.returncode == 0, r.stderr[-2000:]
+    control = json.loads(r.stdout.strip().splitlines()[-1])
+    assert control["sha"] == resumed["sha"], \
+        "payload-resumed params differ from plain-jit resume"
 
 
 def test_cache_report_tool(tmp_path):

@@ -32,16 +32,30 @@ requests, zero post-warmup compiles — certified via worker compile-
 cache stats), and a torn swap (clean abort, fleet keeps serving,
 re-issue completes).  The artifact is ``CHAOS_SERVING.json``.
 
-Usage: python tools/run_chaos.py [--quick] [--pod] [--serving] [--json]
-                                 [--out PATH]
+Training-guardian mode (``--train``) runs the NUMERICAL-HEALTH
+schedules: an injected non-finite gradient (the guardian must refuse
+the update in-graph and continue deterministically — two identical
+seeded runs end bit-identical), an injected loss spike (the guardian
+must roll back to the last healthy checkpoint and end bit-identical to
+a clean reference run that skipped the same quarantined window), and an
+injected corrupt record (the io tier must substitute/skip it, count it,
+and quarantine it so a resumed iterator never reads it again).  Every
+schedule additionally certifies ZERO unified-program-cache compiles
+during recovery (the live/in-memory tier serves every rebuilt program).
+The artifact is ``CHAOS_TRAIN.json``.
+
+Usage: python tools/run_chaos.py [--quick] [--pod] [--serving] [--train]
+                                 [--json] [--out PATH]
     --quick   bounded test selection (the run_tpu_parity.py stage)
     --pod     run the elastic pod schedules (writes CHAOS_POD.json)
     --serving run the multi-replica router schedules
               (writes CHAOS_SERVING.json)
+    --train   run the training-guardian schedules
+              (writes CHAOS_TRAIN.json)
     --json    print only the JSON artifact on stdout
     --out     also write the artifact to PATH (default CHAOS_REPORT.json,
               CHAOS_POD.json with --pod, CHAOS_SERVING.json with
-              --serving)
+              --serving, CHAOS_TRAIN.json with --train)
 
 Exit status: 0 when every schedule's tests passed.
 """
@@ -547,14 +561,235 @@ def run_serving(as_json=False, out_path=None):
     return 0 if artifact["all_passed"] else 1
 
 
+# -- training-guardian schedules: silent-failure recovery ---------------------
+# in-process seeded schedules over small Module.fit runs; every recovery
+# path is certified with zero unified-program-cache compiles
+
+def _train_model():
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import sym
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = sym.Variable("data")
+    net = sym.FullyConnected(net, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="tanh")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    return mx.mod.Module(net, context=mx.cpu())
+
+
+def _train_iter(n=128, bs=8):
+    import numpy as np
+    from incubator_mxnet_tpu import io
+    rng = np.random.RandomState(3)
+    x = rng.standard_normal((n, 10)).astype("float32")
+    y = rng.randint(0, 4, n).astype("float32")
+    return io.NDArrayIter(x, y, batch_size=bs, shuffle=False)
+
+
+def _train_fit(mod, ckpt_dir=None):
+    import incubator_mxnet_tpu as mx
+    mod.fit(_train_iter(), num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05}, eval_metric="acc",
+            initializer=mx.initializer.Xavier(),
+            checkpoint_dir=ckpt_dir, checkpoint_period=4)
+
+
+def _params_sha(mod):
+    import hashlib
+    args, auxs = mod.get_params()
+    h = hashlib.sha256()
+    for k in sorted(args):
+        h.update(args[k].asnumpy().tobytes())
+    for k in sorted(auxs):
+        h.update(auxs[k].asnumpy().tobytes())
+    return h.hexdigest()
+
+
+def run_train_schedule(name, tmp, quiet=False):
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import compile as _compile
+    from incubator_mxnet_tpu.resilience import faults as _f
+    t0 = time.time()
+    checks = {}
+    os.environ["MXNET_GUARDIAN_INTERVAL"] = "4"
+    os.environ["MXNET_GUARDIAN_SPIKE_WINDOW"] = "4"
+
+    def compiles():
+        return _compile.stats()["counters"]["compiles"]
+
+    if name == "warmup":
+        # pays the process's cold compiles so every REAL schedule can
+        # gate on zero-compile recovery (the live tier serves rebuilt
+        # programs); also the fault-free baseline sha.  The K-step scan
+        # AND the 1-step program both warm here — a post-rollback resume
+        # trains partial blocks (the quarantined window breaks block
+        # collection), so recovery dispatches the 1-step program too.
+        _f.clear()
+        mod = _train_model()
+        _train_fit(mod)
+        prev = os.environ.get("MXNET_FUSED_STEP_BLOCK")
+        os.environ["MXNET_FUSED_STEP_BLOCK"] = "1"
+        try:
+            _train_fit(_train_model())
+        finally:
+            if prev is None:
+                os.environ.pop("MXNET_FUSED_STEP_BLOCK", None)
+            else:
+                os.environ["MXNET_FUSED_STEP_BLOCK"] = prev
+        checks["completed"] = True
+        checks["baseline_sha"] = _params_sha(mod)
+        checks["guardian_active"] = mod._guardian is not None and \
+            mod._guardian.stats()["steps_observed"] > 0
+    elif name == "nonfinite-skip":
+        # injected NaN gradient -> in-graph skip, deterministic
+        # continuation: two identical seeded runs end bit-identical
+        def one_run():
+            _f.configure("seed=31;grad.nonfinite:error(at=5)")
+            mod = _train_model()
+            c0 = compiles()
+            _train_fit(mod)
+            st = mod._guardian.stats()
+            _f.clear()
+            return _params_sha(mod), st, compiles() - c0
+        sha1, st1, d1 = one_run()
+        sha2, st2, d2 = one_run()
+        checks.update(
+            skip_fired=(st1["skips"] == 1 and st1["injected_nonfinite"] == 1),
+            batch_quarantined=(st1["quarantined"] == 1),
+            deterministic_continuation=(sha1 == sha2),
+            zero_recovery_compiles=(d1 == 0 and d2 == 0))
+    elif name == "spike-rollback":
+        # injected loss spike -> rollback-to-last-good; final params
+        # bit-identical to a clean reference that skipped the same
+        # quarantined window from the same healthy checkpoint state
+        ck_a = os.path.join(tmp, "ck-spike")
+        ck_b = os.path.join(tmp, "ck-ref")
+        _f.configure("seed=32;loss.spike:error(at=10)")
+        mod = _train_model()
+        c0 = compiles()
+        _train_fit(mod, ck_a)
+        st = mod._guardian.stats()
+        sha_rb = _params_sha(mod)
+        d_rb = compiles() - c0
+        _f.clear()
+        os.makedirs(ck_b, exist_ok=True)
+        shutil.copyfile(os.path.join(ck_a, "quarantine.jsonl"),
+                        os.path.join(ck_b, "quarantine.jsonl"))
+        ref = _train_model()
+        c1 = compiles()
+        _train_fit(ref, ck_b)
+        checks.update(
+            rollback_fired=(st["rollbacks"] == 1 and st["spikes"] == 1),
+            window_quarantined=(st["quarantined"] >= 1),
+            bit_identical_vs_clean=(sha_rb == _params_sha(ref)),
+            zero_recovery_compiles=(d_rb == 0 and compiles() - c1 == 0))
+    elif name == "corrupt-record":
+        # injected record corruption -> substituted + counted +
+        # quarantined; a resumed iterator skips the record entirely
+        import numpy as np
+        import cv2
+        from incubator_mxnet_tpu import recordio
+        from incubator_mxnet_tpu.image import ImageRecordIterImpl
+        from incubator_mxnet_tpu.resilience.guardian import QuarantineLog
+        rec = os.path.join(tmp, "c.rec")
+        rng = np.random.RandomState(0)
+        w = recordio.MXRecordIO(rec, "w")
+        for i in range(24):
+            ok, enc = cv2.imencode(
+                ".png", rng.randint(0, 255, (40, 40, 3), dtype=np.uint8))
+            w.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                                  enc.tobytes()))
+        w.close()
+        qlog = QuarantineLog(os.path.join(tmp, "quarantine.jsonl"))
+        # record= targeting: hit-count (at=) ordering is thread-schedule
+        # dependent under the multi-threaded batch builders
+        _f.configure("seed=33;io.corrupt_record:corrupt(record=6)")
+        it = ImageRecordIterImpl(path_imgrec=rec, data_shape=(3, 32, 32),
+                                 batch_size=4, preprocess_threads=2)
+        it.set_quarantine(qlog)
+        n1 = sum(b.data[0].shape[0] - b.pad for b in it)
+        corrupt_first = it.corrupt_records
+        it.close()
+        _f.clear()
+        entries = qlog.load()
+        # "resume": a fresh iterator with the quarantine applied never
+        # reads the poisoned record again (no fault clause configured)
+        it2 = ImageRecordIterImpl(path_imgrec=rec, data_shape=(3, 32, 32),
+                                  batch_size=4, preprocess_threads=2)
+        it2.apply_quarantine(entries)
+        labels = []
+        for b in it2:
+            labels.extend(
+                b.label[0].asnumpy()[:b.data[0].shape[0] - b.pad].tolist())
+        it2.close()
+        bad = {int(e["record"]) for e in entries
+               if e.get("record") is not None}
+        checks.update(
+            corrupt_detected=(corrupt_first == 1 and n1 == 24),
+            quarantine_logged=(bad == {6}),
+            skipped_on_resume=(it2.corrupt_records == 0 and
+                               len(labels) == 23 and
+                               not any(float(r) in labels for r in bad)))
+    else:
+        raise ValueError("unknown train schedule %r" % name)
+    bools = [v for v in checks.values() if isinstance(v, bool)]
+    result = {
+        "schedule": name,
+        "checks": {k: v for k, v in checks.items() if k != "baseline_sha"},
+        "duration_s": round(time.time() - t0, 1),
+        "passed": bool(bools) and all(bools),
+    }
+    if not quiet:
+        print("chaos[train/%s]: passed=%s checks=%s (%.1fs)" %
+              (name, result["passed"], result["checks"],
+               result["duration_s"]), file=sys.stderr)
+    return result
+
+
+def run_train(as_json=False, out_path=None):
+    runs = []
+    for name in ("warmup", "nonfinite-skip", "spike-rollback",
+                 "corrupt-record"):
+        tmp = tempfile.mkdtemp(prefix="chaos-train-%s-" % name)
+        try:
+            runs.append(run_train_schedule(name, tmp, quiet=as_json))
+        except Exception as exc:
+            runs.append({"schedule": name, "passed": False,
+                         "error": repr(exc)})
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    artifact = {
+        "schedules": runs,
+        "all_passed": all(r["passed"] for r in runs),
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(artifact, f, indent=1)
+    if as_json:
+        print(json.dumps(artifact))
+    else:
+        print("chaos train: %d schedule(s), all_passed=%s -> %s" %
+              (len(runs), artifact["all_passed"], out_path))
+    return 0 if artifact["all_passed"] else 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="run_chaos", description=__doc__)
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--pod", action="store_true")
     ap.add_argument("--serving", action="store_true")
+    ap.add_argument("--train", action="store_true")
     ap.add_argument("--json", action="store_true", dest="as_json")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
+    if args.train:
+        out = args.out if args.out is not None \
+            else os.path.join(REPO, "CHAOS_TRAIN.json")
+        sys.path.insert(0, REPO)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        return run_train(as_json=args.as_json, out_path=out)
     if args.serving:
         out = args.out if args.out is not None \
             else os.path.join(REPO, "CHAOS_SERVING.json")

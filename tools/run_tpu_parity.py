@@ -175,6 +175,28 @@ def chaos_serving_stage():
         return {"error": f"chaos serving stage failed: {exc!r}"}
 
 
+def chaos_train_stage():
+    """Training-guardian stage: run tools/run_chaos.py --train in a
+    throwaway process — an injected non-finite gradient (in-graph
+    skip-batch, deterministic continuation), an injected loss spike
+    (rollback-to-last-good, bit-identical params vs a clean reference),
+    and an injected corrupt record (substituted, counted, quarantined,
+    skipped on resume) — and attach its CHAOS_TRAIN artifact, each
+    recovery certified with zero unified-program-cache compiles.
+    Numerical-health recovery claims become checkable evidence next to
+    the parity outcomes."""
+    cmd = [sys.executable, os.path.join(REPO, "tools", "run_chaos.py"),
+           "--train", "--json", "--out", ""]
+    try:
+        out = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                             timeout=1800)
+        summary = json.loads(out.stdout)
+        summary["rc"] = out.returncode
+        return summary
+    except Exception as exc:
+        return {"error": f"chaos train stage failed: {exc!r}"}
+
+
 def tsan_stage():
     """Concurrency-sanitizer stage: a tier-1-representative subset
     (the tsan fixtures + zero-FP gate + the router battery) runs in a
@@ -279,6 +301,7 @@ def main():
         "chaos": chaos_stage(),
         "chaos_pod": chaos_pod_stage(),
         "chaos_serving": chaos_serving_stage(),
+        "chaos_train": chaos_train_stage(),
         "coldstart": coldstart_stage(),
         "tsan": tsan_stage(),
         "cmd": " ".join(cmd[2:]),
