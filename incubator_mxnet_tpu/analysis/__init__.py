@@ -111,6 +111,11 @@ def runtime_report():
         report.extend(_guardian.findings())
     except Exception:
         pass
+    try:
+        from .. import kvstore as _kvstore
+        report.extend(_kvstore.findings())
+    except Exception:
+        pass
     from . import tsan as _tsan
     if _tsan.enabled():
         report.extend(_tsan.findings())

@@ -41,6 +41,14 @@ walking a script's AST:
   resilience/guardian.py) does this correctly: in-graph skip with
   deterministic RNG/optimizer advance, loss-spike rollback, and a
   quarantine log.
+* ``unbucketed-push`` — a per-parameter ``kv.push``/``kv.pull`` inside
+  a training loop (the key is derived from the loop variable): the
+  collective stores advertise ``prefers_batched_push`` — one batched
+  push/pull of the FULL key list reduces in O(buckets) overlapped
+  all-reduce collectives, while the per-parameter loop dispatches one
+  collective per key (the classic pod-scale throughput killer).  Pass
+  the whole key list in one call (``kv.push(names, grads)``), or
+  stream with ``begin_push``/``push_part``/``end_push``.
 * ``unsupervised-collective`` — a host-level cross-host collective
   dispatch (`collectives.all_reduce` / `all_gather` / `reduce_scatter` /
   `ppermute` / a collective plane's `allreduce`) outside a supervisor/
@@ -117,6 +125,7 @@ _DISABLE_RE = re.compile(r"#\s*mxlint:\s*disable(?:=([\w\-, ]+))?")
 
 _PASS_BY_CODE = {"host-sync-in-loop": "source.hostsync",
                  "kvstore-local-on-tpu": "source.kvstore",
+                 "unbucketed-push": "source.kvstore",
                  "unbounded-retry": "source.retry",
                  "bare-except": "source.except",
                  "nan-swallow": "source.guardian",
@@ -151,6 +160,7 @@ class _Visitor(ast.NodeVisitor):
         self.filename = filename
         self.lines = lines
         self.loop_depth = 0
+        self.loop_targets = []   # per enclosing loop: its target names
         self.findings = []
         self.uses_tpu = False
         self.kv_local_sites = []   # (lineno, sink name)
@@ -164,8 +174,15 @@ class _Visitor(ast.NodeVisitor):
 
     # -- loops ---------------------------------------------------------------
     def _loop(self, node):
+        targets = set()
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            for sub in ast.walk(node.target):
+                if isinstance(sub, ast.Name):
+                    targets.add(sub.id)
         self.loop_depth += 1
+        self.loop_targets.append(targets)
         self.generic_visit(node)
+        self.loop_targets.pop()
         self.loop_depth -= 1
 
     visit_For = visit_AsyncFor = _loop
@@ -300,6 +317,7 @@ class _Visitor(ast.NodeVisitor):
     # definition site; reset the loop context for their bodies
     def _fresh_scope(self, node):
         saved, self.loop_depth = self.loop_depth, 0
+        saved_targets, self.loop_targets = self.loop_targets, []
         device = any(
             _DEVICE_DECORATORS & self._idents(d)
             for d in getattr(node, "decorator_list", ()))
@@ -309,6 +327,7 @@ class _Visitor(ast.NodeVisitor):
         if device:
             self.device_depth -= 1
         self.loop_depth = saved
+        self.loop_targets = saved_targets
 
     visit_FunctionDef = visit_AsyncFunctionDef = visit_Lambda = _fresh_scope
 
@@ -421,6 +440,23 @@ class _Visitor(ast.NodeVisitor):
             self._add("host-sync-in-loop", node.lineno,
                       f"{name}() inside a loop drains ALL in-flight work "
                       "every iteration")
+        if name in ("push", "pull") and self.loop_depth > 0 and \
+                isinstance(func, ast.Attribute) and node.args:
+            recv_ids = self._idents(func.value)
+            loop_vars = set().union(*self.loop_targets) \
+                if self.loop_targets else set()
+            key_ids = self._idents(node.args[0])
+            if any("kv" in ident.lower() for ident in recv_ids) and \
+                    key_ids & loop_vars:
+                self._add(
+                    "unbucketed-push", node.lineno,
+                    f"per-parameter kv.{name}() inside a training loop: "
+                    "collective stores advertise prefers_batched_push — "
+                    "one batched call with the FULL key list reduces in "
+                    "O(buckets) overlapped collectives instead of one "
+                    "per parameter; hoist the loop into kv."
+                    f"{name}(names, arrays) (or stream with "
+                    "begin_push/push_part/end_push)")
         # -- concurrency lints (the mxtsan static half) ----------------------
         if name == "Thread" and \
                 not any(kw.arg == "name" for kw in node.keywords):

@@ -116,6 +116,45 @@ KNOBS = {
     "MXNET_KVSTORE_COLLECTIVE": (_BOOL, True, "honored",
                                  "dist_sync gradients ride XLA collectives "
                                  "instead of the socket server"),
+    "MXNET_KVSTORE_BUCKET_MB": (float, 32, "honored",
+                                "gradient all-reduce bucket size cap on "
+                                "kvstore='tpu'/'device': a batched push "
+                                "packs keys into size-capped buckets "
+                                "(priority order: last-produced grads "
+                                "first) and dispatches each bucket's "
+                                "collective asynchronously — O(buckets) "
+                                "dispatches per step, overlapped with "
+                                "host-side assembly"),
+    "MXNET_KVSTORE_OVERLAP": (_BOOL, True, "honored",
+                              "async per-bucket dispatch on the "
+                              "collective kvstore (bucket k's all-reduce "
+                              "executes while bucket k+1 assembles); 0 "
+                              "blocks after each bucket — the A/B lever "
+                              "tools/run_scaling.py benches"),
+    "MXNET_MESH": (str, "", "honored",
+                   "composed device-mesh spec for the fused train step, "
+                   "e.g. 'dp=8' or 'dp=4,tp=2' (axis sizes multiply to "
+                   "the device count; the dp axis shards the batch, "
+                   "other axes are available to TP/PP-sharded params) — "
+                   "the Module.fit/init_optimizer mesh= argument wins "
+                   "over the env"),
+    "MXNET_POD_SPMD": (_BOOL, True, "honored",
+                       "pod SPMD fast path in the fused train step: the "
+                       "whole step runs inside shard_map over the dp "
+                       "axis and gradients exchange in O(buckets) "
+                       "flatten-concat psum collectives "
+                       "(MXNET_KVSTORE_BUCKET_MB caps a bucket) instead "
+                       "of GSPMD's one all-reduce per tensor — fewer "
+                       "cross-device barriers per step; falls back to "
+                       "the global-view lowering for RNG/batch-"
+                       "normalized/reduced-output graphs or composed "
+                       "(tp/pp) meshes"),
+    "MXNET_ZERO": (_BOOL, False, "honored",
+                   "ZeRO-style weight-update sharding in the fused step: "
+                   "optimizer-state tensors shard over the dp axis, so "
+                   "XLA lowers the gradient exchange to reduce-scatter, "
+                   "updates only the local shard, and all-gathers the "
+                   "new weights (per-device optimizer memory 1/N)"),
     # -- resilience (this framework's own knobs) -----------------------------
     "MXNET_FAULTS": (str, "", "honored",
                      "resilience/faults.py: deterministic fault-injection "

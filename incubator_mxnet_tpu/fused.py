@@ -301,15 +301,20 @@ class _TracedCore:
     and each K-step scan body re-trace for pennies instead of re-running
     framework op dispatch."""
 
-    def __init__(self, core, example_args):
+    def __init__(self, core, example_args, axis_env=None):
         import jax
         flat, in_tree = jax.tree_util.tree_flatten(tuple(example_args))
 
         def flat_core(*leaves):
             return core(*jax.tree_util.tree_unflatten(in_tree, leaves))
 
+        # axis_env binds mesh axis names for the pod fast path's core
+        # (its jaxpr contains psum/pmean/pmin eqns over the dp axis and
+        # is traced with SHARD-local input shapes; the shard_map wrapper
+        # binds the axis for real at lowering time)
         closed, out_shape = jax.make_jaxpr(
-            flat_core, return_shape=True)(*flat)
+            flat_core, return_shape=True,
+            axis_env=axis_env)(*flat)
         self._closed = closed
         self._in_tree = in_tree
         self._out_tree = jax.tree_util.tree_structure(out_shape)
@@ -395,22 +400,99 @@ def create_states_on_device(opt, indices, weights_raw, ctx):
     return [_state_wrap(v, ctx) for v in vals]
 
 
-def _one_step_jit(traced, label=""):
+def _pod_bucket_psum(grads, axis, cap_bytes, extras=()):
+    """Exchange every gradient in O(buckets) psum collectives: pack the
+    (trace-time-static) gradient list into size-capped same-dtype
+    buckets — the kvstore scheduler's planning rule AND priority order
+    (reversed parameter order), applied INSIDE the step program —
+    flatten-concat each bucket and exchange it in its OWN `lax.psum`
+    bind over the dp axis.  Backward materializes the LAST layer's
+    gradients first, so the first-planned bucket's all-reduce depends
+    only on ITS layers' VJP chain: the scheduler starts that collective
+    while earlier layers' backward is still computing — the
+    dependency-engine overlap, expressed as dataflow instead of
+    host-side async dispatch.  One extra psum carries the
+    small per-shard partial sums (metric deltas, BN aux moments, the
+    guardian's health bit).  Returns (summed grads, bucket plan, summed
+    extras).  The psum of per-shard gradients is the reference
+    kvstore's cross-device sum."""
+    import jax
+    import jax.numpy as jnp
+    from .kvstore import plan_buckets
+    sizes = [int(_np.prod(g.shape)) * g.dtype.itemsize if g.shape
+             else g.dtype.itemsize for g in grads]
+    # the kvstore scheduler's EXACT plan, including its priority order:
+    # reversed parameter order, so the last layers' gradients — the ones
+    # backward's VJP chain produces first — form the first buckets
+    plan = plan_buckets(reversed(range(len(grads))), sizes,
+                        [g.dtype for g in grads], cap_bytes)
+    flats = []
+    for bucket in plan:
+        if len(bucket) == 1:
+            flats.append(grads[bucket[0]])
+        else:
+            flats.append(jnp.concatenate(
+                [grads[i].reshape(-1) for i in bucket]))
+    # the extras (metric deltas, BN aux moments, the health bit — all
+    # small) CONCAT into the first f32 bucket's payload rather than
+    # riding as extra psum operands: XLA-CPU rendezvouses multi-operand
+    # all-reduces per operand, so one fused operand is one barrier
+    ex_flat = [jnp.asarray(e, jnp.float32).reshape(-1) for e in extras]
+    ex_sizes = [int(e.shape[0]) for e in ex_flat]
+    ex_host = next((k for k, f in enumerate(flats)
+                    if f.dtype == jnp.float32), None)
+    if ex_flat and ex_host is not None:
+        host_shape = flats[ex_host].shape
+        flats[ex_host] = jnp.concatenate(
+            [flats[ex_host].reshape(-1)] + ex_flat)
+    sflats = [jax.lax.psum(f, axis) for f in flats]
+    if ex_flat and ex_host is not None:
+        host = sflats[ex_host]
+        n_own = int(host.shape[0]) - sum(ex_sizes)
+        sextras, off = [], n_own
+        for n in ex_sizes:
+            sextras.append(jax.lax.dynamic_slice_in_dim(host, off, n))
+            off += n
+        sflats[ex_host] = jax.lax.dynamic_slice_in_dim(
+            host, 0, n_own).reshape(host_shape)
+        sextras = [s.reshape(e.shape).astype(e.dtype)
+                   for s, e in zip(sextras, extras)]
+    else:
+        sextras = jax.lax.psum(tuple(extras), axis) if extras else ()
+    out = list(grads)
+    for flat, bucket in zip(sflats, plan):
+        if len(bucket) == 1:
+            out[bucket[0]] = flat
+            continue
+        off = 0
+        for i in bucket:
+            n = int(_np.prod(grads[i].shape)) if grads[i].shape else 1
+            out[i] = jax.lax.dynamic_slice_in_dim(flat, off, n).reshape(
+                grads[i].shape)
+            off += n
+    return out, plan, sextras
+
+
+def _one_step_jit(traced, label="", call_fn=None, key_tag=None):
     """1-step program over a traced core; the inner carry is donated.
     Compiled through the unified program cache (compile/): a process
     that traced an identical core loads the executable from the disk
-    tier instead of paying the XLA compile."""
+    tier instead of paying the XLA compile.  `call_fn` substitutes a
+    wrapped core (the pod path's shard_map) while `traced` still
+    provides the cache identity; `key_tag` disambiguates the wrapper."""
     from .compile import cached_jit
+    fn = call_fn if call_fn is not None else traced
 
     def step1(inner, x, *extras):
-        return traced(inner, x, *extras)
+        return fn(inner, x, *extras)
 
     return cached_jit(step1, donate_argnums=(0,),
-                      graph_key=("step1", traced.graph_hash),
+                      graph_key=("step1", key_tag, traced.graph_hash),
                       label=label or "fused/step1")
 
 
-def _scan_block_jit(traced, mcarry_index=None, label=""):
+def _scan_block_jit(traced, mcarry_index=None, label="", call_fn=None,
+                    key_tag=None):
     """K-step program: `lax.scan` of the traced core over K stacked
     per-step inputs.  Returns (new_inner, ys, mys, last): `ys` stacks
     every step's outputs (so callers can expose batch j's outputs to a
@@ -426,12 +508,13 @@ def _scan_block_jit(traced, mcarry_index=None, label=""):
     import jax.numpy as jnp
     from jax import lax
     from .compile import cached_jit
+    fn = call_fn if call_fn is not None else traced
 
     def stepk(inner, xs_list, *extras):
         xs = jax.tree_util.tree_map(lambda *vs: jnp.stack(vs), *xs_list)
 
         def body(inn, x):
-            new_inn, out = traced(inn, x, *extras)
+            new_inn, out = fn(inn, x, *extras)
             y = (out, inn[mcarry_index]) if mcarry_index is not None \
                 else (out, None)
             return new_inn, y
@@ -441,7 +524,8 @@ def _scan_block_jit(traced, mcarry_index=None, label=""):
         return new_inner, ys, mys, last
 
     return cached_jit(stepk, donate_argnums=(0,),
-                      graph_key=("scan2", mcarry_index, traced.graph_hash),
+                      graph_key=("scan2", mcarry_index, key_tag,
+                                 traced.graph_hash),
                       label=label or "fused/scan")
 
 
@@ -692,20 +776,79 @@ class FusedTrainStep:
         self._indices = [self._indices[module._exec_group.param_names.index(n)]
                          for n in self._param_names]
 
-        # device mesh for multi-device data parallelism
+        # device mesh for multi-device data parallelism — composed
+        # dp×tp×pp meshes accepted from Module (`mesh=` / MXNET_MESH
+        # spec through parallel/mesh.py); default: every context on one
+        # 'dp' axis.  The batch shards over the dp axis only; params/
+        # state replicate over it, and tensors the user sharded over the
+        # OTHER axes (TP/PP) keep their layout (`_collect_misplaced`
+        # respects same-mesh NamedShardings, `_constrain_like` pins the
+        # step outputs to the input layouts).
         devices = [c.jax_device for c in self._contexts]
-        if len(devices) > 1:
+        mesh = getattr(module, "_mesh", None)
+        if mesh is None and len(devices) > 1:
+            from .parallel.mesh import mesh_from_spec
+            try:
+                mesh = mesh_from_spec(devices=devices)
+            except Exception as e:
+                _log.warning("MXNET_MESH spec ignored (%s); using the 1-D "
+                             "dp mesh", str(e)[:200])
+                mesh = None
+        if len(devices) > 1 or mesh is not None:
             from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-            mesh = Mesh(_np.array(devices), ("dp",))
-            self._data_sharding = NamedSharding(mesh, P("dp"))
+            from .parallel.mesh import dp_axis_of
+            if mesh is None:
+                mesh = Mesh(_np.array(devices), ("dp",))
+            self._mesh = mesh
+            self._dp_axis = dp_axis_of(mesh)
+            self._dp_size = int(mesh.shape[self._dp_axis])
+            self._data_sharding = NamedSharding(mesh, P(self._dp_axis))
             self._rep_sharding = NamedSharding(mesh, P())
         else:
             from jax.sharding import SingleDeviceSharding
+            self._mesh = None
+            self._dp_axis = None
+            self._dp_size = 1
             self._data_sharding = SingleDeviceSharding(devices[0])
             self._rep_sharding = SingleDeviceSharding(devices[0])
+        # ZeRO-style weight-update sharding (MXNET_ZERO): optimizer-state
+        # tensors lay out sharded over dp, so GSPMD lowers the gradient
+        # exchange feeding the update to reduce-scatter, runs the
+        # optimizer on the local 1/N shard only, and all-gathers the new
+        # weights — the MLPerf-pods paper's weight-update sharding, via
+        # sharding annotations instead of hand-written collectives
+        # (parallel/zero.py holds the explicit shard_map machinery).
+        from . import config as _config
+        self._zero = bool(_config.get("MXNET_ZERO")) and \
+            self._mesh is not None and self._dp_size > 1
 
         from .symbol.symbol import graph_eval_fn
         self._gfn, _, _, self._n_rng = graph_eval_fn(self._symbol, True)
+        # pod SPMD fast path (MXNET_POD_SPMD): run the WHOLE step core
+        # inside shard_map over the dp axis with a bucketed single-psum
+        # gradient exchange.  The GSPMD global-view lowering inserts one
+        # all-reduce per gradient tensor at its producing dot; on a wide
+        # mesh every collective is a cross-device barrier, so O(params)
+        # barriers per step amplify per-partition skew.  The pod path
+        # exchanges ALL gradients in O(buckets) collectives
+        # (MXNET_KVSTORE_BUCKET_MB caps a bucket — the same knob and
+        # planning rule as the kvstore scheduler), which benches ~1.2x
+        # faster per step on the 8-way mesh.  Semantics: the psum of
+        # per-shard gradients is exactly the reference kvstore's
+        # cross-device SUM (comm.h Reduce), so sum-normalized graphs
+        # (normalization='null') match the global-view program bit-for-
+        # bit in structure; batch-normalized losses keep their classic
+        # per-device normalization, as on the reference engine.
+        self._pod_axis = None
+        self.pod_stats = None
+        if self._dp_size > 1 and not self._zero and \
+                bool(_config.get("MXNET_POD_SPMD")) and \
+                self._mesh is not None and \
+                all(int(self._mesh.shape[a]) == 1
+                    for a in self._mesh.axis_names
+                    if a != self._dp_axis) and \
+                self._pod_graph_ok():
+            self._pod_axis = self._dp_axis
         self._key = None
         self._jit = None          # 1-step program
         self._jit_block = {}      # K -> K-step scan program
@@ -748,13 +891,89 @@ class FusedTrainStep:
     # Every call normalizes buffer shardings (a no-op once placed): other
     # code paths — set_params at epoch boundaries, checkpoint loads — may
     # legally repoint these NDArrays at single-device arrays between steps.
-    def _collect_misplaced(self, a, out):
-        if getattr(a._data, "sharding", None) != self._rep_sharding:
-            out.append(a)
+    def _collect_misplaced(self, a, out, target=None):
+        from jax.sharding import NamedSharding
+        target = target if target is not None else self._rep_sharding
+        cur = getattr(a._data, "sharding", None)
+        if cur == target:
+            return
+        if target is self._rep_sharding and self._mesh is not None and \
+                self._pod_axis is None and \
+                isinstance(cur, NamedSharding) and cur.mesh == self._mesh:
+            # user-sharded on the fused mesh (TP/PP axes): keep the layout
+            # (the pod fast path instead REQUIRES replicated carries — its
+            # shard_map in_specs claim P() — so it never takes this branch)
+            return
+        out.append((a, target))
+
+    def _pod_graph_ok(self):
+        """Graph eligibility for the pod shard_map fast path.  Fall back
+        to the GSPMD lowering when the program samples RNG (per-shard
+        streams would diverge from the global-view program), when a
+        SoftmaxOutput normalizes by batch/valid (its scale would bake the
+        SHARD batch size into the traced graph), when a train-mode
+        BatchNorm is NOT sync=True (the fused global-view program
+        computes GLOBAL-batch moments — that is this framework's
+        documented BatchNorm semantics — but inside shard_map a plain
+        mean reduces over the SHARD batch; sync BN psums the moments so
+        it keeps the global statistics on either lowering), or when an
+        aux state is non-floating (aux updates are pmean-averaged across
+        shards — the reference executor group's cross-device aux
+        averaging)."""
+        if self._n_rng:
+            return False
+        try:
+            import json as _json
+            g = _json.loads(self._symbol.tojson())
+            for node in g.get("nodes", []):
+                attrs = node.get("attrs") or {}
+                if node.get("op") in ("SoftmaxOutput", "Softmax") and \
+                        attrs.get("normalization", "null") != "null":
+                    return False
+                if node.get("op") in ("BatchNorm", "BatchNorm_v1") and \
+                        str(attrs.get("use_global_stats", "False")
+                            ).lower() not in ("true", "1"):
+                    if str(attrs.get("sync", "False")).lower() not in \
+                            ("true", "1"):
+                        return False
+                    if str(attrs.get("sync_axis", "dp")) != self._dp_axis:
+                        # sync BN psums over its `sync_axis` NAME; on a
+                        # mesh whose dp axis is named differently the
+                        # in-op axis probe would silently fail and the
+                        # moments would go shard-local — fall back to
+                        # the global-view lowering, which computes
+                        # global-batch moments regardless of axis names
+                        return False
+        except Exception:
+            return False
+        try:
+            import jax.numpy as jnp
+            for n in self._aux_names:
+                if not jnp.issubdtype(
+                        self._exec0.aux_dict[n].dtype, jnp.floating):
+                    return False
+        except Exception:
+            return False
+        return True
+
+    def _zero_sharding(self, a):
+        """Dim-0-over-dp NamedSharding for a ZeRO-eligible optimizer
+        state tensor (dim0 divides the dp axis), else replicated.
+        Scalars and ragged tensors stay replicated — the big tensors
+        carry virtually all the optimizer-state bytes."""
+        if not self._zero:
+            return self._rep_sharding
+        shape = tuple(a.shape)
+        if not shape or shape[0] % self._dp_size:
+            return self._rep_sharding
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(
+            self._mesh,
+            P(*((self._dp_axis,) + (None,) * (len(shape) - 1))))
 
     def _place_state(self, s, out):
         if isinstance(s, NDArray):
-            self._collect_misplaced(s, out)
+            self._collect_misplaced(s, out, self._zero_sharding(s))
         elif isinstance(s, (tuple, list)):
             for x in s:
                 self._place_state(x, out)
@@ -776,9 +995,11 @@ class FusedTrainStep:
             self._place_state(upd.states[i], todo)
         if todo:
             # ONE batched transfer instead of a round trip per array
-            moved = jax.device_put([a._data for a in todo],
-                                   self._rep_sharding)
-            for a, v in zip(todo, moved):
+            # (per-leaf target shardings: replicated, or dp-sharded for
+            # ZeRO-eligible optimizer state)
+            moved = jax.device_put([a._data for a, _ in todo],
+                                   [t for _, t in todo])
+            for (a, _), v in zip(todo, moved):
                 a._set_data(v)
 
     def _create_states(self, need):
@@ -905,6 +1126,14 @@ class FusedTrainStep:
         derive = self._derive_ws
         w_dtypes = self._w_dtypes
         guard = self._guard
+        pod_axis = self._pod_axis
+        pod_dp = self._dp_size
+        if pod_axis is not None:
+            from . import config as _config
+            pod_cap = max(1, int(float(_config.get(
+                "MXNET_KVSTORE_BUCKET_MB")) * (1 << 20)))
+        else:
+            pod_cap = None
 
         def core(inner, x, fixed, rescale):
             ws, ss, auxs, mcarry, key, t_vec = inner
@@ -952,6 +1181,46 @@ class FusedTrainStep:
             (grads,) = vjp(cts)
             if guard:
                 grads = [g * jnp.asarray(gmul, g.dtype) for g in grads]
+            pod_deltas = pod_outs_bad = None
+            if pod_axis is not None:
+                # the pod fast path's gradient exchange: every gradient
+                # bucket, every metric delta, the BN aux moments and the
+                # guardian's local-health bit ride ONE psum bind — a
+                # single cross-device barrier per step.  Downstream
+                # (update, guardian, optimizer state) runs on globally
+                # identical values, replicated across the shards.
+                labels_p = inputs[len(inputs) - n_label:] if n_label \
+                    else ()
+                extras = []
+                for fn, _m in metric_fns:
+                    dsum, dnum = fn(list(labels_p), list(outs))
+                    # dnum rides the float bundle; counts are exact in
+                    # f32 well past any step's sample count
+                    extras.append(jnp.asarray(dsum, jnp.float32))
+                    extras.append(jnp.asarray(dnum, jnp.float32))
+                n_metric = len(metric_fns)
+                extras.extend(list(new_aux))
+                if guard:
+                    oks = [jnp.isfinite(o).all() for o in outs
+                           if jnp.issubdtype(o.dtype, jnp.floating)]
+                    bad = jnp.float32(len(oks)) - sum(
+                        (o.astype(jnp.float32) for o in oks),
+                        jnp.float32(0.0))
+                    extras.append(bad)
+                grads, plan, sext = _pod_bucket_psum(
+                    grads, pod_axis, pod_cap, extras)
+                self._pod_plan = plan
+                pod_deltas = [(sext[2 * j], sext[2 * j + 1])
+                              for j in range(n_metric)]
+                # aux updates (BN moments) are averaged across shards —
+                # the reference executor group's cross-device aux merge
+                a0 = 2 * n_metric
+                new_aux = tuple(
+                    (sext[a0 + j] / jnp.asarray(pod_dp, na.dtype))
+                    .astype(na.dtype)
+                    for j, na in enumerate(new_aux))
+                if guard:
+                    pod_outs_bad = sext[-1]
             new_ws, new_ss = _apply_traced(opt, indices, ws, grads, ss, ctx,
                                            lr_vec, wd_vec, t_vec, rescale)
             if guard:
@@ -964,8 +1233,16 @@ class FusedTrainStep:
                 # a converged model's gradient noise spans decades.  The
                 # displacement ratio measures the damage itself.)
                 parts = [jnp.isfinite(g).all() for g in grads]
-                parts += [jnp.isfinite(o).all() for o in outs
-                          if jnp.issubdtype(o.dtype, jnp.floating)]
+                if pod_axis is not None:
+                    # the shard-local output check already crossed the
+                    # wire inside the bundled exchange: a shard whose
+                    # LOCAL outputs went non-finite refuses the step on
+                    # every shard (grads/new_ws are globally identical
+                    # post-exchange, so those checks need no wire)
+                    parts.append(pod_outs_bad <= jnp.float32(0.5))
+                else:
+                    parts += [jnp.isfinite(o).all() for o in outs
+                              if jnp.issubdtype(o.dtype, jnp.floating)]
                 parts += [jnp.isfinite(nw).all() for nw in new_ws]
                 finite = parts[0]
                 for p in parts[1:]:
@@ -998,23 +1275,36 @@ class FusedTrainStep:
                     jnp.where(finite, na, a.astype(na.dtype))
                     for na, a in zip(new_aux, auxs))
             # keep the persistent carries in their input layout (replicated
-            # for DP; whatever the user sharded for TP/ZeRO)
-            new_ss = tuple(_constrain_like(s, sh)
-                           for s, sh in zip(new_ss, self._call_s_shardings))
-            new_aux = tuple(_constrain_like(a, s)
-                            for a, s in zip(new_aux, self._call_a_shardings))
+            # for DP; whatever the user sharded for TP/ZeRO).  Inside the
+            # pod shard_map the layout is enforced by the out_specs
+            # instead — sharding constraints are global-view constructs.
+            if pod_axis is None:
+                new_ss = tuple(
+                    _constrain_like(s, sh)
+                    for s, sh in zip(new_ss, self._call_s_shardings))
+                new_aux = tuple(
+                    _constrain_like(a, s)
+                    for a, s in zip(new_aux, self._call_a_shardings))
             if derive:
                 new_ws = ()   # flush re-derives from the masters on demand
-            else:
+            elif pod_axis is None:
                 new_ws = tuple(
                     _constrain_like(w, s)
                     for w, s in zip(new_ws, self._call_w_shardings))
+            else:
+                new_ws = tuple(new_ws)
             labels = inputs[len(inputs) - n_label:] if n_label else ()
             new_mcarry = []
-            for (fn, _), (msum, mnum) in zip(metric_fns, mcarry):
-                dsum, dnum = fn(list(labels), list(outs))
-                dsum = jnp.asarray(dsum, jnp.float32)
-                dnum = jnp.asarray(dnum, jnp.int32)
+            for j, ((fn, _), (msum, mnum)) in enumerate(
+                    zip(metric_fns, mcarry)):
+                if pod_deltas is not None:
+                    # global deltas arrived inside the bundled exchange
+                    dsum, dnum = pod_deltas[j]
+                    dnum = dnum.astype(jnp.int32)
+                else:
+                    dsum, dnum = fn(list(labels), list(outs))
+                    dsum = jnp.asarray(dsum, jnp.float32)
+                    dnum = jnp.asarray(dnum, jnp.int32)
                 if guard:
                     # a skipped batch must not poison the metric totals
                     dsum = jnp.where(finite, dsum, jnp.zeros_like(dsum))
@@ -1034,11 +1324,80 @@ class FusedTrainStep:
         return core
 
     def _trace_core(self, core, example):
-        """Run the framework trace ONCE; every program replays the jaxpr."""
-        self._core_closed = _TracedCore(core, example)
+        """Run the framework trace ONCE; every program replays the jaxpr.
+        In pod mode the trace runs with SHARD-local input shapes under
+        the dp axis env — the jaxpr replays inside the shard_map wrap."""
+        if self._pod_axis is not None:
+            example = self._pod_shrink(example)
+            self._pod_example = example
+            self._core_closed = _TracedCore(
+                core, example,
+                axis_env=[(self._pod_axis, self._dp_size)])
+        else:
+            self._core_closed = _TracedCore(core, example)
+
+    # -- pod fast-path plumbing ----------------------------------------------
+    def _pod_shrink(self, example):
+        """The trace example with every data/label input shrunk to its
+        per-shard shape (ShapeDtypeStructs; carries stay global — they
+        are replicated, so local == global)."""
+        import jax
+        inner, x, fixed, rescale = example
+        dp = self._dp_size
+
+        def shrink(v):
+            s = tuple(v.shape)
+            return jax.ShapeDtypeStruct((s[0] // dp,) + s[1:], v.dtype)
+
+        inputs = tuple(shrink(v) for v in x[0])
+        return (inner, (inputs,) + tuple(x[1:]), fixed, rescale)
+
+    def _pod_outs_ok(self):
+        """Every graph output must be batch-led (its shard_map out_spec
+        stitches the per-shard rows back into the global batch); a
+        scalar/reduced output has no general reconstitution rule."""
+        inner, x, *_ = self._pod_example
+        local_b = x[0][0].shape[0]
+        step_out = self._core_closed.out_shape[1]
+        outs = step_out[0] if self._guard else step_out
+        import jax
+        return all(
+            getattr(o, "shape", ()) and o.shape[0] == local_b
+            for o in jax.tree_util.tree_leaves(outs))
+
+    def _pod_call(self):
+        """The shard_map-wrapped core (or None outside pod mode): batch
+        inputs and graph outputs shard over the dp axis, every carry is
+        replicated."""
+        if self._pod_axis is None:
+            return None
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from .parallel.mesh import compat_shard_map
+        axis = self._pod_axis
+        tmap = jax.tree_util.tree_map
+        rep = lambda t: tmap(lambda _: P(), t)                # noqa: E731
+        shd = lambda t: tmap(lambda _: P(axis), t)            # noqa: E731
+        inner_ex, x_ex, fixed_ex, rescale_ex = self._pod_example
+        x_spec = (shd(x_ex[0]),) + tuple(rep(e) for e in x_ex[1:])
+        in_specs = (rep(inner_ex), x_spec, rep(fixed_ex), P())
+        new_inner_sh, step_out_sh = self._core_closed.out_shape
+        if self._guard:
+            out_specs = (rep(new_inner_sh),
+                         (shd(step_out_sh[0]), rep(step_out_sh[1])))
+        else:
+            out_specs = (rep(new_inner_sh), shd(step_out_sh))
+        return compat_shard_map(self._core_closed, mesh=self._mesh,
+                                in_specs=in_specs, out_specs=out_specs)
+
+    def _pod_tag(self):
+        return None if self._pod_axis is None else \
+            ("pod", self._pod_axis, self._dp_size)
 
     def _build1(self):
-        self._jit = _one_step_jit(self._core_closed, label=self._audit_key)
+        self._jit = _one_step_jit(self._core_closed, label=self._audit_key,
+                                  call_fn=self._pod_call(),
+                                  key_tag=self._pod_tag())
 
     def _buildk(self, k):
         # one scan-jit serves every K (xs arity keys the jit's own cache);
@@ -1047,7 +1406,9 @@ class FusedTrainStep:
         # carry — the scan stacks it per step for the callback burst
         jitk = self._scan_jit if getattr(self, "_scan_jit", None) is not None \
             else _scan_block_jit(self._core_closed, mcarry_index=3,
-                                 label=self._audit_key)
+                                 label=self._audit_key,
+                                 call_fn=self._pod_call(),
+                                 key_tag=self._pod_tag())
         self._scan_jit = jitk
         self._jit_block[k] = jitk
         return jitk
@@ -1169,11 +1530,10 @@ class FusedTrainStep:
         if not n_inputs_ok:
             self.flush()   # caller runs unfused on the public buffers
             return False
-        ndev = len(self._contexts)
-        if ndev > 1 and any(
-                (shape[0] if shape else 0) % ndev
+        if self._dp_size > 1 and any(
+                (shape[0] if shape else 0) % self._dp_size
                 for shape, _dt in in_sig):
-            # e.g. a partial tail batch: not shardable over the mesh —
+            # e.g. a partial tail batch: not shardable over the dp axis —
             # this batch takes the unfused path, the step stays usable
             self.flush()
             return False
@@ -1247,7 +1607,9 @@ class FusedTrainStep:
             if cached is not None:
                 (self._core_closed, self._jit, self._scan_jit,
                  self._jit_block, self._derive_ws, self._mp_pos,
-                 self._w_dtypes) = cached
+                 self._w_dtypes, self._pod_axis,
+                 self._pod_example, self._pod_plan,
+                 self.pod_stats) = cached
             else:
                 self._core_closed = None
 
@@ -1303,6 +1665,32 @@ class FusedTrainStep:
                         inner = ((),) + inner[1:]
                     self._trace_core(core, (inner, xs[0], fixed,
                                             rescale_dev))
+                    if self._pod_axis is not None and \
+                            not self._pod_outs_ok():
+                        # a reduced (non-batch-led) graph output cannot
+                        # ride the pod fast path; re-trace global-view
+                        _log.info("pod fast path disabled: graph outputs "
+                                  "are not batch-led")
+                        self._pod_axis = None
+                        self.pod_stats = None
+                        core = self._build_core(metric_fns)
+                        self._trace_core(core, (inner, xs[0], fixed,
+                                                rescale_dev))
+                    if self._pod_axis is not None:
+                        plan = getattr(self, "_pod_plan", [])
+                        nbytes = sum(
+                            int(_np.prod(w.shape)) * w.dtype.itemsize
+                            for w in ws) if ws else 0
+                        self.pod_stats = {
+                            "axis": self._pod_axis, "dp": self._dp_size,
+                            "params": len(self._param_names),
+                            "buckets": len(plan),
+                            "collectives_per_step": len(plan),
+                            "bytes_per_step": nbytes,
+                        }
+                        from . import profiler as _profiler
+                        _profiler.record_kvstore(
+                            "pod_exchange", **self.pod_stats)
                     self._jit = None
                     self._jit_block = {}
                     self._scan_jit = None
@@ -1398,7 +1786,9 @@ class FusedTrainStep:
                 self._core_closed, self._jit, self._scan_jit,
                 self._jit_block, self._derive_ws,
                 getattr(self, "_mp_pos", None),
-                getattr(self, "_w_dtypes", None))
+                getattr(self, "_w_dtypes", None),
+                self._pod_axis, getattr(self, "_pod_example", None),
+                getattr(self, "_pod_plan", None), self.pod_stats)
         if was_cold:
             # first step of a signature: write through immediately so the
             # `_seen_*` identity snapshots exist for the fast-path check

@@ -3,7 +3,8 @@
 from __future__ import annotations
 
 from ...block import Block, HybridBlock
-from ...nn import Sequential, HybridSequential, Embedding, BatchNorm
+from ...nn import (Sequential, HybridSequential, Embedding, BatchNorm,
+                   SyncBatchNorm as _NnSyncBatchNorm)
 
 
 class Concurrent(Sequential):
@@ -60,22 +61,11 @@ class SparseEmbedding(Block):
         return self._embed(x)
 
 
-class SyncBatchNorm(BatchNorm):
-    """Cross-device BatchNorm (reference `basic_layers.py:SyncBatchNorm`).
-
-    Under this framework's data-parallel design the train step is ONE
-    SPMD program (`parallel.data_parallel_step`), so batch statistics are
-    computed over the device axis with an XLA `pmean` when run inside
-    `shard_map` — the separate NCCL sync pass of the reference
-    (`sync_batch_norm-inl.h`) has no equivalent to manage.  Outside an
-    SPMD region this is exactly BatchNorm.
-    """
-
-    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
-                 epsilon=1e-5, **kwargs):
-        super().__init__(momentum=momentum, epsilon=epsilon,
-                         in_channels=in_channels, **kwargs)
-        self._num_devices = num_devices
+class SyncBatchNorm(_NnSyncBatchNorm):
+    """Kept at its historical contrib path; the implementation moved to
+    `gluon.nn.SyncBatchNorm` (distributed BN with a psum of moments over
+    the dp axis inside SPMD regions; global-batch statistics by
+    construction under the fused train step)."""
 
 
 class _PixelShuffle(HybridBlock):

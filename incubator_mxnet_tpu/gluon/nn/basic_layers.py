@@ -7,8 +7,8 @@ from ..block import Block, HybridBlock
 from ...base import MXNetError
 
 __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "Embedding",
-           "BatchNorm", "InstanceNorm", "LayerNorm", "Flatten", "Lambda",
-           "HybridLambda"]
+           "BatchNorm", "SyncBatchNorm", "InstanceNorm", "LayerNorm",
+           "Flatten", "Lambda", "HybridLambda"]
 
 
 class Sequential(Block):
@@ -194,6 +194,31 @@ class BatchNorm(HybridBlock):
     def __repr__(self):
         in_channels = self.gamma.shape[0]
         return f"BatchNorm(axis={self._axis}, in_channels={in_channels})"
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device BatchNorm: statistics over the GLOBAL batch
+    (reference `contrib/nn/basic_layers.py:SyncBatchNorm` /
+    `sync_batch_norm-inl.h`, promoted into `gluon.nn` per the
+    MLPerf-pods distributed-BN recipe).
+
+    Sets ``sync=True`` on the underlying BatchNorm op: inside an
+    explicit SPMD region (`shard_map` with the dp axis bound —
+    `parallel.data_parallel_step`, `zero_train_step`) the moments psum
+    over ``sync_axis``; under the fused `Module.fit` train step the
+    program is global-view, so batch statistics are already global and
+    this layer is numerically identical to `BatchNorm` there (the
+    stronger semantics by construction).  ``num_devices`` is accepted
+    for reference API compatibility; the axis size comes from the mesh.
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, sync_axis="dp", **kwargs):
+        super().__init__(momentum=momentum, epsilon=epsilon,
+                         in_channels=in_channels, **kwargs)
+        self._kwargs["sync"] = True
+        self._kwargs["sync_axis"] = sync_axis
+        self._num_devices = num_devices
 
 
 class InstanceNorm(HybridBlock):

@@ -22,7 +22,8 @@ class Trainer:
     """
 
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
-                 compression_params=None, update_on_kvstore=None, zero=None):
+                 compression_params=None, update_on_kvstore=None, zero=None,
+                 mesh=None):
         if isinstance(params, (dict, ParameterDict)):
             params = list(params.values())
         if not isinstance(params, (list, tuple)):
@@ -50,8 +51,32 @@ class Trainer:
         self._kvstore = None
         self._update_on_kvstore = None
         self._fused = None
+        if mesh is None:
+            # MXNET_MESH spec (or None).  On the Trainer, `mesh=` exists
+            # to resolve `zero=True` (which dp axis shards the optimizer
+            # state) — the per-parameter update path itself is mesh-free;
+            # the composed-mesh TRAINING lever lives in Module.fit /
+            # parallel's explicit SPMD steps.
+            from ..parallel.mesh import mesh_from_spec
+            try:
+                mesh = mesh_from_spec()
+            except Exception:
+                mesh = None
+        self._mesh = mesh
+        if zero is True:
+            if mesh is None:
+                raise MXNetError(
+                    "Trainer(zero=True) needs a mesh: pass mesh= (or set "
+                    "MXNET_MESH), or hand zero= the mesh directly")
+            zero = mesh
+        elif zero is False:
+            zero = None
         if zero is not None and not isinstance(zero, tuple):
-            zero = (zero, list(zero.shape.keys())[0])
+            # optimizer state shards over the DATA-parallel axis (every
+            # dp rank holds the full params and a 1/N state shard) — on
+            # a composed mesh the dp axis is found by name, not position
+            from ..parallel.mesh import dp_axis_of
+            zero = (zero, dp_axis_of(zero))
         self._zero = zero  # (mesh, axis) for sharded optimizer state
 
     def _check_contexts(self):
@@ -119,12 +144,26 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
-        for i, param in enumerate(self._params):
-            if param.grad_req != "null":
-                grads = param.list_grad()
+        live = []
+        for i, p in enumerate(self._params):
+            if p.grad_req != "null":
+                grads = p.list_grad()
                 if len(grads) > 1:
-                    self._kvstore.push(i, grads, priority=-i)
-                    self._kvstore.pull(i, grads, priority=-i)
+                    live.append((i, grads))
+        if not live:
+            return
+        if getattr(self._kvstore, "prefers_batched_push", False):
+            # ONE batched push/pull pair: the collective store packs the
+            # whole key list into size-capped buckets and dispatches
+            # O(buckets) overlapped all-reduces, not one per parameter
+            keys = [i for i, _ in live]
+            grads = [g for _, g in live]
+            self._kvstore.push(keys, grads)
+            self._kvstore.pull(keys, grads)
+            return
+        for i, grads in live:
+            self._kvstore.push(i, grads, priority=-i)
+            self._kvstore.pull(i, grads, priority=-i)
 
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:

@@ -191,7 +191,7 @@ class BaseModule:
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, sparse_row_id_fn=None, checkpoint_dir=None,
             checkpoint_period=100, checkpoint_keep_last=5, resume=False,
-            max_restarts=None):
+            max_restarts=None, mesh=None):
         """THE classic training loop (reference `base_module.py:409 fit`).
 
         Elastic checkpointing (no reference analogue): with
@@ -267,7 +267,7 @@ class BaseModule:
             sparse_row_id_fn=sparse_row_id_fn,
             checkpoint_dir=checkpoint_dir,
             checkpoint_period=checkpoint_period,
-            checkpoint_keep_last=checkpoint_keep_last)
+            checkpoint_keep_last=checkpoint_keep_last, mesh=mesh)
         while True:
             try:
                 return self._fit_attempt(
@@ -376,7 +376,7 @@ class BaseModule:
                      validation_metric=None, monitor=None,
                      sparse_row_id_fn=None, checkpoint_dir=None,
                      checkpoint_period=100, checkpoint_keep_last=5,
-                     resume=False):
+                     resume=False, mesh=None):
         """One fit attempt; `ServerLostError` propagates to `fit`'s
         restart loop with the checkpoint manager already flushed/closed."""
         assert num_epoch is not None, "please specify number of epochs"
@@ -445,7 +445,7 @@ class BaseModule:
                          aux_params=aux_params, allow_missing=allow_missing,
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
-                            optimizer_params=optimizer_params)
+                            optimizer_params=optimizer_params, mesh=mesh)
         sup = self._start_supervisor()
         if checkpoint_dir is not None:
             from .. import checkpoint as _ckpt
@@ -932,7 +932,7 @@ class BaseModule:
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
-                       force_init=False):
+                       force_init=False, mesh=None):
         raise NotImplementedError()
 
 

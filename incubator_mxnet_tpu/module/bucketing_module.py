@@ -168,12 +168,13 @@ class BucketingModule(BaseModule):
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
-                       force_init=False):
+                       force_init=False, mesh=None):
         assert self.binded and self.params_initialized
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring.")
             return
         self._curr_module.init_optimizer(kvstore, optimizer, optimizer_params,
+                                         mesh=mesh,
                                          force_init=force_init)
         for mod in self._buckets.values():
             if mod is not self._curr_module:

@@ -57,6 +57,7 @@ class Module(BaseModule):
         self._data_shapes = None
         self._label_shapes = None
         self._fused_step = None
+        self._mesh = None
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
@@ -232,13 +233,22 @@ class Module(BaseModule):
     # -- optimizer -------------------------------------------------------------
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
-                       force_init=False):
+                       force_init=False, mesh=None):
         assert self.binded and self.params_initialized
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring...")
             return
         if self._params_dirty:
             self._sync_params_from_devices()
+
+        # composed device mesh for the fused step: a jax Mesh, a spec
+        # string/dict ('dp=4,tp=2'), or None (MXNET_MESH env spec, else
+        # the default 1-D dp mesh over the contexts)
+        if mesh is not None and not hasattr(mesh, "axis_names"):
+            from ..parallel.mesh import mesh_from_spec
+            mesh = mesh_from_spec(
+                mesh, devices=[c.jax_device for c in self._context])
+        self._mesh = mesh
 
         (kvstore, update_on_kvstore) = _create_kvstore(
             kvstore, len(self._context), self._arg_params)

@@ -106,7 +106,7 @@ class PythonModule(BaseModule):
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
-                       force_init=False):
+                       force_init=False, mesh=None):
         """Nothing to optimize by default."""
         self.optimizer_initialized = True
 

@@ -209,19 +209,43 @@ def _bn_nout(params):
     return 3 if params.get("output_mean_var") else 1
 
 
+def _bn_axis_bound(name):
+    """True when the named mesh axis is bound in the current trace (a
+    `shard_map`/pmap region): probing with a zero-size psum either
+    traces fine or raises NameError — never dispatches real work."""
+    try:
+        jax.lax.psum(jnp.zeros(()), name)
+        return True
+    except NameError:
+        return False
+
+
 @register("BatchNorm", nin=3, naux=2, nout=_bn_nout, mode_dependent=True,
           params={"eps": 1e-3, "momentum": 0.9, "fix_gamma": True,
                   "use_global_stats": False, "output_mean_var": False,
-                  "axis": 1, "cudnn_off": False},
+                  "axis": 1, "cudnn_off": False, "sync": False,
+                  "sync_axis": "dp"},
           aliases=("BatchNorm_v1",),
           input_names=["data", "gamma", "beta", "moving_mean", "moving_var"])
 def _batch_norm(params, x, gamma, beta, moving_mean, moving_var):
     """Reference `src/operator/nn/batch_norm.cc`.  Aux states
-    (moving_mean/var) are inputs 4-5 and returned as updates in train mode."""
+    (moving_mean/var) are inputs 4-5 and returned as updates in train mode.
+
+    ``sync=True`` asks for GLOBAL-batch statistics (the reference's
+    `sync_batch_norm-inl.h` distributed BatchNorm, per the MLPerf-pods
+    recipe): inside an explicit SPMD region (`shard_map` over a mesh
+    with the ``sync_axis`` axis bound — `parallel.data_parallel_step`,
+    `zero_train_step`) the moments psum over that axis.  Inside the
+    fused train step the whole program is GLOBAL-view (the batch is
+    merely sharded over dp), so the plain reductions already ARE
+    global-batch statistics and ``sync`` adds nothing — sync-BN is the
+    fused path's default semantics."""
     axis = int(params["axis"]) % x.ndim
     eps = float(params["eps"])
     momentum = float(params["momentum"])
     train = params.get("_train", False) and not params["use_global_stats"]
+    sync = bool(params.get("sync", False))
+    sync_axis = str(params.get("sync_axis", "dp"))
 
     if params["fix_gamma"]:
         gamma = jnp.ones_like(gamma)
@@ -235,7 +259,17 @@ def _batch_norm(params, x, gamma, beta, moving_mean, moving_var):
     xs = x.astype(jnp.float32) if x.dtype != jnp.float32 else x
     if train:
         mean = jnp.mean(xs, axis=red_axes)
-        var = jnp.mean(jnp.square(xs - mean.reshape(bshape)), axis=red_axes)
+        if sync and _bn_axis_bound(sync_axis):
+            # distributed BN: psum of moments over the dp axis — with
+            # equal per-device batches, pmean of local moments around
+            # the GLOBAL mean is exactly the big-batch statistics
+            mean = jax.lax.pmean(mean, sync_axis)
+            var = jnp.mean(jnp.square(xs - mean.reshape(bshape)),
+                           axis=red_axes)
+            var = jax.lax.pmean(var, sync_axis)
+        else:
+            var = jnp.mean(jnp.square(xs - mean.reshape(bshape)),
+                           axis=red_axes)
     else:
         mean, var = moving_mean, moving_var
 

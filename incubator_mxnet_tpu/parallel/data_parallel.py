@@ -36,9 +36,7 @@ def data_parallel_step(loss_fn, optimizer_update, mesh, axis_name="dp",
     Returns step(params, opt_state, batch, lr) -> (params, opt_state, loss):
     params/opt_state replicated; batch sharded on axis 0 over `axis_name`.
     """
-    from jax import shard_map
-
-    n_axes = len(mesh.axis_names)
+    from .mesh import compat_shard_map
 
     def spmd_step(params, opt_state, batch, lr):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
@@ -51,10 +49,9 @@ def data_parallel_step(loss_fn, optimizer_update, mesh, axis_name="dp",
 
     batch_spec = P(axis_name)
     rep = P()
-    step = shard_map(spmd_step, mesh=mesh,
-                     in_specs=(rep, rep, batch_spec, rep),
-                     out_specs=(rep, rep, rep),
-                     check_vma=False)
+    step = compat_shard_map(spmd_step, mesh=mesh,
+                            in_specs=(rep, rep, batch_spec, rep),
+                            out_specs=(rep, rep, rep))
     return jax.jit(step, donate_argnums=(0, 1) if donate else ())
 
 

@@ -44,6 +44,67 @@ def make_mesh(shape=None, axis_names=None, devices=None):
     return Mesh(dev_array, axis_names)
 
 
+def parse_spec(spec):
+    """Parse a mesh spec string — ``'dp=8'``, ``'dp=4,tp=2'`` — into an
+    ordered axis->size dict (the `MXNET_MESH` / ``Module.fit(mesh=)``
+    currency).  Axis order is placement order: outermost axes land on
+    DCN, innermost on ICI (scaling-book recipe)."""
+    out = {}
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise MXNetError(
+                f"bad mesh spec part {part!r} (want axis=size, e.g. "
+                "'dp=4,tp=2')")
+        k, v = part.split("=", 1)
+        try:
+            out[k.strip()] = int(v)
+        except ValueError:
+            raise MXNetError(f"bad mesh axis size {v!r} in spec {spec!r}")
+    return out
+
+
+def mesh_from_spec(spec=None, devices=None):
+    """Build a Mesh from a spec (string or axis->size dict); with
+    ``spec=None`` reads `MXNET_MESH`.  Returns None when nothing is
+    configured — callers fall back to their default 1-D dp mesh."""
+    if spec is None or spec == "":
+        from .. import config as _config
+        spec = _config.get("MXNET_MESH")
+    if not spec:
+        return None
+    if isinstance(spec, str):
+        spec = parse_spec(spec)
+    if not spec:
+        return None
+    return make_mesh(spec, devices=devices)
+
+
+def dp_axis_of(mesh):
+    """The data-parallel axis of a composed mesh: 'dp' when present,
+    else the first axis (the convention every consumer shares)."""
+    names = tuple(mesh.axis_names)
+    return "dp" if "dp" in names else names[0]
+
+
+def compat_shard_map(fn, mesh, in_specs, out_specs):
+    """`shard_map` across the jax versions this framework supports: the
+    stable `jax.shard_map` (check_vma) when present, else the
+    `jax.experimental.shard_map` spelling (check_rep).  Every SPMD
+    consumer (parallel/data_parallel.py, parallel/zero.py, the fused
+    step's pod fast path) builds through this one seam."""
+    try:
+        from jax import shard_map as _sm
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False)
+    return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_vma=False)
+
+
 def local_mesh(n=None, axis_names=("dp",)):
     """Mesh over the first n local devices (testing convenience)."""
     import jax

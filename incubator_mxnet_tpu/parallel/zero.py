@@ -82,8 +82,8 @@ def zero_train_step(loss_fn, update_fn, mesh, axis_name="dp", donate=True):
     params and batch as in the dp step; opt_state leaves are the local
     1/N shards (out_spec P(axis_name) on the leading dim).
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+    from .mesh import compat_shard_map
 
     def spmd_step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
@@ -92,11 +92,10 @@ def zero_train_step(loss_fn, update_fn, mesh, axis_name="dp", donate=True):
                                             update_fn, axis_name)
         return new_params, new_state, loss
 
-    step = shard_map(
+    step = compat_shard_map(
         spmd_step, mesh=mesh,
         in_specs=(P(), P(axis_name), P(axis_name)),
-        out_specs=(P(), P(axis_name), P()),
-        check_vma=False)
+        out_specs=(P(), P(axis_name), P()))
     return jax.jit(step, donate_argnums=(0, 1) if donate else ())
 
 

@@ -218,6 +218,14 @@ def record_guardian(event, **args):
     _record_instant("guardian", event, **args)
 
 
+def record_kvstore(event, **args):
+    """Record one bucketed-communication event (the collective kvstore
+    feeds this per batched push: buckets cut, bytes reduced, overlap
+    hits), so the gradient-exchange economy lines up against the train
+    steps it served."""
+    _record_instant("kvstore", event, **args)
+
+
 def record_fault(site, kind, **args):
     """Record one fired fault / resilience event (resilience.faults feeds
     this), so chaos-run failure injections line up against the serving

@@ -14,13 +14,16 @@ prints the machine-readable protocol the launchers parse:
 
 Env: ``POD_CKPT_DIR`` (shared checkpoint directory, required),
 ``POD_RESUME=1`` (resume the directory's run — the control lane of the
-bit-identical gate), and the usual DMLC_*/MXNET_* knobs (fault schedules
-ride ``MXNET_FAULTS``).
+bit-identical gate), ``POD_SCALING=1`` (record a per-world-size
+throughput curve across shrinks and print it as ``SCALING {json}`` —
+the chaos ``pod-scaling`` schedule's artifact), and the usual
+DMLC_*/MXNET_* knobs (fault schedules ride ``MXNET_FAULTS``).
 """
 import hashlib
 import json
 import logging
 import os
+import time
 
 import numpy as np
 import jax
@@ -45,11 +48,36 @@ def main():
     y = (np.arange(48) % 4).astype("f4")
     it = NDArrayIter(X, y, batch_size=8, shuffle=False)
     mod = mx.mod.Module(net, context=mx.cpu())
+    marks = []          # (world_size, perf_counter) per completed batch
+
+    def scaling_cb(param):
+        sup = mod._supervisor
+        world = sup.stats()["world_size"] if sup is not None else \
+            int(os.environ.get("DMLC_NUM_WORKER", 1))
+        marks.append((world, time.perf_counter()))
+
+    scaling = os.environ.get("POD_SCALING") == "1"
     mod.fit(it, kvstore="dist_sync", optimizer="sgd",
             optimizer_params={"learning_rate": 0.1}, num_epoch=2,
             checkpoint_dir=os.environ["POD_CKPT_DIR"],
             checkpoint_period=1, checkpoint_keep_last=100,
-            resume=os.environ.get("POD_RESUME") == "1")
+            resume=os.environ.get("POD_RESUME") == "1",
+            batch_end_callback=scaling_cb if scaling else None)
+    if scaling:
+        # the scaling curve across shrinks: this worker's steps and
+        # steps/s per world size it trained at (world 3 pre-kill,
+        # world 2 post-shrink in the sabotaged lane)
+        curve = {}
+        for (world, t) in marks:
+            pt = curve.setdefault(world, {"steps": 0, "t0": t, "t1": t})
+            pt["steps"] += 1
+            pt["t1"] = t
+        print("SCALING " + json.dumps({
+            str(w): {"steps": pt["steps"],
+                     "steps_per_s": round(
+                         (pt["steps"] - 1) / max(pt["t1"] - pt["t0"],
+                                                 1e-9), 2)}
+            for w, pt in sorted(curve.items())}))
     sup = mod._supervisor
     if sup is not None:
         print("SUPSTATS " + json.dumps(sup.stats()))

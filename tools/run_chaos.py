@@ -307,9 +307,161 @@ def run_pod_schedule(name, schedule, quiet=False):
     return result
 
 
+def _spawn_pod(port, n_workers, ckpt_dir, log_path, faults_by_rank=None,
+               resume=False, scaling=True):
+    """Launch n supervised pod workers against the coordinator at
+    `port`; returns (procs, outs).  One copy of the env recipe shared
+    by the scaling schedule's chaos and control lanes."""
+    base_env = dict(
+        os.environ,
+        DMLC_PS_ROOT_URI="127.0.0.1", DMLC_PS_ROOT_PORT=str(port),
+        DMLC_NUM_WORKER=str(n_workers), DMLC_ROLE="worker",
+        MXNET_KVSTORE_COLLECTIVE="0",
+        MXNET_SUPERVISOR_HEARTBEAT_S="0.2",
+        MXNET_SUPERVISOR_DEADLINE_S="1.2",
+        MXNET_SUPERVISOR_COLLECTIVE_TIMEOUT_S="3.0",
+        MXNET_SUPERVISOR_SHRINK_BARRIER_S="10.0",
+        MXNET_PS_RECONNECT_WAIT="1.0",
+        MXNET_FAULTS_LOG=log_path,
+        POD_CKPT_DIR=ckpt_dir,
+        POD_RESUME="1" if resume else "0",
+        POD_SCALING="1" if scaling else "0",
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    base_env.pop("MXNET_FAULTS", None)
+    base_env.pop("MXNET_SUPERVISOR_EPOCH", None)
+    procs = []
+    for r in range(n_workers):
+        env = dict(base_env, DMLC_RANK=str(r))
+        spec = (faults_by_rank or {}).get(str(r))
+        if spec:
+            env["MXNET_FAULTS"] = spec
+        procs.append(subprocess.Popen(
+            [sys.executable, POD_WORKER_PATH], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, cwd=REPO))
+    outs = []
+    for p in procs:
+        try:
+            outs.append(p.communicate(timeout=240)[0].decode())
+        except subprocess.TimeoutExpired:
+            p.kill()
+            outs.append((p.communicate()[0] or b"").decode()
+                        + "\nHUNG (killed)")
+    return procs, outs
+
+
+def _pod_server(port, n_workers):
+    env = dict(os.environ,
+               DMLC_PS_ROOT_URI="127.0.0.1", DMLC_PS_ROOT_PORT=str(port),
+               DMLC_NUM_WORKER=str(n_workers),
+               JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep +
+               os.environ.get("PYTHONPATH", ""))
+    return subprocess.Popen(
+        [sys.executable, "-m", "incubator_mxnet_tpu.dist.server"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        cwd=REPO)
+
+
+def run_pod_scaling_schedule(quiet=False):
+    """The scale-meets-resilience composition gate: a 3-worker
+    SUPERVISED scaling sweep (per-world-size throughput curve recorded
+    by every worker), one host SIGKILLed mid-sweep — survivors must
+    shrink to world 2, resume from the last committed checkpoint, and
+    COMPLETE the curve (points at world 3 AND world 2) — then a control
+    lane: an uninterrupted 2-worker run resumed from the same
+    checkpoint must end with bit-identical params."""
+    t0 = time.time()
+    checks = {}
+    log_fd, log_path = tempfile.mkstemp(prefix="chaos-pod-scaling-",
+                                        suffix=".jsonl")
+    os.close(log_fd)
+    ckpt_dir = tempfile.mkdtemp(prefix="chaos-pod-scaling-ckpt-")
+    control_dir = ckpt_dir + "-control"
+    curves = []
+    try:
+        # lane 1 — chaos: rank 2 dies at its 4th step, mid-sweep
+        port = _free_port()
+        server = _pod_server(port, 3)
+        procs, outs = _spawn_pod(
+            port, 3, ckpt_dir, log_path,
+            faults_by_rank={"2": "seed=24;host.step:kill(at=4)"})
+        server.kill()
+        server.communicate()
+        shas, resume_step = set(), None
+        for r in (0, 1):
+            m = re.search(r"PARAMS_SHA (\w+)", outs[r])
+            shas.add(m.group(1) if m else None)
+            m = re.search(r"SCALING (.*)", outs[r])
+            curves.append(json.loads(m.group(1)) if m else {})
+            m = re.search(r"resuming from .*\(step (\d+),", outs[r])
+            if m:
+                resume_step = int(m.group(1))
+        checks["killed_host_rc_137"] = procs[2].returncode == 137
+        checks["survivors_completed"] = all(
+            p.returncode == 0 for p in procs[:2])
+        checks["survivors_agree"] = len(shas) == 1 and None not in shas
+        # the curve COMPLETED across the shrink: every survivor holds a
+        # world-3 point (pre-kill) and a world-2 point (post-resume)
+        checks["curve_spans_shrink"] = all(
+            set(c) >= {"2", "3"} and
+            all(pt["steps"] > 0 for pt in c.values())
+            for c in curves)
+        # lane 2 — control: clean 2-worker resume from the SAME
+        # checkpoint the survivors resumed from (prune newer snapshots)
+        checks["resume_step_found"] = resume_step is not None
+        if resume_step is not None:
+            shutil.copytree(ckpt_dir, control_dir)
+            for entry in os.listdir(control_dir):
+                cm = re.match(r"ckpt-(\d+)$", entry)
+                if cm and int(cm.group(1)) > resume_step:
+                    shutil.rmtree(os.path.join(control_dir, entry))
+            port = _free_port()
+            server = _pod_server(port, 2)
+            cprocs, couts = _spawn_pod(port, 2, control_dir, log_path,
+                                       resume=True)
+            server.kill()
+            server.communicate()
+            cshas = set()
+            for r in (0, 1):
+                m = re.search(r"PARAMS_SHA (\w+)", couts[r])
+                cshas.add(m.group(1) if m else None)
+            checks["control_completed"] = all(
+                p.returncode == 0 for p in cprocs)
+            checks["bit_identical_vs_clean_shrunk"] = (
+                len(cshas) == 1 and None not in cshas and cshas == shas)
+    finally:
+        fault_agg = _read_fault_log(log_path)
+        os.unlink(log_path)
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+        shutil.rmtree(control_dir, ignore_errors=True)
+    bools = [v for v in checks.values() if isinstance(v, bool)]
+    result = {
+        "schedule": "pod-scaling",
+        "specs": {"2": "seed=24;host.step:kill(at=4)"},
+        "killed_rank": 2,
+        "checks": checks,
+        "curves": curves,
+        "workers": [],
+        **fault_agg,
+        "duration_s": round(time.time() - t0, 1),
+        "passed": bool(bools) and all(bools),
+    }
+    if not quiet:
+        print("chaos[pod-scaling]: passed=%s checks=%s (%.1fs)" %
+              (result["passed"], checks, result["duration_s"]),
+              file=sys.stderr)
+    return result
+
+
 def run_pod(as_json=False, out_path=None):
     runs = [run_pod_schedule(name, sched, quiet=as_json)
             for name, sched in POD_SCHEDULES.items()]
+    try:
+        runs.append(run_pod_scaling_schedule(quiet=as_json))
+    except Exception as exc:
+        runs.append({"schedule": "pod-scaling", "passed": False,
+                     "workers": [], "error": repr(exc)})
     artifact = {
         "schedules": runs,
         "all_passed": all(r["passed"] for r in runs),

@@ -264,6 +264,29 @@ def tsan_stage():
     return out
 
 
+def scaling_stage():
+    """Scaling-curve stage: run tools/run_scaling.py --quick in a
+    throwaway process — the dp=1/2/4/8 sweep over host-platform virtual
+    devices through the public `Module.fit` path plus the comm-heavy
+    bucketed-vs-single-bucket A/B — and attach its BENCH_SCALING
+    artifact (per-point throughput + weak-scaling efficiency + kvstore
+    communication economy, gates: dp=8 efficiency, bucketed speedup,
+    zero steady-state recompiles, dispatches O(buckets)) to the round.
+    Pod-scale throughput claims become checkable evidence next to the
+    parity outcomes."""
+    cmd = [sys.executable, os.path.join(REPO, "tools", "run_scaling.py"),
+           "--quick", "--json", "--out",
+           os.path.join(REPO, "BENCH_SCALING.json")]
+    try:
+        out = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                             timeout=3600)
+        summary = json.loads(out.stdout)
+        summary["rc"] = out.returncode
+        return summary
+    except Exception as exc:
+        return {"error": f"scaling stage failed: {exc!r}"}
+
+
 def coldstart_stage():
     """Cold-start stage: the warmup CLI's built-in probe, run cold then
     warm in fresh subprocesses (tools/warmup.py coldstart_probe) — the
@@ -303,6 +326,7 @@ def main():
         "chaos_serving": chaos_serving_stage(),
         "chaos_train": chaos_train_stage(),
         "coldstart": coldstart_stage(),
+        "scaling": scaling_stage(),
         "tsan": tsan_stage(),
         "cmd": " ".join(cmd[2:]),
         "tests": tests[:500],
