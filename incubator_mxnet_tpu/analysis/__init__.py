@@ -116,6 +116,11 @@ def runtime_report():
         report.extend(_kvstore.findings())
     except Exception:
         pass
+    try:
+        from ..serving import fleet as _fleet
+        report.extend(_fleet.findings())
+    except Exception:
+        pass
     from . import tsan as _tsan
     if _tsan.enabled():
         report.extend(_tsan.findings())
@@ -125,6 +130,11 @@ def runtime_report():
 def reset_runtime():
     hostsync.reset()
     recompile.reset()
+    try:
+        from ..serving import fleet as _fleet
+        _fleet.reset_findings()
+    except Exception:
+        pass
     try:
         from ..resilience import supervisor as _supervisor
         _supervisor.reset_findings()

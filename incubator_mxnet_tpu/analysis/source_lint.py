@@ -31,6 +31,16 @@ walking a script's AST:
   death or one overload burst takes exactly that traffic down.  Route
   requests through ``router.submit()/predict()`` (or keep the script
   router-less on purpose and say so with a suppression).
+* ``fixed-fleet`` — a `ReplicaRouter` constructed with a hand-rolled
+  FIXED replica list (a list/tuple literal or a comprehension of
+  replica constructors) in a script that also configures the fleet
+  autoscaler (`FleetManager` / an `Autoscaler`): the fleet layer owns
+  membership — it places replicas across hosts with anti-affinity,
+  backfills host losses, and scales on the SLO signal — so a
+  hand-pinned fleet silently caps capacity at whatever the script
+  hard-coded and leaves host placement to luck.  Hand the router (or
+  nothing: the manager builds its own) plus the host registry to
+  `FleetManager` and let placement spawn the replicas.
 * ``nan-swallow`` — a ``try`` whose body runs a training update
   (`Module.fit` / `fit_step` / a trainer's ``.step``) with an
   exception handler that swallows the failure and keeps looping
@@ -131,6 +141,7 @@ _PASS_BY_CODE = {"host-sync-in-loop": "source.hostsync",
                  "nan-swallow": "source.guardian",
                  "unsupervised-collective": "source.supervisor",
                  "router-bypass": "source.router",
+                 "fixed-fleet": "source.fleet",
                  "unnamed-thread": "source.thread",
                  "bare-acquire": "source.locks",
                  "sleep-under-lock": "source.locks",
@@ -168,6 +179,9 @@ class _Visitor(ast.NodeVisitor):
         self.served_names = set()    # names bound from ServedModel(...)
         self.bypass_sites = []       # (lineno, what) — emitted only when
                                      # a router is configured
+        self.fleet_configured = False
+        self.fixed_router_sites = []  # (lineno, what) — emitted only
+                                      # when a fleet/autoscaler is too
         self.supervised_depth = 0  # inside a supervisor/watchdog `with`
         self.device_depth = 0      # inside a jit/pjit/shard_map function
         self.lock_with_depth = 0   # inside a `with <lock-ish>:` block
@@ -480,6 +494,24 @@ class _Visitor(ast.NodeVisitor):
         # -- router bypass ---------------------------------------------------
         if name == "ReplicaRouter":
             self.router_configured = True
+            # a hand-rolled FIXED replica population: a list/tuple
+            # literal (or comprehension) as the replicas argument —
+            # flagged only when the script ALSO configures the fleet
+            # autoscaler, which should own membership instead
+            replicas_arg = node.args[0] if node.args else next(
+                (kw.value for kw in node.keywords
+                 if kw.arg == "replicas"), None)
+            if isinstance(replicas_arg, (ast.List, ast.Tuple)) \
+                    and replicas_arg.elts:
+                self.fixed_router_sites.append(
+                    (node.lineno, "a %d-element replica list literal"
+                     % len(replicas_arg.elts)))
+            elif isinstance(replicas_arg, (ast.ListComp,
+                                           ast.GeneratorExp)):
+                self.fixed_router_sites.append(
+                    (node.lineno, "a replica comprehension"))
+        elif name in ("FleetManager", "Autoscaler"):
+            self.fleet_configured = True
         elif name == "ModelServer":
             self.bypass_sites.append(
                 (node.lineno, "ModelServer(...) instantiated"))
@@ -535,6 +567,19 @@ def scan_source(text, filename="<string>"):
                 "this traffic bypasses the router's failover, health "
                 "checks, and priority-class shedding — route it through "
                 "router.submit()/predict()",
+                location=f"{filename}:{lineno}"))
+    if v.fleet_configured:
+        for lineno, what in v.fixed_router_sites:
+            if _suppressed(lines, lineno, "fixed-fleet"):
+                continue
+            report.add(Finding(
+                "source.fleet", "fixed-fleet", WARN,
+                f"ReplicaRouter constructed with {what} in a script "
+                "that configures the fleet autoscaler: a hand-pinned "
+                "replica list caps capacity at what the script "
+                "hard-coded and bypasses host-aware placement/backfill "
+                "— hand the host registry to FleetManager and let "
+                "placement spawn the replicas",
                 location=f"{filename}:{lineno}"))
     if v.uses_tpu:
         for lineno, sink in v.kv_local_sites:

@@ -222,6 +222,53 @@ KNOBS = {
                                          "which even interactive "
                                          "requests are shed (the last "
                                          "line before queue collapse)"),
+    # -- cross-host serving fleet (serving/fleet.py) -------------------------
+    "MXNET_FLEET_TICK_S": (float, 0.5, "honored",
+                           "FleetManager control-loop tick: the autoscaler "
+                           "samples the router's est-wait signal and "
+                           "reconciles the fleet to target once per tick"),
+    "MXNET_FLEET_SLO_MS": (float, 100.0, "honored",
+                           "the autoscaler's SLO on the admission "
+                           "est-wait signal: sustained waits above it "
+                           "scale the fleet up (the same queue-model "
+                           "number the router sheds on)"),
+    "MXNET_FLEET_UP_AFTER_S": (float, 3.0, "honored",
+                               "est-wait must breach the SLO for this "
+                               "long, uninterrupted, before a scale-up "
+                               "(a transient burst never spawns)"),
+    "MXNET_FLEET_DOWN_AFTER_S": (float, 30.0, "honored",
+                                 "the fleet must be idle (est-wait under "
+                                 "the idle threshold, nothing in flight) "
+                                 "this long before a scale-down retires "
+                                 "a replica through the drain path"),
+    "MXNET_FLEET_IDLE_FRACTION": (float, 0.1, "honored",
+                                  "idle threshold as a fraction of the "
+                                  "SLO; est-wait between idle and SLO is "
+                                  "the hysteresis dead band (both streaks "
+                                  "reset, so a flapping signal can never "
+                                  "thrash the fleet)"),
+    "MXNET_FLEET_COOLDOWN_S": (float, 10.0, "honored",
+                               "minimum spacing between scale events: "
+                               "every action arms it, rate-limiting even "
+                               "a pathological signal to one event per "
+                               "window"),
+    "MXNET_FLEET_MIN_REPLICAS": (int, 1, "honored",
+                                 "scale-down floor (and the default "
+                                 "initial target)"),
+    "MXNET_FLEET_MAX_REPLICAS": (int, 8, "honored",
+                                 "scale-up ceiling: breaches past it are "
+                                 "counted (stats.signal.clamped_at_max), "
+                                 "not acted on"),
+    "MXNET_FLEET_HOST_HEARTBEAT_S": (float, 1.0, "honored",
+                                     "interval of the fleet's host-agent "
+                                     "heartbeats (fed into the "
+                                     "dist.membership table)"),
+    "MXNET_FLEET_HOST_DEADLINE_S": (float, 5.0, "honored",
+                                    "heartbeat silence before a HOST is "
+                                    "declared dead: all its replicas are "
+                                    "marked dead at once, in-flight "
+                                    "requests fail over, and the fleet "
+                                    "backfills on surviving hosts"),
     # -- training guardian (resilience/guardian.py) --------------------------
     "MXNET_GUARDIAN": (_BOOL, True, "honored",
                        "training health guardian in Module.fit: in-graph "

@@ -47,7 +47,7 @@ __all__ = ["MembershipTable"]
 
 
 class _Host:
-    __slots__ = ("rank", "last", "step", "ewma", "beats")
+    __slots__ = ("rank", "last", "step", "ewma", "beats", "label")
 
     def __init__(self, rank, now):
         self.rank = rank
@@ -55,6 +55,7 @@ class _Host:
         self.step = 0
         self.ewma = None      # step-time EWMA reported by the host
         self.beats = 0
+        self.label = None     # human name (serving-fleet host ids)
 
 
 class MembershipTable:
@@ -79,10 +80,13 @@ class MembershipTable:
         _tsan.instrument(self, "dist.membership")
 
     # -- liveness -------------------------------------------------------------
-    def heartbeat(self, rank, epoch, step=None, step_time=None):
+    def heartbeat(self, rank, epoch, step=None, step_time=None,
+                  label=None):
         """One host heartbeat.  Returns the membership view, or an
         ``{"error": ...}`` dict when the host's epoch is stale (the fence:
-        it must not be allowed to keep participating)."""
+        it must not be allowed to keep participating).  ``label`` is an
+        optional human name carried into the view (the serving fleet
+        beats by registry rank but reports by host id)."""
         with self._cond:
             fence = self._fence(rank, epoch, "heartbeat")
             if fence is not None:
@@ -97,6 +101,8 @@ class MembershipTable:
                 rec.step = int(step)
             if step_time is not None:
                 rec.ewma = float(step_time)
+            if label is not None:
+                rec.label = str(label)
             self._cond.notify_all()
             return {"ok": True, "view": self._view_locked()}
 
@@ -135,7 +141,9 @@ class MembershipTable:
                 "age": ages,
                 "steps": {r: self._hosts[r].step for r in self._hosts},
                 "ewma": {r: self._hosts[r].ewma for r in self._hosts
-                         if self._hosts[r].ewma is not None}}
+                         if self._hosts[r].ewma is not None},
+                "labels": {r: self._hosts[r].label for r in self._hosts
+                           if self._hosts[r].label is not None}}
 
     # -- shrink barrier -------------------------------------------------------
     def propose_shrink(self, rank, epoch, deadline_s, on_commit=None):

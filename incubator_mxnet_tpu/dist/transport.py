@@ -36,6 +36,24 @@ def _hmac_key():
     return k.encode() if k else None
 
 
+def parse_endpoint(spec, default_host="127.0.0.1"):
+    """``"host:port"`` / ``":port"`` / ``"port"`` -> ``(host, port)``.
+
+    The one parser for remote endpoints handed to the serving fleet
+    (host registries name hostd agents by endpoint) and any CLI taking
+    a peer address — so every front end accepts the same spellings."""
+    text = str(spec).strip()
+    host, sep, port = text.rpartition(":")
+    if not sep:
+        host, port = "", text
+    host = host or default_host
+    try:
+        return host, int(port)
+    except ValueError:
+        raise ValueError(f"invalid endpoint {spec!r} (want host:port)") \
+            from None
+
+
 def send_msg(sock: socket.socket, obj) -> None:
     buffers = []
     payload = pickle.dumps(obj, protocol=5, buffer_callback=buffers.append)

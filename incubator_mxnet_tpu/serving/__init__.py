@@ -35,6 +35,15 @@ Layers:
   weight-swap with zero dropped requests and zero XLA compiles, and
   priority classes (interactive/batch/best_effort) that shed lowest
   first under overload.
+* `FleetManager` (fleet.py) over `FleetHost` handles + `serving.hostd`
+  host agents — the fleet layer: host-aware anti-affinity placement,
+  host liveness through the SAME `dist.membership` table the elastic
+  trainer uses (a dead HOST marks all its replicas dead at once and
+  backfills on survivors), and an SLO-driven autoscaler fed by the
+  router's admission est-wait signal (sustained breach spawns a
+  zero-compile warm replica, sustained idle retires one through the
+  drain path; hysteresis + cooldown + a min/max budget make it
+  flap-proof).
 
 Minimal server::
 
@@ -58,8 +67,11 @@ from .metrics import ServingMetrics, LatencyReservoir
 from .replica import (Replica, LocalReplica, RemoteReplica,
                       ReplicaLostError)
 from .router import ReplicaRouter, PRIORITIES
+from .fleet import (FleetManager, Autoscaler, ReplicaSpec, FleetHost,
+                    InProcessHost, AgentHost)
 
 __all__ = ["ServedModel", "MicroBatcher", "ModelServer", "ServingMetrics",
            "LatencyReservoir", "Replica", "LocalReplica", "RemoteReplica",
            "ReplicaLostError", "ReplicaRouter", "PRIORITIES",
-           "DEFAULT_BUCKETS"]
+           "DEFAULT_BUCKETS", "FleetManager", "Autoscaler", "ReplicaSpec",
+           "FleetHost", "InProcessHost", "AgentHost"]

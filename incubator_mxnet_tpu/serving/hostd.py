@@ -1,0 +1,194 @@
+"""Fleet host daemon: one agent process per serving host.
+
+``python -m incubator_mxnet_tpu.serving.hostd --host-id host-a`` prints
+``HOSTD_PORT <n>`` / ``HOSTD_READY`` on stdout and serves the fleet
+host protocol over the same length-prefixed transport frames as the
+parameter server and the replica workers:
+
+* ``hb``    — host liveness + load (live worker count, pid).  The
+  `FleetManager` feeds these beats into its `dist.membership` table;
+  silence past the deadline is host death.
+* ``spawn`` — launch one `serving.worker` ON THIS HOST from a
+  `ReplicaSpec` message (the worker binds this daemon's address, so
+  the router connects across the network, not to localhost) and wait
+  for its readiness handshake; the reply carries the worker's port and
+  its ``REPLICA_READY`` evidence (programs/compiles/disk_hits — the
+  fleet's zero-compile warm-spinup cert).
+* ``stop``  — kill every worker, then exit.  (Individual worker
+  lifecycle belongs to the worker's own control channel — the router's
+  drain/close path stops it directly and the daemon's heartbeat reap
+  collects the exit.)
+
+The daemon and its workers share one process group
+(`AgentHost.launch_local` starts it with ``start_new_session=True``),
+so SIGKILLing the group is a faithful whole-host power-off: daemon and
+workers die together, exactly the failure the fleet's membership
+deadline + backfill path exist to survive (`tools/run_chaos.py
+--fleet` drives that weapon).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socketserver
+import sys
+import threading
+
+from ..analysis import locks as _locks
+
+__all__ = ["HostDaemon", "main"]
+
+
+class HostDaemon:
+    """The serving loop around one host's worker population."""
+
+    def __init__(self, host_id, host="127.0.0.1", port=0):
+        self.host_id = str(host_id)
+        self.host = str(host)
+        self._lock = _locks.make_lock("serving.hostd")
+        self._workers = {}    # replica_id -> {"proc", "port", "ready"}
+        self._spawning = {}   # replica_id -> Event (first spawn running)
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                from ..dist.transport import recv_msg, send_msg
+                while True:
+                    try:
+                        msg = recv_msg(self.request)
+                    except (EOFError, ConnectionError, OSError):
+                        break
+                    try:
+                        reply = outer._handle(msg)
+                    except Exception as exc:
+                        reply = {"error": f"hostd dispatch failed: {exc}",
+                                 "seq": msg.get("seq")}
+                    try:
+                        send_msg(self.request, reply)
+                    except (ConnectionError, OSError):
+                        break
+                    if msg.get("cmd") == "stop":
+                        outer._kill_workers()
+                        os._exit(0)
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((self.host, int(port)), Handler)
+        self.port = self._server.server_address[1]
+
+    # -- command dispatch ----------------------------------------------------
+    def _reap_locked(self):
+        for rid in list(self._workers):
+            proc = self._workers[rid]["proc"]
+            if proc.poll() is not None:
+                del self._workers[rid]
+
+    def _handle(self, msg):
+        cmd = msg.get("cmd")
+        seq = msg.get("seq")
+        if cmd == "hb":
+            with self._lock:
+                self._reap_locked()
+                return {"ok": True, "host_id": self.host_id,
+                        "workers": len(self._workers),
+                        "pid": os.getpid(), "seq": seq}
+        if cmd == "spawn":
+            return dict(self._spawn(msg), seq=seq)
+        if cmd == "stop":
+            return {"ok": True, "seq": seq}
+        return {"error": f"hostd: unknown cmd {cmd!r}", "seq": seq}
+
+    def _worker_reply(self, rec):
+        return {"ok": True, "port": rec["port"], "ready": rec["ready"],
+                "pid": rec["proc"].pid}
+
+    def _spawn(self, msg):
+        from .fleet import ReplicaSpec
+        from .replica import launch_worker, worker_argv
+        spec = ReplicaSpec.from_msg(msg["spec"])
+        rid = msg.get("replica_id") or spec.name
+        # IDEMPOTENT by replica id, like the worker's rid dedup: a
+        # timed-out / lost reply makes the channel RESEND the spawn
+        # request on a fresh connection, and a second worker for the
+        # same rid would be an orphan nobody ever stops.  A live worker
+        # answers with ITS endpoint; a resend racing the first spawn
+        # waits for it instead of double-launching.
+        while True:
+            with self._lock:
+                self._reap_locked()
+                rec = self._workers.get(rid)
+                if rec is not None:
+                    return self._worker_reply(rec)
+                pending = self._spawning.get(rid)
+                if pending is None:
+                    self._spawning[rid] = threading.Event()
+                    break
+            pending.wait(600)
+        try:
+            # the worker binds THIS host's address so the router's
+            # channels cross the network — the 127.0.0.1 assumption
+            # dies here
+            cmd = worker_argv(prefix=spec.prefix, epoch=spec.epoch,
+                              symbol_file=spec.symbol_file,
+                              checkpoint_dir=spec.checkpoint_dir,
+                              data_shapes=spec.data_shapes,
+                              buckets=spec.buckets, name=spec.name,
+                              host=self.host)
+            proc, port, ready = launch_worker(cmd, env=spec.env,
+                                              name=spec.name, tag=rid)
+            with self._lock:
+                rec = self._workers[rid] = {"proc": proc, "port": port,
+                                            "ready": ready}
+        finally:
+            with self._lock:
+                ev = self._spawning.pop(rid, None)
+            if ev is not None:
+                ev.set()
+        return self._worker_reply(rec)
+
+    def _kill_workers(self):
+        with self._lock:
+            workers, self._workers = dict(self._workers), {}
+        for rec in workers.values():
+            try:
+                rec["proc"].kill()
+            except Exception:
+                pass
+
+    def serve_forever(self):
+        self._server.serve_forever(poll_interval=0.1)
+
+    def start(self):
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        daemon=True,
+                                        name="mx-hostd-server")
+        self._thread.start()
+        return self
+
+    def shutdown(self):
+        self._kill_workers()
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="serving.hostd", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--host-id", required=True,
+                    help="this host's fleet registry name")
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="address the daemon AND its workers bind")
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args(argv)
+    daemon = HostDaemon(args.host_id, host=args.host, port=args.port)
+    print("HOSTD_PORT %d" % daemon.port, flush=True)
+    print("HOSTD_READY host_id=%s" % daemon.host_id, flush=True)
+    daemon.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -45,7 +45,8 @@ import numpy as _np
 from ..analysis import locks as _locks
 from ..base import MXNetError
 
-__all__ = ["Replica", "LocalReplica", "RemoteReplica", "ReplicaLostError"]
+__all__ = ["Replica", "LocalReplica", "RemoteReplica", "ReplicaLostError",
+           "worker_argv", "launch_worker"]
 
 
 class ReplicaLostError(MXNetError):
@@ -133,6 +134,7 @@ class LocalReplica(Replica):
             max_queue_latency_ms=max_queue_latency_ms, max_queue=max_queue,
             **batcher_knobs)
         self._dead = False
+        self._last_reply_t = None   # when a response last resolved
 
     # -- request path --------------------------------------------------------
     def submit(self, inputs, timeout_ms=None, rid=None, priority=1):
@@ -155,6 +157,7 @@ class LocalReplica(Replica):
         out.request_id = rid
 
         def _chain(f, out=out, rid=rid):
+            self._last_reply_t = time.monotonic()
             try:
                 res = f.result()
             except MXNetError as exc:
@@ -213,9 +216,22 @@ class LocalReplica(Replica):
         """What a new request would wait here: the batcher's queue-model
         estimate, floored by the observed response-latency EWMA — the
         queue model alone is blind to host scheduling overhead, which
-        dominates exactly when the fleet is overloaded."""
+        dominates exactly when the fleet is overloaded.  On an EMPTY
+        replica the floor decays with the age of the last response: the
+        EWMA cannot decay on its own (it only updates on responses),
+        and holding it would wedge the fleet autoscaler's idle
+        detection forever after an overload burst."""
         est = self._batcher.estimated_wait_s()
         lat = self.metrics.avg_latency_s()
+        if lat is not None and self.outstanding() == 0:
+            # empty replica: decay the floor with the age of the last
+            # response (1s half-life, same as RemoteReplica) — an
+            # abrupt drop would collapse the fleet admission signal on
+            # momentary empty instants mid-flood, while no decay at
+            # all wedges idle detection forever
+            last = self._last_reply_t
+            age = 0.0 if last is None else time.monotonic() - last
+            lat = lat * 0.5 ** age
         if est is None:
             return lat
         return est if lat is None else max(est, lat)
@@ -241,6 +257,99 @@ class LocalReplica(Replica):
             pass
 
 
+def worker_argv(*, prefix=None, epoch=0, symbol_file=None,
+                checkpoint_dir=None, data_shapes, buckets=(1, 2, 4, 8),
+                name="model", host="127.0.0.1", port=0):
+    """The `serving.worker` command line for one replica — the single
+    place the worker CLI contract is spelled, shared by
+    `RemoteReplica.spawn` (local subprocess) and the fleet host daemon
+    (`serving.hostd`, spawning on ITS host)."""
+    shapes = ";".join("%s=%s" % (n, ",".join(str(d) for d in s))
+                      for n, s in data_shapes)
+    cmd = [sys.executable, "-m", "incubator_mxnet_tpu.serving.worker",
+           "--name", str(name), "--data-shapes", shapes,
+           "--buckets", ",".join(str(b) for b in buckets),
+           "--host", str(host), "--port", str(int(port))]
+    if prefix is not None:
+        cmd += ["--prefix", prefix, "--epoch", str(epoch)]
+    if symbol_file is not None:
+        cmd += ["--symbol-file", symbol_file]
+    if checkpoint_dir is not None:
+        cmd += ["--checkpoint-dir", checkpoint_dir]
+    return cmd
+
+
+def launch_worker(cmd, *, env=None, name="model", ready_timeout=240.0,
+                  launch=None, tag=None, port_prefix="REPLICA_PORT",
+                  ready_prefix="REPLICA_READY", start_new_session=False,
+                  thread_prefix="mx-replica"):
+    """Run one worker argv and wait for its readiness handshake.
+    Returns ``(proc, port, ready_info)`` where ``ready_info`` is the
+    parsed ``REPLICA_READY`` evidence (programs / compiles / disk_hits
+    — the zero-compile spin-up cert chaos, bench, and the fleet
+    autoscaler all read).  ``launch(cmd, env) -> Popen`` overrides the
+    default local `subprocess.Popen` (remote-exec hook).  The line
+    prefixes are parameters so the fleet host daemon's handshake
+    (``HOSTD_PORT`` / ``HOSTD_READY``) shares this one implementation;
+    ``start_new_session`` puts the child in its own process group (the
+    daemon + its workers die together under a group SIGKILL).
+
+    ``ready_timeout`` is enforced even when the child stays alive but
+    SILENT (wedged on a hung checkpoint read): a deadline timer kills
+    it, which unblocks the pipe read."""
+    full_env = dict(os.environ, **(env or {}))
+    if launch is not None:
+        proc = launch(cmd, full_env)
+    else:
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True,
+                                env=full_env,
+                                start_new_session=start_new_session)
+    port = None
+    ready_info = {}
+    timed_out = threading.Event()
+
+    def _deadline_kill():
+        timed_out.set()
+        proc.kill()
+
+    timer = threading.Timer(float(ready_timeout), _deadline_kill)
+    timer.daemon = True
+    timer.start()
+    try:
+        while True:
+            line = proc.stdout.readline()
+            if not line:
+                if timed_out.is_set():
+                    break
+                raise MXNetError(
+                    f"worker '{name}' exited during startup "
+                    f"(rc={proc.poll()})")
+            if line.startswith(port_prefix + " "):
+                port = int(line.split()[1])
+            elif line.startswith(ready_prefix):
+                # "REPLICA_READY programs=N compiles=K disk_hits=D":
+                # the zero-compile spin-up evidence (chaos/bench read it)
+                for tok in line.split()[1:]:
+                    k, _, v = tok.partition("=")
+                    if v.isdigit():
+                        ready_info[k] = int(v)
+                break
+    finally:
+        timer.cancel()
+    if port is None or timed_out.is_set():
+        proc.kill()
+        raise MXNetError(
+            f"worker '{name}' did not complete its readiness handshake "
+            f"within {ready_timeout:g}s")
+    # drain the pipe in the background or the worker blocks on a
+    # full stdout once it starts logging
+    threading.Thread(target=lambda: proc.stdout.read(),
+                     daemon=True,
+                     name=f"{thread_prefix}-{tag or name}-stdout").start()
+    return proc, port, ready_info
+
+
 class RemoteReplica(Replica):
     """Subprocess replica over the seq-numbered dist transport.
 
@@ -263,6 +372,7 @@ class RemoteReplica(Replica):
         self._inflight = {}          # rid -> _Pending (on the wire)
         self._lock = _locks.make_lock("serving.replica")
         self._ewma_s = None          # recent per-request round-trip
+        self._last_reply_t = None    # when the EWMA last saw a response
         self._chans = []
         self._threads = []
         # the control channel answers in microseconds or the worker is
@@ -295,56 +405,31 @@ class RemoteReplica(Replica):
     def spawn(cls, *, prefix=None, epoch=0, symbol_file=None,
               checkpoint_dir=None, data_shapes, buckets=(1, 2, 4, 8),
               name="model", replica_id=None, env=None, concurrency=2,
-              ready_timeout=240.0):
+              ready_timeout=240.0, host="127.0.0.1", launch=None):
         """Launch a `serving.worker` subprocess and connect to it.  The
         worker inherits ``MXNET_PROGRAM_CACHE_DIR`` (when set), so every
         replica after the first warms from the shared disk tier with
-        zero XLA compiles."""
-        shapes = ";".join("%s=%s" % (n, ",".join(str(d) for d in s))
-                          for n, s in data_shapes)
-        cmd = [sys.executable, "-m", "incubator_mxnet_tpu.serving.worker",
-               "--name", str(name), "--data-shapes", shapes,
-               "--buckets", ",".join(str(b) for b in buckets)]
-        if prefix is not None:
-            cmd += ["--prefix", prefix, "--epoch", str(epoch)]
-        if symbol_file is not None:
-            cmd += ["--symbol-file", symbol_file]
-        if checkpoint_dir is not None:
-            cmd += ["--checkpoint-dir", checkpoint_dir]
-        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                                stderr=subprocess.STDOUT, text=True,
-                                env=dict(os.environ, **(env or {})))
-        port = None
-        ready_info = {}
-        deadline = time.monotonic() + float(ready_timeout)
-        while time.monotonic() < deadline:
-            line = proc.stdout.readline()
-            if not line:
-                raise MXNetError(
-                    f"replica worker '{name}' exited during startup "
-                    f"(rc={proc.poll()})")
-            if line.startswith("REPLICA_PORT "):
-                port = int(line.split()[1])
-            elif line.startswith("REPLICA_READY"):
-                # "REPLICA_READY programs=N compiles=K disk_hits=D":
-                # the zero-compile spin-up evidence (chaos/bench read it)
-                for tok in line.split()[1:]:
-                    k, _, v = tok.partition("=")
-                    if v.isdigit():
-                        ready_info[k] = int(v)
-                break
-        if port is None:
-            proc.kill()
-            raise MXNetError(
-                f"replica worker '{name}' did not report a port within "
-                f"{ready_timeout:g}s")
-        # drain the pipe in the background or the worker blocks on a
-        # full stdout once it starts logging
-        threading.Thread(target=lambda: proc.stdout.read(),
-                         daemon=True,
-                         name=f"mx-replica-{replica_id or name}-stdout"
-                         ).start()
-        self = cls("127.0.0.1", port, replica_id=replica_id, process=proc,
+        zero XLA compiles.
+
+        ``host`` is the address the worker binds AND the address this
+        handle connects to (default localhost, so existing callers and
+        artifacts are unchanged).  ``launch`` is the launch-command hook
+        for remote execution: a callable ``launch(cmd, env) -> Popen``
+        (text mode, stdout piped) that runs the worker argv on the
+        target host — e.g. by prefixing an ssh invocation — instead of
+        the default local ``subprocess.Popen``.  Cross-host *fleets*
+        should prefer `serving.fleet.AgentHost`, which delegates the
+        spawn to a host daemon and reuses this module's launch helper
+        on the far side."""
+        cmd = worker_argv(prefix=prefix, epoch=epoch,
+                          symbol_file=symbol_file,
+                          checkpoint_dir=checkpoint_dir,
+                          data_shapes=data_shapes, buckets=buckets,
+                          name=name, host=host)
+        proc, port, ready_info = launch_worker(
+            cmd, env=env, name=name, ready_timeout=ready_timeout,
+            launch=launch, tag=replica_id or name)
+        self = cls(host, port, replica_id=replica_id, process=proc,
                    concurrency=concurrency)
         self.ready_info = ready_info
         return self
@@ -419,6 +504,7 @@ class RemoteReplica(Replica):
             rt = time.monotonic() - pend.t_enqueue
             self._ewma_s = rt if self._ewma_s is None \
                 else 0.8 * self._ewma_s + 0.2 * rt
+            self._last_reply_t = time.monotonic()
             try:
                 if "error" in reply:
                     pend.future.set_exception(MXNetError(reply["error"]))
@@ -498,7 +584,24 @@ class RemoteReplica(Replica):
     def estimated_wait_s(self):
         if self._ewma_s is None:
             return None
-        return self._ewma_s * (self.outstanding() + 1) / max(
+        outstanding = self.outstanding()
+        if outstanding == 0:
+            # same wedge as LocalReplica's EWMA floor: the round-trip
+            # EWMA is measured from enqueue (it INCLUDES queue wait)
+            # and only updates on responses, so on an EMPTY replica it
+            # is a memory of traffic that already ended and would hold
+            # a remembered overload forever, blocking the fleet
+            # autoscaler's idle detection.  The VIEW decays with the
+            # age of the last response (1s half-life, no mutation —
+            # a read-rate-dependent decay would collapse the shared
+            # measurement admission shedding floors on): a momentary
+            # empty instant mid-flood reads essentially the full
+            # floor, real silence reaches any idle threshold within
+            # seconds.
+            last = self._last_reply_t
+            age = 0.0 if last is None else time.monotonic() - last
+            return self._ewma_s * 0.5 ** age
+        return self._ewma_s * (outstanding + 1) / max(
             len(self._chans), 1)
 
     def stats(self):

@@ -228,6 +228,14 @@ class ReplicaRouter:
             return 0.0 if slots else None
         return min(waits)
 
+    def estimated_wait_s(self):
+        """The queue-model wait a NEW request faces on this fleet — the
+        same signal the admission controller sheds on.  The fleet
+        autoscaler (`serving.fleet.FleetManager`) reads this every tick
+        so scaling and shedding act on one number, never two estimates
+        that can disagree.  None when no replica is live."""
+        return self._fleet_wait_s()
+
     def submit(self, inputs, timeout_ms=None, priority="interactive",
                request_id=None):
         """Route one request; returns a Future resolving to the
@@ -405,6 +413,18 @@ class ReplicaRouter:
             pass   # caller cancelled it meanwhile
 
     # -- health ---------------------------------------------------------------
+    def declare_lost(self, replica_id):
+        """Externally declare one replica dead (the fleet layer's
+        host-loss path: a dead HOST kills every replica placed on it at
+        once, without waiting for each replica's own probe silence to
+        cross the liveness deadline).  In-flight requests fail over
+        immediately; unknown ids are ignored (the replica may already
+        have been removed)."""
+        with self._lock:
+            slot = self._slots.get(replica_id)
+        if slot is not None:
+            self._on_replica_lost(slot)
+
     def _on_replica_lost(self, slot):
         with self._lock:
             if slot.state == DEAD:

@@ -99,10 +99,17 @@ def _local_fleet(prefix, n, in_dim, buckets, latency_ms):
 
 
 def _ramp(router, in_dim, slo_ms, requests, max_level=64, kill_at_level=None,
-          kill_fn=None, priority="interactive"):
+          kill_fn=None, priority="interactive", miss_budget=None,
+          on_level=None):
     """Closed-loop concurrency ramp: double the client count until p99
     breaks the SLO (or the cap).  Returns the per-level list and the
-    max sustainable QPS (fastest level whose p99 met the SLO)."""
+    max sustainable QPS (fastest level whose p99 met the SLO).
+
+    ``miss_budget`` keeps the ramp alive through that many CONSECUTIVE
+    SLO misses instead of stopping at the knee — the autoscale lane
+    needs it, because a missed level is exactly when the fleet is
+    recruiting capacity and the next level is expected to recover.
+    ``on_level(entry)`` annotates each finished level (fleet size)."""
     x = np.random.default_rng(3).standard_normal(
         (1, in_dim)).astype(np.float32)
     levels = []
@@ -152,15 +159,20 @@ def _ramp(router, in_dim, slo_ms, requests, max_level=64, kill_at_level=None,
             "p99_ms": round(p99, 3) if p99 is not None else None,
             "met_slo": bool(p99 is not None and p99 <= slo_ms),
         }
+        if on_level is not None:
+            on_level(entry)
         levels.append(entry)
         if entry["met_slo"]:
             sustainable = max(sustainable or 0.0, entry["qps"])
             misses = 0
         else:
             misses += 1
+            if miss_budget is not None:
+                if misses >= miss_budget:
+                    break
             # past the knee — or never inside the SLO at all (a noisy
             # host): two straight misses end the ramp either way
-            if sustainable is not None or misses >= 2:
+            elif sustainable is not None or misses >= 2:
                 break
         level *= 2
     return levels, sustainable
@@ -327,6 +339,133 @@ def router_bench(prefix, in_dim, buckets, slo_ms, requests, n_replicas,
     return out
 
 
+class _PacedModel:
+    """Deterministic per-replica service rate for the autoscale lane:
+    delegates to the real `ServedModel` but holds every batch execution
+    for a fixed service time.  In-process replicas share the GIL and
+    the host's cores, so raw XLA throughput on a small CPU model cannot
+    separate 1 replica from N (the router lane's own numbers show it);
+    pacing makes per-replica CAPACITY the bottleneck, so this lane
+    measures what it claims to — the control loop recruiting and
+    retiring capacity against a queue — not container CPU noise."""
+
+    def __init__(self, model, service_s):
+        self._model = model
+        self._service_s = float(service_s)
+
+    def run_bucket(self, arrs, bucket):
+        time.sleep(self._service_s)
+        return self._model.run_bucket(arrs, bucket)
+
+    def __getattr__(self, name):
+        return getattr(self._model, name)
+
+
+def autoscale_bench(prefix, in_dim, buckets, slo_ms, requests, latency_ms,
+                    max_replicas=4, service_ms=10.0):
+    """The autoscale lane: max sustainable QPS under a concurrency ramp
+    with NO manual resizing.  A fixed 1-replica fleet and a
+    `FleetManager`-autoscaled fleet (floor 1, same SLO the ramp gates
+    on) face the same doubling ramp of paced replicas; the autoscaled
+    fleet must recruit its way to a higher sustainable QPS, then walk
+    back down to the floor once the traffic stops — without thrashing
+    on the way.
+
+    Two ramp passes, mirroring the degradation run's prime-then-measure
+    shape: a doubling ramp can outrun recruitment inside a single level
+    (capacity cannot double in one cooldown), so pass 1 is the
+    RECRUITMENT ramp — it rides through SLO misses on a miss budget
+    while the fleet scales — and pass 2 measures the settled fleet's
+    sustainable QPS.  Both passes' levels land in the artifact."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.serving import (FleetManager, InProcessHost,
+                                             ReplicaSpec)
+    lane_buckets = tuple(b for b in buckets if b <= 4) or (1, 2, 4)
+
+    def paced_replica(rid):
+        model = mx.serving.ServedModel.load(
+            prefix, 0, data_shapes=[("data", (1, in_dim))],
+            buckets=lane_buckets, name="bench")
+        return mx.serving.LocalReplica(
+            _PacedModel(model, service_ms / 1e3), replica_id=rid,
+            max_queue_latency_ms=latency_ms)
+
+    # the un-resized baseline: what one paced replica can sustain
+    router = mx.serving.ReplicaRouter([paced_replica("fixed-0")],
+                                      health_interval_s=0.5)
+    with router:
+        fixed_levels, fixed_qps = _ramp(router, in_dim, slo_ms, requests)
+
+    # two logical hosts so placement exercises anti-affinity; the
+    # actuation is in-process (every spinup shares the already-warm
+    # program registry, so recruiting is zero-compile by construction)
+    hosts = [InProcessHost("host-a", spawn=lambda spec, rid:
+                           paced_replica(rid)),
+             InProcessHost("host-b", spawn=lambda spec, rid:
+                           paced_replica(rid))]
+    spec = ReplicaSpec(data_shapes=[("data", (1, in_dim))], name="bench",
+                       buckets=lane_buckets)
+    # the idle threshold must sit ABOVE the paced service time: the
+    # est-wait signal is floored by the response-latency EWMA, so a
+    # threshold under the service floor could never see "idle"
+    fleet = FleetManager(
+        hosts, spec, name="bench-autoscale", target_replicas=1,
+        min_replicas=1, max_replicas=max_replicas, slo_ms=slo_ms,
+        tick_s=0.05, up_after_s=0.2, down_after_s=2.0, cooldown_s=0.6,
+        idle_fraction=max(0.1, 3.0 * service_ms / slo_ms),
+        host_heartbeat_s=0.5, host_deadline_s=30.0)
+
+    def on_level(entry):
+        entry["replicas"] = fleet.stats()["live_replicas"]
+
+    returned_to_floor = False
+    try:
+        recruit_levels, _ = _ramp(fleet.router, in_dim, slo_ms, requests,
+                                  miss_budget=3, on_level=on_level)
+        peak = fleet.stats()["live_replicas"]
+        levels, auto_qps = _ramp(fleet.router, in_dim, slo_ms, requests,
+                                 miss_budget=3, on_level=on_level)
+        # the ramp is over — the idle streak must retire the recruits
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            st = fleet.stats()
+            if st["live_replicas"] <= fleet.autoscaler.min_replicas:
+                returned_to_floor = True
+                break
+            time.sleep(0.2)
+        st = fleet.stats()
+    finally:
+        fleet.shutdown(drain=False)
+    events = st["scale_ups"] + st["scale_downs"]
+    ratio = (round(auto_qps / fixed_qps, 2)
+             if auto_qps and fixed_qps else None)
+    return {
+        "slo_ms": slo_ms,
+        "service_ms_per_batch": service_ms,
+        "buckets": list(lane_buckets),
+        "replica_budget": [1, max_replicas],
+        "fixed_1": {"levels": fixed_levels,
+                    "max_sustainable_qps": fixed_qps},
+        "recruitment": {"levels": recruit_levels,
+                        "peak_replicas": peak},
+        "autoscaled": {"levels": levels, "max_sustainable_qps": auto_qps},
+        "scale_ups": st["scale_ups"],
+        "scale_downs": st["scale_downs"],
+        "clamped_at_max": st["signal"]["clamped_at_max"],
+        "qps_ratio_vs_fixed_1": ratio,
+        "gates": {
+            # the acceptance bar: recruiting capacity must be worth
+            # >= 1.5x what the hand-pinned single replica sustains
+            "reaches_1_5x_fixed": bool(ratio is not None
+                                       and ratio >= 1.5),
+            "returned_to_floor": returned_to_floor,
+            # no thrash: a clean run is <= (max-1) ups and the
+            # matching downs; double that is the flap alarm
+            "bounded_scale_events": events <= 2 * max_replicas,
+        },
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--out", default=None,
@@ -425,6 +564,14 @@ def main(argv=None):
                 max(args.requests // 2, 8) if args.quick else args.requests,
                 args.replicas, args.latency_ms,
                 deg_concurrency=16 if args.quick else 64)
+            # the autoscale lane (ROADMAP item 5): the same ramp with
+            # NO manual resizing — a FleetManager recruits capacity off
+            # the admission est-wait signal and retires it afterwards
+            artifact["autoscale"] = autoscale_bench(
+                prefix, in_dim, buckets, args.slo_ms,
+                max(args.requests // 2, 8) if args.quick else args.requests,
+                args.latency_ms,
+                max_replicas=min(args.replicas + 1, 4))
 
     out = json.dumps(artifact, indent=1)
     if args.out:

@@ -175,6 +175,28 @@ def chaos_serving_stage():
         return {"error": f"chaos serving stage failed: {exc!r}"}
 
 
+def chaos_fleet_stage():
+    """Cross-host fleet stage: run tools/run_chaos.py --fleet in a
+    throwaway process — a 2-host fleet (real `serving.hostd` process
+    groups, two replicas each) under mixed-priority load with one host
+    SIGKILLed mid-ramp — and attach its CHAOS_FLEET artifact (zero
+    admitted interactive requests lost, interactive p99 inside its SLO
+    band while best-effort sheds first, the fleet backfilled to target
+    on the survivor, every backfill spinup certified zero-compile) to
+    the round.  Host-loss survival claims become checkable evidence
+    next to the parity outcomes."""
+    cmd = [sys.executable, os.path.join(REPO, "tools", "run_chaos.py"),
+           "--fleet", "--json", "--out", ""]
+    try:
+        out = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                             timeout=1800)
+        summary = json.loads(out.stdout)
+        summary["rc"] = out.returncode
+        return summary
+    except Exception as exc:
+        return {"error": f"chaos fleet stage failed: {exc!r}"}
+
+
 def chaos_train_stage():
     """Training-guardian stage: run tools/run_chaos.py --train in a
     throwaway process — an injected non-finite gradient (in-graph
@@ -324,6 +346,7 @@ def main():
         "chaos": chaos_stage(),
         "chaos_pod": chaos_pod_stage(),
         "chaos_serving": chaos_serving_stage(),
+        "chaos_fleet": chaos_fleet_stage(),
         "chaos_train": chaos_train_stage(),
         "coldstart": coldstart_stage(),
         "scaling": scaling_stage(),
