@@ -22,7 +22,17 @@ Two front ends over one Finding/Report currency (findings.py):
   - tsan.py + locks.py: the MXNET_TSAN=1 concurrency sanitizer — lock-
     order deadlock detection over the `analysis.locks` shims, lockset
     race attribution on registered shared state, blocking-call and
-    thread-lifecycle audits (rendered by `mxlint --tsan-report`).
+    thread-lifecycle audits (rendered by `mxlint --tsan-report`);
+  - cost.py + budgets.py: mxcost — static per-program FLOPs/bytes/
+    roofline against a device profile, dtype-flow defect chains
+    (dequantize -> fp32 dot), collective enumeration via the shared
+    kvstore bucket plan, liveness/peak-HBM + donation opportunities,
+    hidden host-transfer detection; `mxlint --cost-report` gates the
+    numbers against the committed COST_BUDGETS.json baseline.
+
+Every finding code registers once in `findings.CODE_TABLE`
+(code -> default severity -> one-line doc) — the stable `--json` key
+contract.
 
 Runtime passes activate with ``MXNET_ANALYSIS=1`` (or
 `analysis.enable()`); collected findings are read via
@@ -33,10 +43,12 @@ path.
 from __future__ import annotations
 
 __all__ = ["check", "check_json", "check_source", "check_source_file",
-           "enable", "disable", "enabled", "runtime_report",
-           "reset_runtime", "Finding", "Report"]
+           "check_cost", "enable", "disable", "enabled",
+           "runtime_report", "reset_runtime", "Finding", "Report",
+           "CODE_TABLE", "registered_codes"]
 
-from .findings import Finding, Report, ERROR, WARN, HINT  # noqa: F401
+from .findings import (Finding, Report, ERROR, WARN, HINT,  # noqa: F401
+                       CODE_TABLE, registered_codes)
 from . import donation  # noqa: F401
 from . import hostsync  # noqa: F401
 from . import recompile  # noqa: F401
@@ -87,6 +99,16 @@ def check_source(text, filename="<string>"):
 def check_source_file(path):
     from . import source_lint
     return source_lint.scan_file(path)
+
+
+def check_cost(symbol, shapes=None, dtypes=None, profile=None,
+               target=None):
+    """Run the mxcost static analyzer over a Symbol -> ProgramCost
+    (its ``.report`` is an ordinary findings Report; see cost.py for
+    the jaxpr/collective entry points)."""
+    from . import cost
+    return cost.analyze_symbol(symbol, shapes=shapes, dtypes=dtypes,
+                               profile=profile, target=target)
 
 
 def runtime_report():

@@ -8,7 +8,8 @@ analyses compose because their only output is a report.
 """
 from __future__ import annotations
 
-__all__ = ["Finding", "Report", "ERROR", "WARN", "HINT"]
+__all__ = ["Finding", "Report", "ERROR", "WARN", "HINT", "CODE_TABLE",
+           "registered_codes", "code_info", "severity_rank"]
 
 # severity ladder: errors break runs, warnings are correctness hazards,
 # hints are perf advisories (padded-tile waste etc.) that a clean example
@@ -18,6 +19,173 @@ WARN = "warn"
 HINT = "hint"
 
 _SEV_RANK = {ERROR: 0, WARN: 1, HINT: 2}
+
+
+def severity_rank(severity):
+    """Lower rank = more severe (ERROR=0 < WARN=1 < HINT=2); the CLI's
+    ``--fail-on`` threshold compares on this."""
+    return _SEV_RANK[severity]
+
+
+# ---------------------------------------------------------------------------
+# THE finding-code registry: every code any pass emits, in one table, so
+# `--json` output keys are a stable contract and docs/tests have a single
+# source of truth.  One entry per code: (default severity, emitting
+# pass names, one-line doc).  A code emitted by several subsystems
+# (e.g. 'summary', 'host-lost') lists every pass; `duplicate-name` may
+# escalate to ERROR at the emission site — the table records the
+# DEFAULT.  tests/test_analysis.py asserts the table has no duplicate
+# entries and no orphans (a table code no pass emits, or an emitted
+# code the table misses).
+# ---------------------------------------------------------------------------
+
+def _build_code_table(rows):
+    table = {}
+    for code, severity, passes, doc in rows:
+        if code in table:
+            raise ValueError(f"finding code {code!r} registered twice")
+        table[code] = (severity, tuple(passes), doc)
+    return table
+
+
+CODE_TABLE = _build_code_table([
+    # -- graph passes (graph_passes.py) --------------------------------------
+    ("duplicate-name", ERROR, ("graph.names",),
+     "two distinct nodes share a name; bind/arg_dict silently shadow one"),
+    ("empty-name", ERROR, ("graph.names",),
+     "node has an empty name and cannot be addressed"),
+    ("bad-json", ERROR, ("graph.names",),
+     "file is not a loadable symbol JSON"),
+    ("unloadable", ERROR, ("graph.names",),
+     "symbol JSON parses but does not load; only structural passes ran"),
+    ("dead-output", WARN, ("graph.dead",),
+     "multi-output op output computed, shipped through XLA, never used"),
+    ("unreachable-node", WARN, ("graph.dead", "graph.aux"),
+     "saved-JSON node unreachable from any head (dead compute/state)"),
+    ("shared-aux", WARN, ("graph.aux",),
+     "one running-stat variable feeds several ops' aux slots (racing)"),
+    ("aux-as-input", WARN, ("graph.aux",),
+     "aux state also consumed as a regular input (updated under reader)"),
+    ("f64-promotion", WARN, ("graph.dtype",),
+     "float64 introduced; TPUs have no f64 ALU (emulation or demotion)"),
+    ("f64-output", WARN, ("graph.dtype",),
+     "the f64 promotion reaches graph outputs; consumers inherit it"),
+    ("unbound-input", WARN, ("graph.unbound",),
+     "variable shape not inferable from inputs/attrs; bind fails there"),
+    ("tpu-layout", HINT, ("graph.layout",),
+     "feature dim off the 8/128 tile grid pads the MXU tile"),
+    # -- script AST lints (source_lint.py) -----------------------------------
+    ("syntax-error", WARN, ("source.parse",),
+     "script does not parse; nothing else was checked"),
+    ("host-sync-in-loop", WARN, ("source.hostsync", "trace.hostsync"),
+     "blocking host read inside a hot loop (asnumpy/asscalar/waitall)"),
+    ("kvstore-local-on-tpu", WARN, ("source.kvstore",),
+     "kvstore='local' in a TPU script reduces gradients through host"),
+    ("unbucketed-push", WARN, ("source.kvstore",),
+     "per-parameter kv.push/pull in a loop; batch the full key list"),
+    ("unbounded-retry", WARN, ("source.retry",),
+     "while-True retry with no deadline/raise spins on a dead peer"),
+    ("bare-except", WARN, ("source.except",),
+     "bare/blanket except swallows MXNetError incl. failover signals"),
+    ("nan-swallow", WARN, ("source.guardian",),
+     "hand-rolled NaN tolerance around a training update; use the "
+     "guardian"),
+    ("unsupervised-collective", WARN, ("source.supervisor",),
+     "host-level collective outside a supervisor/watchdog scope"),
+    ("router-bypass", WARN, ("source.router",),
+     "direct ServedModel/ModelServer use bypasses the configured router"),
+    ("fixed-fleet", WARN, ("source.fleet",),
+     "hand-pinned replica list in an autoscaler-configured script"),
+    ("host-transfer-in-graph", WARN, ("source.hostsync",),
+     "np coercion / device_get inside a jit-decorated function stalls "
+     "the device pipeline every call"),
+    ("unnamed-thread", WARN, ("source.thread",),
+     "Thread() without name=; findings/trace events attribute by name"),
+    ("bare-acquire", WARN, ("source.locks",),
+     "statement-level lock.acquire() leaks the lock on exceptions"),
+    ("sleep-under-lock", WARN, ("source.locks",),
+     "time.sleep inside a lock scope parks every queued thread"),
+    ("unjoined-thread-in-init", WARN, ("source.thread",),
+     "class starts a Thread but registers no lifecycle method"),
+    # -- runtime trace passes ------------------------------------------------
+    ("shape-churn", WARN, ("trace.recompile",),
+     "new jit signature forced a fresh XLA compile (ragged batches etc.)"),
+    # -- mxtsan concurrency sanitizer (tsan.py) ------------------------------
+    ("lock-order-inversion", ERROR, ("tsan.lockorder",),
+     "two locks acquired in both orders by different threads"),
+    ("lock-order-cycle", ERROR, ("tsan.lockorder",),
+     "cycle in the lock-acquisition-order graph (deadlockable)"),
+    ("shared-state-race", WARN, ("tsan.race",),
+     "unsynchronized write on registered shared state (lockset empty)"),
+    ("blocking-under-lock", WARN, ("tsan.blocking",),
+     "blocking call while holding a contended lock"),
+    ("leaked-thread", WARN, ("tsan.lifecycle",),
+     "non-daemon thread never joined; wedges interpreter shutdown"),
+    ("thread-outlives-close", WARN, ("tsan.lifecycle",),
+     "thread still alive after its owner's close() returned"),
+    ("join-no-timeout", WARN, ("tsan.lifecycle",),
+     "join() without timeout in package code blocks shutdown forever"),
+    # -- program cache / kvstore / resilience / fleet summaries --------------
+    ("summary", HINT, ("cache.programs", "kvstore.buckets",
+                       "serving.fleet"),
+     "per-subsystem runtime summary (cache traffic, bucket economy, "
+     "fleet scale events)"),
+    ("churn-compiles", WARN, ("cache.programs",),
+     "one program compiled under several signatures (shape churn cost)"),
+    ("skip-batch", WARN, ("guardian.skip",),
+     "guardian refused a non-finite step in-graph; batch quarantined"),
+    ("rollback", WARN, ("guardian.rollback",),
+     "loss spike rolled training back to the newest healthy checkpoint"),
+    ("spike-unrecoverable", WARN, ("guardian.spike",),
+     "loss spike with no checkpoint_dir to roll back to"),
+    ("host-lost", WARN, ("supervisor.host", "serving.fleet"),
+     "a pod/fleet host stopped heartbeating and was declared dead"),
+    ("straggler-host", WARN, ("supervisor.straggler",),
+     "host step-time EWMA diverges k-sigma from the pod median"),
+    ("backfill", WARN, ("serving.fleet",),
+     "fleet backfilled to target after capacity loss"),
+    ("cold-spinup", WARN, ("serving.fleet",),
+     "scale-up compiled XLA programs; warm spinup should be zero-compile"),
+    # -- mxcost static cost analysis (cost.py / budgets.py) ------------------
+    ("cost-summary", HINT, ("cost.roofline",),
+     "per-program flops/bytes/AI, roofline bound, step lower bound, "
+     "peak HBM"),
+    ("dequant-fp32-dot", WARN, ("cost.dtype",),
+     "dequantized values reach a dot computing in fp32 (the "
+     "int8-slower-than-fp32 static signature)"),
+    ("quantized-fp32-compute", WARN, ("cost.dtype",),
+     "quantized dot-class op registers float32 compute (no int8 MXU "
+     "rate)"),
+    ("f32-upcast-in-bf16", WARN, ("cost.dtype",),
+     "bf16->f32 upcast feeds an fp32 dot inside a bf16-dominant graph"),
+    ("hidden-host-transfer", WARN, ("cost.host",),
+     "callback primitive inside a traced program crosses to the host "
+     "every step"),
+    ("donation-opportunity", HINT, ("cost.memory",),
+     "step-boundary buffer dies undonated; donation would reuse it "
+     "in place"),
+    ("collective-summary", HINT, ("cost.collectives",),
+     "statically derived collectives/bytes per step for a mesh plan"),
+    ("collective-o-params", WARN, ("cost.collectives",),
+     "plan dispatches one collective per parameter (bucket economy "
+     "broken)"),
+    ("budget-regression", ERROR, ("cost.budget",),
+     "metric exceeds the committed COST_BUDGETS baseline (CI fails)"),
+    ("budget-missing", HINT, ("cost.budget",),
+     "program/plan has no baseline entry; snapshot it"),
+    ("budget-slack", HINT, ("cost.budget",),
+     "metric is well under budget; re-snapshot to tighten the gate"),
+])
+
+
+def registered_codes():
+    """{code: (default severity, passes, doc)} — a copy of the table."""
+    return dict(CODE_TABLE)
+
+
+def code_info(code):
+    """(default severity, passes, doc) for a registered code, or None."""
+    return CODE_TABLE.get(code)
 
 
 class Finding:

@@ -35,8 +35,9 @@ from .findings import Finding, Report, ERROR, WARN, HINT
 __all__ = ["check", "check_json", "PASS_CATALOG"]
 
 PASS_CATALOG = {
-    "graph.names": ("duplicate-name", "empty-name"),
-    "graph.dead": ("dead-output",),
+    "graph.names": ("duplicate-name", "empty-name", "bad-json",
+                    "unloadable"),
+    "graph.dead": ("dead-output", "unreachable-node"),
     "graph.aux": ("shared-aux", "aux-as-input", "unreachable-node"),
     "graph.dtype": ("f64-promotion", "f64-output"),
     "graph.unbound": ("unbound-input",),
@@ -269,30 +270,43 @@ def _pass_layout(symbol, topo):
 # best-effort abstract evaluation (shape+dtype), partial-tolerant
 # ---------------------------------------------------------------------------
 
-def _abstract_env(symbol, shapes):
+def _abstract_env(symbol, shapes, dtypes=None):
     """{id(node): tuple(ShapeDtypeStruct|None)} walking topo order; a node
     whose inputs cannot be resolved gets None (partial inference — the
     passes that consume the env skip unknowns).  Variables seed from the
     provided `shapes`, then ``__shape__`` attrs; declared ``__dtype__``
-    attrs carry real dtypes so f64 propagation is visible."""
+    attrs carry real dtypes so f64 propagation is visible, and the
+    optional `dtypes` map ({var_name: dtype}) overrides both — a
+    quantized model's int8 weights live in its params dict, not its
+    variable attrs, and the cost analyzer feeds them through here."""
     import jax
     from ..symbol.symbol import _solve_param_shapes
 
     shapes = dict(shapes or {})
+    dtypes = dict(dtypes or {})
     topo = symbol._topo()
     env = {}
 
     def var_aval(node):
         cand = None
         if node.name in shapes and shapes[node.name]:
-            cand = tuple(shapes[node.name])
+            cand = shapes[node.name]
         elif "__shape__" in node._extra_attrs:
-            cand = tuple(node._extra_attrs["__shape__"])
+            cand = node._extra_attrs["__shape__"]
+        if isinstance(cand, str):
+            # saved JSON stringifies attrs: "(4, 8)" -> (4, 8)
+            import ast as _ast
+            try:
+                cand = _ast.literal_eval(cand)
+            except (ValueError, SyntaxError):
+                cand = None
+        cand = tuple(cand) if cand is not None else None
         if cand is None or not all(isinstance(d, int) and d > 0
                                    for d in cand):
             return None
         dt = _np.float32
-        declared = node._extra_attrs.get("__dtype__")
+        declared = dtypes.get(node.name,
+                              node._extra_attrs.get("__dtype__"))
         if declared is not None:
             try:
                 dt = np_dtype(declared)

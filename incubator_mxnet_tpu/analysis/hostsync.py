@@ -22,7 +22,10 @@ import threading
 from .findings import Finding, WARN
 from . import locks as _locks
 
-__all__ = ["hot_loop", "note", "findings", "reset", "active"]
+__all__ = ["hot_loop", "note", "findings", "reset", "active", "CODES"]
+
+# every code this pass emits (the findings.CODE_TABLE cross-check)
+CODES = ("host-sync-in-loop",)
 
 # modules whose frames are the sync MECHANISM, not its cause: attribution
 # walks past them to the first caller outside the package data plane

@@ -16,7 +16,11 @@ from __future__ import annotations
 from .findings import Finding, WARN
 from . import locks as _locks
 
-__all__ = ["note", "register", "findings", "signatures", "reset"]
+__all__ = ["note", "register", "findings", "signatures", "reset",
+           "CODES"]
+
+# every code this auditor emits (the findings.CODE_TABLE cross-check)
+CODES = ("shape-churn",)
 
 _lock = _locks.make_lock("analysis.recompile")
 _seen = {}       # key -> list of signatures in first-seen order

@@ -59,6 +59,14 @@ walking a script's AST:
   collective per key (the classic pod-scale throughput killer).  Pass
   the whole key list in one call (``kv.push(names, grads)``), or
   stream with ``begin_push``/``push_part``/``end_push``.
+* ``host-transfer-in-graph`` — a host coercion (`.asnumpy()` /
+  `.asscalar()` / `.item()` / `np.asarray` / `np.array` /
+  `jax.device_get`) lexically inside a jit/pjit/shard_map-decorated
+  function: the traced program either fails to trace or (via a
+  callback) crosses to the host on EVERY step — the mxcost jaxpr pass
+  (`hidden-host-transfer`) is the runtime-graph side of the same
+  hazard.  Move the computation in-graph or hoist the read out of the
+  traced region.
 * ``unsupervised-collective`` — a host-level cross-host collective
   dispatch (`collectives.all_reduce` / `all_gather` / `reduce_scatter` /
   `ppermute` / a collective plane's `allreduce`) outside a supervisor/
@@ -134,6 +142,7 @@ def _supervised_name(ident):
 _DISABLE_RE = re.compile(r"#\s*mxlint:\s*disable(?:=([\w\-, ]+))?")
 
 _PASS_BY_CODE = {"host-sync-in-loop": "source.hostsync",
+                 "host-transfer-in-graph": "source.hostsync",
                  "kvstore-local-on-tpu": "source.kvstore",
                  "unbucketed-push": "source.kvstore",
                  "unbounded-retry": "source.retry",
@@ -345,6 +354,23 @@ class _Visitor(ast.NodeVisitor):
 
     visit_FunctionDef = visit_AsyncFunctionDef = visit_Lambda = _fresh_scope
 
+    @classmethod
+    def _constant_expr(cls, node):
+        """Literal (or container/unary-minus of literals): a value that
+        exists at trace time, not per step."""
+        if isinstance(node, ast.Constant):
+            return True
+        if isinstance(node, (ast.List, ast.Tuple)):
+            return all(cls._constant_expr(e) for e in node.elts)
+        if isinstance(node, ast.UnaryOp):
+            return cls._constant_expr(node.operand)
+        # dtype mentions (np.float32 etc.) are constants too
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id in ("np", "numpy", "onp", "jnp"):
+            return True
+        return False
+
     @staticmethod
     def _idents(node):
         """Every Name/Attribute identifier inside `node` (decorator or
@@ -454,6 +480,30 @@ class _Visitor(ast.NodeVisitor):
             self._add("host-sync-in-loop", node.lineno,
                       f"{name}() inside a loop drains ALL in-flight work "
                       "every iteration")
+        # -- host coercion inside a traced (jit/pjit/shard_map) function -----
+        if self.device_depth > 0:
+            what = None
+            if isinstance(func, ast.Attribute) and \
+                    name in ("asnumpy", "asscalar", "item",
+                             "device_get"):
+                what = f".{name}()"
+            elif isinstance(func, ast.Attribute) and \
+                    name in ("asarray", "array") and \
+                    isinstance(func.value, ast.Name) and \
+                    func.value.id in ("np", "numpy", "onp") and \
+                    not all(self._constant_expr(a) for a in node.args):
+                # np.array(<literal>) is a trace-time constant baked
+                # into the program — only DYNAMIC values cross to host
+                what = f"{func.value.id}.{name}()"
+            if what:
+                self._add(
+                    "host-transfer-in-graph", node.lineno,
+                    f"{what} inside a jit/shard_map-decorated function: "
+                    "the traced program either fails to trace or "
+                    "crosses to the host on every step (mxcost flags "
+                    "the jaxpr side as hidden-host-transfer) — compute "
+                    "in-graph or hoist the read out of the traced "
+                    "region")
         if name in ("push", "pull") and self.loop_depth > 0 and \
                 isinstance(func, ast.Attribute) and node.args:
             recv_ids = self._idents(func.value)

@@ -79,7 +79,12 @@ from .findings import Finding, Report, ERROR, WARN
 __all__ = ["enabled", "enable", "disable", "findings", "report", "reset",
            "dump", "lock_graph", "instrument", "shared_dict",
            "note_blocking", "join_thread", "TsanLock", "TsanRLock",
-           "make_condition"]
+           "make_condition", "CODES"]
+
+# every code this sanitizer emits (the findings.CODE_TABLE cross-check)
+CODES = ("lock-order-inversion", "lock-order-cycle", "shared-state-race",
+         "blocking-under-lock", "leaked-thread", "thread-outlives-close",
+         "join-no-timeout")
 
 _PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _REPO_DIR = os.path.dirname(_PKG_DIR)

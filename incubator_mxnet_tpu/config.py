@@ -155,6 +155,15 @@ KNOBS = {
                    "XLA lowers the gradient exchange to reduce-scatter, "
                    "updates only the local shard, and all-gathers the "
                    "new weights (per-device optimizer memory 1/N)"),
+    # -- mxcost static cost analysis (analysis/cost.py) ----------------------
+    "MXNET_COST_PROFILE": (str, "tpu-v3", "honored",
+                           "device profile the mxcost roofline "
+                           "classifies against (analysis/cost.py "
+                           "PROFILES: tpu-v3, tpu-v4, cpu-host)"),
+    "MXNET_COST_DONATE_MIN_MB": (float, 1.0, "honored",
+                                 "minimum buffer size for a donation-"
+                                 "opportunity finding (step-boundary "
+                                 "buffers that die undonated)"),
     # -- resilience (this framework's own knobs) -----------------------------
     "MXNET_FAULTS": (str, "", "honored",
                      "resilience/faults.py: deterministic fault-injection "

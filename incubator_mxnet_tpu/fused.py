@@ -414,8 +414,10 @@ def _pod_bucket_psum(grads, axis, cap_bytes, extras=()):
     host-side async dispatch.  One extra psum carries the
     small per-shard partial sums (metric deltas, BN aux moments, the
     guardian's health bit).  Returns (summed grads, bucket plan, summed
-    extras).  The psum of per-shard gradients is the reference
-    kvstore's cross-device sum."""
+    extras, psum binds actually dispatched — the extras fold into the
+    first f32 bucket when one exists and otherwise cost one extra
+    bind).  The psum of per-shard gradients is the reference kvstore's
+    cross-device sum."""
     import jax
     import jax.numpy as jnp
     from .kvstore import plan_buckets
@@ -470,7 +472,30 @@ def _pod_bucket_psum(grads, axis, cap_bytes, extras=()):
             out[i] = jax.lax.dynamic_slice_in_dim(flat, off, n).reshape(
                 grads[i].shape)
             off += n
-    return out, plan, sextras
+    n_psums = len(plan) + (1 if (ex_flat and ex_host is None) else 0)
+    return out, plan, sextras, n_psums
+
+
+def predict_pod_plan(shapes, dtypes=None, cap_bytes=None, extras=True,
+                     dp=1):
+    """Static mirror of the pod fast path's in-graph bucket plan — the
+    plan-introspection hook mxcost uses: given the parameter shapes (and
+    dtypes) a fused step would exchange, derive the same plan
+    `_pod_bucket_psum` cuts (the shared `kvstore.plan_buckets` rule in
+    reversed parameter order) and the resulting collective economy.
+    ``extras=True`` models the bundled metric/aux/health payload, which
+    folds into the first f32 bucket when one exists and otherwise costs
+    one extra psum — exactly the trace-time behavior, so the returned
+    ``collectives_per_step``/``bytes_per_step`` match what
+    `FusedTrainStep.pod_stats` reports after tracing a step that
+    carries extras (metrics/aux/health — the normal fit path; pass
+    ``extras=False`` for a bare step)."""
+    from .analysis import cost as _cost
+    # cap_bytes=None resolves MXNET_KVSTORE_BUCKET_MB inside the
+    # enumerator — ONE cap-resolution rule, shared with the kvstore
+    return _cost.enumerate_collectives(
+        shapes, dtypes=dtypes, dp=dp, cap_bytes=cap_bytes, extras=extras,
+        name="pod-plan")
 
 
 def _one_step_jit(traced, label="", call_fn=None, key_tag=None):
@@ -1207,9 +1232,10 @@ class FusedTrainStep:
                         (o.astype(jnp.float32) for o in oks),
                         jnp.float32(0.0))
                     extras.append(bad)
-                grads, plan, sext = _pod_bucket_psum(
+                grads, plan, sext, n_psums = _pod_bucket_psum(
                     grads, pod_axis, pod_cap, extras)
                 self._pod_plan = plan
+                self._pod_psums = n_psums
                 pod_deltas = [(sext[2 * j], sext[2 * j + 1])
                               for j in range(n_metric)]
                 # aux updates (BN moments) are averaged across shards —
@@ -1685,7 +1711,11 @@ class FusedTrainStep:
                             "axis": self._pod_axis, "dp": self._dp_size,
                             "params": len(self._param_names),
                             "buckets": len(plan),
-                            "collectives_per_step": len(plan),
+                            # binds actually dispatched: the extras
+                            # psum costs one extra when no f32 bucket
+                            # existed to fold it into
+                            "collectives_per_step": getattr(
+                                self, "_pod_psums", len(plan)),
                             "bytes_per_step": nbytes,
                         }
                         from . import profiler as _profiler

@@ -436,6 +436,34 @@ class KVStoreTPU(KVStore):
             else dict(self._compression),
         }
 
+    def predicted_stats(self, shapes, dtypes=None, ndev=None):
+        """Static mirror of one batched push's `stats()` counters —
+        the plan-introspection hook the mxcost analyzer cross-checks
+        against measured numbers: given the key shapes (and dtypes) a
+        batched push would carry, derive the bucket plan with the SAME
+        `plan_buckets` rule and priority order the scheduler uses and
+        return the predicted allreduce dispatches / bytes reduced /
+        bucket count.  `analysis.cost.enumerate_collectives` does the
+        arithmetic; this method just binds this store's live bucket cap
+        and device count to it."""
+        from .analysis import cost as _cost
+        if ndev is None:
+            import jax
+            ndev = len(jax.devices())
+        stats = _cost.enumerate_collectives(
+            shapes, dtypes=dtypes, dp=ndev,
+            cap_bytes=self._bucket_cap_bytes,
+            name=f"kvstore-{self._kind}")
+        return {
+            "type": self._kind,
+            "allreduce_dispatches": stats["collectives_per_step"],
+            "bytes_reduced": stats["bytes_per_step"],
+            "buckets": stats["buckets"],
+            "bucket_cap_mb": stats["bucket_cap_mb"],
+            "dispatch_complexity": stats["dispatch_complexity"],
+            "plan": stats["plan"],
+        }
+
     def _mesh_for(self, devices):
         ids = tuple(d.id for d in devices)
         mesh = self._meshes.get(ids)

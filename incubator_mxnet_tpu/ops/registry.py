@@ -71,13 +71,13 @@ class OpDef:
     __slots__ = ("name", "fn", "nin", "nout", "naux", "params", "param_types",
                  "needs_rng", "mode_dependent", "stop_grad", "aliases",
                  "variadic_param", "dynamic_params", "input_names", "doc",
-                 "cache_key")
+                 "cache_key", "cost_meta")
 
     def __init__(self, name, fn, nin=1, nout=1, naux=0, params=None,
                  param_types=None, needs_rng=False, mode_dependent=False,
                  stop_grad=False, aliases=(), variadic_param=None,
                  dynamic_params=(), input_names=None, doc=None,
-                 cache_key=None):
+                 cache_key=None, cost_meta=None):
         self.name = name
         self.fn = fn
         self.nin = nin
@@ -106,6 +106,15 @@ class OpDef:
         # primitive ops) keeps the plain per-(op, params) jit — tiny
         # programs that are not worth a disk round trip.
         self.cache_key = cache_key
+        # cost_meta: static metadata for the mxcost analyzer
+        # (analysis/cost.py).  Keys: "flops" — fn(params, in_avals,
+        # out_avals) -> float overriding the analyzer's per-op-name
+        # rule; "compute_dtype" — the dtype the op's arithmetic ACTUALLY
+        # runs in, when it differs from what the graph dtypes suggest
+        # (the quantized ops declare "float32" here: that declaration IS
+        # the int8-slower-than-fp32 defect's static signature);
+        # "quantized" — marks an int8-family op for the dtype-flow pass.
+        self.cost_meta = dict(cost_meta) if cost_meta else None
 
     # -- parameter handling ---------------------------------------------------
     def canonicalize_params(self, kwargs):

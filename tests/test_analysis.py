@@ -631,3 +631,159 @@ def test_naive_engine_track_chains_contextful_error():
             os.environ.pop("MXNET_ENGINE_TYPE", None)
         else:
             os.environ["MXNET_ENGINE_TYPE"] = prev
+
+
+# ---------------------------------------------------------------------------
+# the unified finding-code registry (ISSUE-13 satellite)
+# ---------------------------------------------------------------------------
+
+def test_code_table_no_duplicates_and_no_orphans():
+    """Every code any pass emits registers exactly once in
+    findings.CODE_TABLE, and the table carries no code nothing emits."""
+    from incubator_mxnet_tpu.analysis import findings as F
+    from incubator_mxnet_tpu.analysis import (budgets, cost, graph_passes,
+                                              hostsync, recompile,
+                                              source_lint, tsan)
+
+    # duplicate registration is rejected at table build time
+    with pytest.raises(ValueError, match="registered twice"):
+        F._build_code_table([("x", F.WARN, ("p",), "d"),
+                             ("x", F.WARN, ("p",), "d")])
+
+    table = set(F.CODE_TABLE)
+    declared = set()
+    for codes in graph_passes.PASS_CATALOG.values():
+        declared.update(codes)
+    declared.update(source_lint._PASS_BY_CODE)
+    declared.add("syntax-error")
+    declared.update(tsan.CODES)
+    declared.update(recompile.CODES)
+    declared.update(hostsync.CODES)
+    declared.update(cost.CODES)
+    declared.update(budgets.CODES)
+    missing = declared - table
+    assert not missing, f"codes emitted but unregistered: {missing}"
+
+    # reverse orphan check: every registered code appears as a literal
+    # in the package source OUTSIDE the table itself (nothing in the
+    # table is dead — findings.py is excluded, else the check would be
+    # satisfied by the very registration it verifies)
+    pkg = os.path.join(REPO, "incubator_mxnet_tpu")
+    blob = []
+    for root, _dirs, files in os.walk(pkg):
+        if "__pycache__" in root:
+            continue
+        for fname in files:
+            if fname.endswith(".py") and fname != "findings.py":
+                with open(os.path.join(root, fname),
+                          encoding="utf-8") as f:
+                    blob.append(f.read())
+    blob = "\n".join(blob)
+    orphans = {code for code in table if f'"{code}"' not in blob}
+    assert not orphans, f"registered codes nothing emits: {orphans}"
+
+    # table hygiene: valid severities, one-line docs, named passes
+    for code, (severity, passes, doc) in F.CODE_TABLE.items():
+        assert severity in (F.ERROR, F.WARN, F.HINT), code
+        assert passes and all(p for p in passes), code
+        assert doc and "\n" not in doc, code
+
+
+# ---------------------------------------------------------------------------
+# source-lint suppression: EVERY registered code sweeps through an
+# inline `# mxlint: disable=<code>` fixture (ISSUE-13 satellite)
+# ---------------------------------------------------------------------------
+
+# code -> (fixture source, 1-based line the finding lands on)
+_SUPPRESSION_FIXTURES = {
+    "host-sync-in-loop": (
+        "for b in it:\n"
+        "    x.asnumpy()\n", 2),
+    "host-transfer-in-graph": (
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return np.asarray(x)\n", 5),
+    "kvstore-local-on-tpu": (
+        "import incubator_mxnet_tpu as mx\n"
+        "ctx = mx.tpu()\n"
+        "m.fit(it, kvstore='local')\n", 3),
+    "unbucketed-push": (
+        "for name in names:\n"
+        "    kv.push(name, grads[name])\n", 2),
+    "unbounded-retry": (
+        "while True:\n"
+        "    try:\n"
+        "        s.connect(addr)\n"
+        "    except OSError:\n"
+        "        pass\n", 1),
+    "bare-except": (
+        "try:\n"
+        "    f()\n"
+        "except:\n"
+        "    pass\n", 3),
+    "nan-swallow": (
+        "while True:\n"
+        "    try:\n"
+        "        trainer.step(1)\n"
+        "    except ValueError:\n"
+        "        continue\n", 4),
+    "unsupervised-collective": (
+        "kv.all_reduce(x)\n", 1),
+    "router-bypass": (
+        "r = ReplicaRouter(replicas)\n"
+        "srv = ModelServer()\n", 2),
+    "fixed-fleet": (
+        "r = ReplicaRouter([LocalReplica(), LocalReplica()])\n"
+        "m = FleetManager(r)\n", 1),
+    "unnamed-thread": (
+        "import threading\n"
+        "t = threading.Thread(target=f)\n", 2),
+    "bare-acquire": (
+        "lock.acquire()\n", 1),
+    "sleep-under-lock": (
+        "import time\n"
+        "with lock:\n"
+        "    time.sleep(1)\n", 3),
+    "unjoined-thread-in-init": (
+        "import threading\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        threading.Thread(target=f, name='x').start()\n", 4),
+}
+
+
+def test_every_source_lint_code_has_a_suppression_fixture():
+    """The sweep below covers the COMPLETE registered source-lint code
+    set (syntax-error aside: an unparseable file has no line to carry
+    the directive), so a new lint cannot land without a fixture."""
+    from incubator_mxnet_tpu.analysis.source_lint import _PASS_BY_CODE
+    assert set(_SUPPRESSION_FIXTURES) == set(_PASS_BY_CODE)
+
+
+@pytest.mark.parametrize("code", sorted(_SUPPRESSION_FIXTURES))
+def test_source_lint_inline_suppression_sweep(code):
+    source, lineno = _SUPPRESSION_FIXTURES[code]
+    report = analysis.check_source(source, filename="fix.py")
+    hits = [f for f in report if f.code == code]
+    assert hits, f"{code}: fixture did not trigger its lint"
+    assert any(f.location == f"fix.py:{lineno}" for f in hits), \
+        f"{code}: fired at {[f.location for f in hits]}, " \
+        f"fixture expects line {lineno}"
+
+    # the inline directive on the finding line silences EXACTLY it
+    lines = source.splitlines()
+    lines[lineno - 1] += f"  # mxlint: disable={code}"
+    suppressed = analysis.check_source("\n".join(lines) + "\n",
+                                       filename="fix.py")
+    assert not [f for f in suppressed if f.code == code], \
+        f"{code}: inline disable did not suppress"
+
+    # a disable naming a DIFFERENT code must not silence this one
+    lines = source.splitlines()
+    lines[lineno - 1] += "  # mxlint: disable=tpu-layout"
+    other = analysis.check_source("\n".join(lines) + "\n",
+                                  filename="fix.py")
+    assert [f for f in other if f.code == code], \
+        f"{code}: a foreign disable code suppressed it"

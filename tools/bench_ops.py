@@ -20,6 +20,15 @@ runs with `jax.block_until_ready` on every output; the artifact records
 mean/p50/min per op.  Compare two artifacts across commits to catch a
 kernel regression before the round bench does.
 
+Each op also records its **static mxcost estimate** (flops, bytes
+moved, the predicted roofline bound and step lower bound from
+`analysis/cost.py`) next to the measured time, so estimate drift is
+visible in the artifact itself: when a measured time moves and the
+static column does not, the kernel regressed; when both move, the
+graph changed.  The quantization section builds its models through
+`cost.build_bench_convnet` — the SAME graphs the mxcost budget
+baseline (COST_BUDGETS.json) gates.
+
 Usage:
     python tools/bench_ops.py [--iters 20] [--out BENCH_OPS.json] [--json]
 """
@@ -33,6 +42,35 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+
+def _static_of(prog):
+    """The artifact's static column from a mxcost ProgramCost."""
+    if prog is None:
+        return None
+    d = prog.as_dict()
+    return {"flops": d["flops"], "bytes_moved": d["bytes_moved"],
+            "predicted_bound": d["bound"],
+            "arithmetic_intensity": d["arithmetic_intensity"],
+            "step_time_lb_ms": d["step_time_lb_ms"],
+            "profile": d["profile"]}
+
+
+def _static_symbol(sym, shapes, dtypes=None, name=None):
+    from incubator_mxnet_tpu.analysis import cost
+    try:
+        return _static_of(cost.analyze_symbol(sym, shapes=shapes,
+                                              dtypes=dtypes, target=name))
+    except Exception:
+        return None
+
+
+def _static_callable(fn, avals, name=None):
+    from incubator_mxnet_tpu.analysis import cost
+    try:
+        return _static_of(cost.analyze_callable(fn, avals, name=name))
+    except Exception:
+        return None
 
 
 def _timeit(fn, iters, warmup=3):
@@ -71,15 +109,17 @@ def _sparse_ops(mx, nd, np):
             return w._data
         return run
 
+    # the lazy row-sparse update runs through the host-resident sparse
+    # path (see ndarray/sparse.py) — no traced program to cost statically
     return {
         "sparse.sgd_momentum_lazy": (
             bench("sgd", mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
                                           lazy_update=True)),
-            f"({V},{D}) table, {K} rows"),
+            f"({V},{D}) table, {K} rows", None),
         "sparse.adam_lazy": (
             bench("adam", mx.optimizer.Adam(learning_rate=0.001,
                                             lazy_update=True)),
-            f"({V},{D}) table, {K} rows"),
+            f"({V},{D}) table, {K} rows", None),
     }
 
 
@@ -122,23 +162,32 @@ def _control_flow_ops(mx, nd, np):
         return o[0]._data
 
     shape = f"T={T} batch={B} hidden={H}"
-    return {"control_flow.foreach_rnn_imperative": (run_imperative, shape),
-            "control_flow.foreach_rnn_symbolic": (run_symbolic, shape)}
+    from incubator_mxnet_tpu.analysis import cost as _mxcost
+    try:
+        # executor-level analysis costs the scan BODY x trip count
+        # (the symbol walk cannot see through the _foreach node)
+        static = _static_of(_mxcost.analyze_executor(
+            exe, name="control_flow.foreach_rnn"))
+    except Exception:
+        static = None
+    return {"control_flow.foreach_rnn_imperative": (run_imperative, shape,
+                                                    static),
+            "control_flow.foreach_rnn_symbolic": (run_symbolic, shape,
+                                                  static)}
 
 
 def _quantization_ops(mx, nd, np):
-    """INT8 convnet forward vs its fp32 reference executor."""
+    """INT8 convnet forward vs its fp32 reference executor.  The graphs
+    come from `analysis.cost.build_bench_convnet` — the SAME models the
+    mxcost budget baseline gates, so the measured and static columns
+    describe one program."""
+    from incubator_mxnet_tpu.analysis.cost import (build_bench_convnet,
+                                                   BENCH_SHAPE)
     from incubator_mxnet_tpu.contrib.quantization import quantize_model
     rng = np.random.RandomState(2)
-    data = mx.sym.Variable("data")
-    c = mx.sym.Convolution(data, kernel=(3, 3), num_filter=16, pad=(1, 1),
-                           name="conv0")
-    c = mx.sym.Activation(c, act_type="relu")
-    p = mx.sym.Pooling(c, kernel=(2, 2), stride=(2, 2), pool_type="max")
-    f = mx.sym.Flatten(p)
-    sym = mx.sym.FullyConnected(f, num_hidden=32, name="fc0")
+    sym, _shapes = build_bench_convnet("float32")
 
-    shape = (8, 3, 32, 32)
+    shape = BENCH_SHAPE
     arg_shapes, _, aux_shapes = sym.infer_shape(data=shape)
     args = {n: nd.array(rng.normal(0, 0.5, s).astype("f4"))
             for n, s in zip(sym.list_arguments(), arg_shapes)
@@ -161,8 +210,15 @@ def _quantization_ops(mx, nd, np):
         return qexe.forward(is_train=False, data=x)[0]._data
 
     s = "x".join(str(d) for d in shape)
-    return {"quantization.convnet_fp32": (run_fp32, s),
-            "quantization.convnet_int8": (run_int8, s)}
+    qdtypes = {n: str(a.dtype) for n, a in qargs.items()}
+    return {"quantization.convnet_fp32": (
+                run_fp32, s,
+                _static_symbol(sym, {"data": shape},
+                               name="quantization.convnet_fp32")),
+            "quantization.convnet_int8": (
+                run_int8, s,
+                _static_symbol(qsym, {"data": shape}, dtypes=qdtypes,
+                               name="quantization.convnet_int8"))}
 
 
 def _dense_ops(mx, nd, np):
@@ -175,13 +231,35 @@ def _dense_ops(mx, nd, np):
     wconv = nd.array(rng.randn(16, 16, 3, 3).astype("f4"))
     logits = nd.array(rng.randn(64, 1000).astype("f4"))
 
+    import jax
+    import jax.numpy as jnp
+
+    def _conv_ref(xv, wv):
+        return jax.lax.conv_general_dilated(
+            xv, wv, window_strides=(1, 1), padding=[(1, 1), (1, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+    f4 = np.float32
     return {
-        "dense.matmul_256": (lambda: nd.dot(a, b)._data, "256x256"),
+        "dense.matmul_256": (
+            lambda: nd.dot(a, b)._data, "256x256",
+            _static_callable(jnp.dot,
+                             [jax.ShapeDtypeStruct((256, 256), f4)] * 2,
+                             name="dense.matmul_256")),
         "dense.conv3x3": (
             lambda: nd.Convolution(x, wconv, no_bias=True, kernel=(3, 3),
                                    num_filter=16, pad=(1, 1))._data,
-            "8x16x32x32"),
-        "dense.softmax": (lambda: nd.softmax(logits)._data, "64x1000"),
+            "8x16x32x32",
+            _static_callable(
+                _conv_ref,
+                [jax.ShapeDtypeStruct((8, 16, 32, 32), f4),
+                 jax.ShapeDtypeStruct((16, 16, 3, 3), f4)],
+                name="dense.conv3x3")),
+        "dense.softmax": (
+            lambda: nd.softmax(logits)._data, "64x1000",
+            _static_callable(jax.nn.softmax,
+                             [jax.ShapeDtypeStruct((64, 1000), f4)],
+                             name="dense.softmax")),
     }
 
 
@@ -197,8 +275,9 @@ def run_battery(iters=20):
 
     results = {}
     for name in sorted(ops):
-        fn, shape = ops[name]
-        results[name] = dict(_timeit(fn, iters), shape=shape)
+        fn, shape, static = ops[name]
+        results[name] = dict(_timeit(fn, iters), shape=shape,
+                             static=static)
     return results
 
 
@@ -239,8 +318,12 @@ def main(argv=None):
         width = max(len(n) for n in results)
         for name in sorted(results):
             r = results[name]
+            st = r.get("static")
+            tail = "" if not st else \
+                "   static %.1f MFLOP %s-bound" % (
+                    st["flops"] / 1e6, st["predicted_bound"])
             print(f"{name:<{width}}  mean {r['mean_ms']:8.3f} ms   "
-                  f"p50 {r['p50_ms']:8.3f} ms   ({r['shape']})")
+                  f"p50 {r['p50_ms']:8.3f} ms   ({r['shape']}){tail}")
         print(f"bench_ops: {len(results)} op(s) in "
               f"{artifact['duration_s']:g}s"
               + (f" -> {args.out}" if args.out else ""))

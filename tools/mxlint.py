@@ -20,6 +20,11 @@ Usage:
     --shape name=d,... seed graph shape inference (repeatable), e.g.
                        --shape data=64,3,224,224
     --suppress codes   comma list of finding codes to drop
+    --fail-on SEV      severity threshold for the exit status: exit 1
+                       when any finding at/above SEV (one of error,
+                       warn, hint) survives --suppress.  Default: warn
+                       (hints never fail).  --fail-on=hint implies
+                       --hints.
     --json             machine-readable summary (one JSON object)
     --tsan-report      concurrency report: the mxtsan AST lints
                        (unnamed-thread, bare-acquire, sleep-under-lock,
@@ -28,10 +33,22 @@ Usage:
                        dump among PATHS rendered as the lock-order
                        graph + findings
     --cache-report DIR program-cache hit rates / churn from stats.json
+    --cost-report      mxcost static cost analysis (analysis/cost.py):
+                       the canonical bench program set (per-program
+                       flops/bytes/roofline, dtype-flow defects, peak
+                       HBM) plus the dp-N bucketed collective plan, and
+                       any symbol-JSON PATHS as extra programs.
+                       --budgets FILE compares against the committed
+                       COST_BUDGETS baseline (in-budget defects demote
+                       to hints; regressions are errors);
+                       --write-budgets FILE re-snapshots the baseline;
+                       --profile/--dp/--bucket-mb pick the device
+                       profile and plan geometry.
 
-Exit status: 0 when no error/warn findings survive, 1 otherwise (hints
-never fail the run).  Inline suppression: ``# mxlint: disable[=code]``
-on the offending source line, or a ``__lint__`` attr on a graph node.
+Exit status (the CI contract): 0 — no finding at/above --fail-on
+survived --suppress; 1 — at least one did; 2 — usage error (argparse).
+Inline suppression: ``# mxlint: disable[=code]`` on the offending
+source line, or a ``__lint__`` attr on a graph node.
 """
 from __future__ import annotations
 
@@ -144,6 +161,99 @@ def cache_report(cache_dir, as_json=False):
     return 0
 
 
+def cost_report(paths, as_json=False, budgets_path=None,
+                write_budgets=None, profile=None, dp=8, bucket_mb=None,
+                suppress=(), fail_on="warn", shapes=None):
+    """mxcost stage: analyze the canonical bench program set (plus any
+    symbol-JSON PATHS) with analysis/cost.py, optionally gate against a
+    COST_BUDGETS baseline, and exit per --fail-on.  This is the CI
+    entry `run_tpu_parity.py`'s cost stage runs: a new dequant chain,
+    f32 upcast, extra collective, +bytes/step or +peak-HBM beyond the
+    committed budget exits 1."""
+    from incubator_mxnet_tpu.analysis import Report
+    from incubator_mxnet_tpu.analysis import cost as mxcost
+    from incubator_mxnet_tpu.analysis import budgets as mxbudgets
+    from incubator_mxnet_tpu.analysis.findings import severity_rank
+    from incubator_mxnet_tpu.symbol.symbol import load_json
+
+    cap = int(bucket_mb * (1 << 20)) if bucket_mb else None
+    results = mxcost.analyze_bench_set(profile=profile, dp=dp,
+                                       cap_bytes=cap)
+    _py, json_files = _collect(paths)
+    for path in json_files:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            text = f.read()
+        if not _looks_like_symbol_json(text):
+            continue
+        name = os.path.basename(path)
+        if name in results:       # same basename twice: keep both
+            name = path
+        try:
+            sym = load_json(text)
+        except Exception as e:
+            print(f"mxlint: cannot load {path} ({str(e)[:120]})",
+                  file=sys.stderr)
+            continue
+        results[name] = mxcost.analyze_symbol(
+            sym, shapes=shapes or None, profile=profile, target=name)
+
+    if write_budgets:
+        mxbudgets.save(write_budgets, mxbudgets.snapshot(results))
+        print(f"mxlint: cost budgets for {len(results) - 1} program(s) "
+              f"written to {write_budgets}")
+        return 0
+
+    coll_report = mxcost.collectives_report(results["__collectives__"])
+    deltas = {}
+    if budgets_path:
+        report, deltas = mxbudgets.check(results,
+                                         mxbudgets.load(budgets_path))
+    else:
+        report = Report(target="cost")
+        for name, prog in sorted(results.items()):
+            if name != "__collectives__":
+                report.extend(prog.report)
+    report.extend(coll_report.findings)
+    report = report.suppress(set(suppress))
+    thr = severity_rank(fail_on)
+    failing = [f for f in report
+               if severity_rank(f.severity) <= thr]
+
+    stats = {k: v for k, v in results["__collectives__"].items()
+             if k != "plan"}
+    summary = {
+        "programs": {name: prog.as_dict()
+                     for name, prog in sorted(results.items())
+                     if name != "__collectives__"},
+        "collectives": stats,
+        "budgets": budgets_path,
+        "budget_deltas": deltas,
+        "findings": len(report),
+        "failing": len(failing),
+        "fail_on": fail_on,
+    }
+    if as_json:
+        print(json.dumps(summary, indent=1))
+    else:
+        for name, prog in sorted(results.items()):
+            if name == "__collectives__":
+                continue
+            d = prog.as_dict()
+            print("%-34s %10.3f MFLOP %9.2f MB moved  AI %6.1f  "
+                  "%s-bound (%s)"
+                  % (name, d["flops"] / 1e6,
+                     d["bytes_moved"] / (1 << 20),
+                     d["arithmetic_intensity"], d["bound"],
+                     d["dominant_dtype"]))
+        for f in report:
+            print(f.format())
+        print("mxlint --cost-report: %d program(s), %d finding(s), "
+              "%d failing at --fail-on=%s%s"
+              % (len(results) - 1, len(report), len(failing), fail_on,
+                 " (vs %s)" % budgets_path if budgets_path else ""))
+    return 1 if failing else 0
+
+
 def tsan_report(paths, as_json=False):
     """Concurrency report: the mxtsan AST lint subset (unnamed-thread,
     bare-acquire, sleep-under-lock, unjoined-thread-in-init) over the
@@ -242,6 +352,11 @@ def main(argv=None):
                     metavar="NAME=D0,D1,...")
     ap.add_argument("--suppress", default="",
                     metavar="CODE[,CODE...]")
+    ap.add_argument("--fail-on", choices=["error", "warn", "hint"],
+                    default="warn", dest="fail_on",
+                    help="exit 1 when any finding at/above this "
+                         "severity survives --suppress (default: warn; "
+                         "hint implies --hints)")
     ap.add_argument("--json", action="store_true", dest="as_json")
     ap.add_argument("--cache-report", metavar="CACHE_DIR",
                     help="report program-cache hit rates and churn-"
@@ -251,12 +366,43 @@ def main(argv=None):
                          "PATHS (default: the package) + any MXNET_TSAN_"
                          "LOG runtime dumps among PATHS rendered as the "
                          "lock-order graph and findings")
+    ap.add_argument("--cost-report", action="store_true",
+                    help="mxcost static cost analysis of the bench "
+                         "program set + symbol-JSON PATHS; gate with "
+                         "--budgets / re-baseline with --write-budgets")
+    ap.add_argument("--budgets", metavar="JSON",
+                    help="COST_BUDGETS baseline to gate --cost-report "
+                         "against (regressions become errors)")
+    ap.add_argument("--write-budgets", metavar="JSON",
+                    dest="write_budgets",
+                    help="snapshot the --cost-report analysis as a new "
+                         "budget baseline and exit")
+    ap.add_argument("--profile", metavar="NAME",
+                    help="mxcost device profile (tpu-v3/tpu-v4/"
+                         "cpu-host; default MXNET_COST_PROFILE)")
+    ap.add_argument("--dp", type=int, default=8,
+                    help="data-parallel degree for the --cost-report "
+                         "collective plan (default 8)")
+    ap.add_argument("--bucket-mb", type=float, default=None,
+                    dest="bucket_mb",
+                    help="bucket cap for the --cost-report collective "
+                         "plan (default MXNET_KVSTORE_BUCKET_MB)")
     args = ap.parse_args(argv)
 
+    if args.fail_on == "hint":
+        args.hints = True
     if args.cache_report:
         return cache_report(args.cache_report, as_json=args.as_json)
     if args.tsan_report:
         return tsan_report(args.paths, as_json=args.as_json)
+    if args.cost_report:
+        return cost_report(
+            args.paths, as_json=args.as_json, budgets_path=args.budgets,
+            write_budgets=args.write_budgets, profile=args.profile,
+            dp=args.dp, bucket_mb=args.bucket_mb,
+            suppress={c.strip() for c in args.suppress.split(",")
+                      if c.strip()},
+            fail_on=args.fail_on, shapes=_parse_shapes(args.shape))
     if not args.paths:
         ap.error("paths required (or --cache-report DIR)")
 
@@ -290,7 +436,9 @@ def main(argv=None):
     for f in findings:
         by_code[f.code] = by_code.get(f.code, 0) + 1
         by_pass[f.pass_name] = by_pass.get(f.pass_name, 0) + 1
-    failing = [f for f in findings if f.severity in ("error", "warn")]
+    from incubator_mxnet_tpu.analysis.findings import severity_rank
+    thr = severity_rank(args.fail_on)
+    failing = [f for f in findings if severity_rank(f.severity) <= thr]
 
     if args.as_json:
         print(json.dumps({

@@ -92,6 +92,32 @@ def mxlint_stage():
         return {"error": f"mxlint stage failed: {exc!r}"}
 
 
+def cost_stage():
+    """Static-cost stage: `mxlint --cost-report` over the canonical
+    bench program set, gated against the committed COST_BUDGETS.json
+    baseline in a throwaway process.  The artifact records per-program
+    flops/bytes/peak-HBM and the per-metric deltas vs budget, so a new
+    dequant chain, f32 upcast, extra collective, +bytes/step or
+    +peak-HBM is a hard stage failure (rc=1) — cost regressions become
+    checkable evidence next to the parity outcomes, BEFORE any bench
+    run measures them."""
+    cmd = [sys.executable, os.path.join(REPO, "tools", "mxlint.py"),
+           "--cost-report", "--json", "--fail-on=warn",
+           "--budgets", os.path.join(REPO, "COST_BUDGETS.json")]
+    try:
+        out = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                             timeout=900)
+        summary = json.loads(out.stdout)
+        for prog in summary.get("programs", {}).values():
+            prog.pop("top_ops", None)     # per-op detail lives in the
+            prog.pop("findings", None)    # lint run, not the artifact
+        summary["rc"] = out.returncode
+        summary["clean"] = out.returncode == 0
+        return summary
+    except Exception as exc:
+        return {"error": f"cost stage failed: {exc!r}"}
+
+
 def serving_stage():
     """Serving-bench stage: run tools/run_serving_bench.py --quick in a
     throwaway process and attach its JSON artifact (QPS, p50/p99, batch
@@ -342,6 +368,7 @@ def main():
         "git_rev": git_revision(),
         "jax": probe_backend(),
         "mxlint": mxlint_stage(),
+        "cost": cost_stage(),
         "serving": serving_stage(),
         "chaos": chaos_stage(),
         "chaos_pod": chaos_pod_stage(),
