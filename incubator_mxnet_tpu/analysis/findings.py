@@ -107,6 +107,9 @@ CODE_TABLE = _build_code_table([
      "time.sleep inside a lock scope parks every queued thread"),
     ("unjoined-thread-in-init", WARN, ("source.thread",),
      "class starts a Thread but registers no lifecycle method"),
+    ("untracked-stats", WARN, ("source.obs",),
+     "public stats() dict not registered with the obs MetricsRegistry; "
+     "invisible to the scrape plane"),
     # -- runtime trace passes ------------------------------------------------
     ("shape-churn", WARN, ("trace.recompile",),
      "new jit signature forced a fresh XLA compile (ragged batches etc.)"),

@@ -97,6 +97,17 @@ Concurrency lints (the static half of the mxtsan tier; ``mxlint
   ``join``/``reset``/``__exit__``/``__del__``): nothing can ever join
   the worker, so it leaks by construction.
 
+Observability lint (the telemetry-plane registration contract):
+
+* ``untracked-stats`` — a class defining a public ``stats()`` method in
+  a file that never calls ``obs.metrics.register_producer``: the stats
+  dict exists but the scrape plane (the ``metrics`` transport frame,
+  `FleetManager.scrape`, ``tools/mxtop.py``) cannot see it — a
+  subsystem invents a private observability shape instead of joining
+  the registry.  Register the producer under a stable dotted
+  namespace, or suppress inline for protocol stubs / remote fetches
+  whose numbers are registered elsewhere.
+
 Suppression: append ``# mxlint: disable`` (everything on the line) or
 ``# mxlint: disable=<code>[,<code>...]`` to the offending line.
 """
@@ -154,7 +165,8 @@ _PASS_BY_CODE = {"host-sync-in-loop": "source.hostsync",
                  "unnamed-thread": "source.thread",
                  "bare-acquire": "source.locks",
                  "sleep-under-lock": "source.locks",
-                 "unjoined-thread-in-init": "source.thread"}
+                 "unjoined-thread-in-init": "source.thread",
+                 "untracked-stats": "source.obs"}
 
 # identifiers that mark a with-scope as a critical section for the
 # sleep-under-lock lint (token substrings of the context expression)
@@ -194,6 +206,8 @@ class _Visitor(ast.NodeVisitor):
         self.supervised_depth = 0  # inside a supervisor/watchdog `with`
         self.device_depth = 0      # inside a jit/pjit/shard_map function
         self.lock_with_depth = 0   # inside a `with <lock-ish>:` block
+        self.stats_defs = []       # (lineno, class name) of `def stats`
+        self.registers_producer = False   # file calls register_producer
 
     # -- loops ---------------------------------------------------------------
     def _loop(self, node):
@@ -414,8 +428,14 @@ class _Visitor(ast.NodeVisitor):
 
     visit_With = visit_AsyncWith = _visit_with
 
-    # -- classes (thread-lifecycle lint) -------------------------------------
+    # -- classes (thread-lifecycle + untracked-stats lints) ------------------
     def visit_ClassDef(self, node):
+        for fn in node.body:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and fn.name == "stats":
+                # deferred: emitted only if the whole FILE never
+                # registers a producer (scan_source post-pass)
+                self.stats_defs.append((fn.lineno, node.name))
         methods = {n.name for n in node.body
                    if isinstance(n, (ast.FunctionDef,
                                      ast.AsyncFunctionDef))}
@@ -470,6 +490,8 @@ class _Visitor(ast.NodeVisitor):
             name = func.id
         if name == "tpu":
             self.uses_tpu = True
+        if name == "register_producer":
+            self.registers_producer = True
         if self.loop_depth > 0 and isinstance(func, ast.Attribute) and \
                 name in _SYNC_METHODS:
             self._add("host-sync-in-loop", node.lineno,
@@ -630,6 +652,19 @@ def scan_source(text, filename="<string>"):
                 "hard-coded and bypasses host-aware placement/backfill "
                 "— hand the host registry to FleetManager and let "
                 "placement spawn the replicas",
+                location=f"{filename}:{lineno}"))
+    if not v.registers_producer:
+        for lineno, cls in v.stats_defs:
+            if _suppressed(lines, lineno, "untracked-stats"):
+                continue
+            report.add(Finding(
+                "source.obs", "untracked-stats", WARN,
+                f"class '{cls}' defines a public stats() dict but this "
+                "file never registers it with the metrics registry "
+                "(obs.metrics.register_producer): the scrape plane — "
+                "the 'metrics' transport frame, FleetManager.scrape, "
+                "mxtop — cannot see these numbers; register the "
+                "producer under a stable dotted namespace",
                 location=f"{filename}:{lineno}"))
     if v.uses_tpu:
         for lineno, sink in v.kv_local_sites:

@@ -68,7 +68,6 @@ lock-order graph as JSON at process exit (the artifact
 from __future__ import annotations
 
 import atexit
-import json
 import os
 import sys
 import threading
@@ -822,10 +821,10 @@ def lock_graph():
 def dump(path=None):
     """Write findings + lock graph as one JSON artifact (the
     ``mxlint --tsan-report`` input).  Registered at atexit when
-    ``MXNET_TSAN_LOG`` is set; each process appends ONE json line with
-    a single O_APPEND write (the faults-JSONL convention), so the
-    subprocesses of a chaos run share a log without clobbering each
-    other's findings."""
+    ``MXNET_TSAN_LOG`` is set; each process appends ONE json line
+    through the shared `obs.jsonl_sink` (O_APPEND line-atomic,
+    pid/rank/thread-stamped), so the subprocesses of a chaos run share
+    a log without clobbering each other's findings."""
     found = [f.as_dict() for f in findings()]
     with _state_lock:
         states = sorted({state for (state, _k) in _accesses})
@@ -838,14 +837,10 @@ def dump(path=None):
     }
     if path is None:
         return payload
-    try:
-        fd = os.open(path, os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644)
-        try:
-            os.write(fd, (json.dumps(payload) + "\n").encode())
-        finally:
-            os.close(fd)
-    except OSError:
-        pass
+    from ..obs import jsonl_sink as _jsonl
+    s = _jsonl.JsonlSink(path)
+    s.write(payload)
+    s.close()
     return payload
 
 

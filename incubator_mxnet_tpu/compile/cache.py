@@ -142,6 +142,10 @@ class ProgramCache:
         # (we cannot delete there): the next export of that key rewrites
         # instead of skipping the existing bad file
         self.corrupt_keys = set()
+        # telemetry plane: hit/compile/eviction counters under the
+        # stable 'cache' namespace (weakly held; newest cache answers)
+        from ..obs import metrics as _obs_metrics
+        _obs_metrics.register_producer("cache", self.stats)
         if directory:
             self.set_directory(directory)
         for s in sources:

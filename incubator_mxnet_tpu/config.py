@@ -411,6 +411,36 @@ KNOBS = {
                          "an MXNetError at the acquisition site instead "
                          "of only recording a finding (the lock is "
                          "released before raising)"),
+    # -- unified telemetry plane (obs/) --------------------------------------
+    "MXNET_OBS_TRACE": (str, "", "honored",
+                        "obs/trace.py: shared span JSONL file enabling "
+                        "cross-process distributed tracing — every "
+                        "process of a run (router, subprocess workers, "
+                        "host daemons, parameter servers) appends its "
+                        "finished spans there (O_APPEND line-atomic); "
+                        "tools/mxtrace.py merges the file into ONE "
+                        "Perfetto-loadable chrome trace with "
+                        "cross-process flow arrows"),
+    "MXNET_OBS_TRACE_BUFFER": (int, 65536, "honored",
+                               "in-memory span buffer cap per process "
+                               "(drop-oldest past it, counted in the "
+                               "'trace.dropped' metric); spans "
+                               "auto-flush to the shared file in "
+                               "batches and at exit"),
+    "MXNET_OBS_METRICS": (_BOOL, True, "honored",
+                          "obs/metrics.py: invoke registered stats() "
+                          "producers on scrape — off, collect() "
+                          "returns raw instruments only (the paranoid "
+                          "hot-path escape hatch; the 'metrics' "
+                          "transport frame itself always answers)"),
+    "MXNET_PROFILER_MAX_EVENTS": (int, 250000, "honored",
+                                  "profiler.py in-memory custom-event "
+                                  "buffer cap: a long supervised run "
+                                  "with MXNET_PROFILER=1 drops the "
+                                  "OLDEST events past it instead of "
+                                  "exhausting host memory; drops are "
+                                  "counted and surfaced as the "
+                                  "'profiler.dropped_events' metric"),
 }
 
 _warned = set()

@@ -76,6 +76,11 @@ class KVStoreDist(KVStoreTPU):
         # route profiler(profile_process='server') commands through us
         from .. import profiler as _profiler
         _profiler.set_kvstore_handle(self)
+        # telemetry plane: the dist retry/failover counters under their
+        # own namespace (the base class's bucketed counters stay under
+        # 'kvstore' via super().__init__'s registration)
+        from ..obs import metrics as _obs_metrics
+        _obs_metrics.register_producer("kvstore.dist", self.stats)
         # collective data plane: gradients all-reduce over the global device
         # mesh (ICI/DCN via XLA collectives — the reference's NCCL/ps-lite
         # data role done the TPU way, SURVEY §2.4); the socket server is

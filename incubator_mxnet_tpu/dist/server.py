@@ -39,7 +39,7 @@ from ..resilience import faults as _faults
 # "members" are idempotent too — a re-executed heartbeat just refreshes
 # the same liveness timestamp)
 _READ_CMDS = frozenset({"pull", "server_list", "get_optimizer_states",
-                        "hb", "members"})
+                        "hb", "members", "metrics"})
 
 
 class _State:
@@ -219,7 +219,10 @@ class ParameterServer:
         reply = None
         try:
             _faults.fire("server.dispatch", cmd=cmd)
-            reply = self._dispatch(msg)
+            from ..obs import trace as _obs_trace
+            with _obs_trace.server_span(msg, f"server.{cmd}",
+                                        cat="kvstore"):
+                reply = self._dispatch(msg)
         finally:
             if dedup:
                 # caching the reply and clearing inflight must be ONE
@@ -301,6 +304,11 @@ class ParameterServer:
             return self._membership().heartbeat(
                 msg["rank"], msg.get("epoch", 0), step=msg.get("step"),
                 step_time=msg.get("step_time"))
+
+        if cmd == "metrics":
+            # the scrape plane: this server process's registry snapshot
+            from ..obs.scrape import metrics_reply
+            return metrics_reply()
 
         if cmd == "members":
             return {"ok": True, "view": self._membership().view()}

@@ -26,6 +26,15 @@ import struct
 import time
 
 _tsan = None   # analysis.tsan, memoized on first recv (lazy: low-level module)
+_trace = None  # obs.trace, memoized on first request (lazy: low-level module)
+
+
+def _obs_trace():
+    global _trace
+    if _trace is None:
+        from ..obs import trace
+        _trace = trace
+    return _trace
 
 _LEN = struct.Struct(">Q")
 _TAG_LEN = 32
@@ -226,13 +235,23 @@ class Channel:
     def request(self, obj):
         """One request/reply round trip.  Connection-level failures resend
         under the retry policy (safe: the server dedups by client+seq);
-        a timeout raises but leaves the channel consistent."""
+        a timeout raises but leaves the channel consistent.
+
+        When distributed tracing is on (``MXNET_OBS_TRACE``) the frame
+        carries a ``tr`` span context — the server side's handling span
+        parents to this request's rpc span, in another process.  A
+        resend reuses the ORIGINAL frame (and span id), so a dedup
+        replay still joins the same trace."""
         self._seq += 1
         msg = dict(obj)
         msg["seq"] = self._seq
         msg["client"] = self.client_id
+        sp = _obs_trace().rpc_span(msg, f"{self.host}:{self.port}")
         self._last_frame = msg
-        return self._send_framed(msg)
+        try:
+            return self._send_framed(msg)
+        finally:
+            sp.end()
 
     def resend_last(self):
         """Retry the most recent request with its ORIGINAL sequence
@@ -291,8 +310,12 @@ class Channel:
         if self._closed or self._sock is None:
             raise ConnectionError(
                 f"channel to {self.host}:{self.port} is closed")
-        send_msg(self._sock, msg)
-        return self._read_reply(msg["seq"])
+        sp = _obs_trace().rpc_span(msg, f"{self.host}:{self.port}")
+        try:
+            send_msg(self._sock, msg)
+            return self._read_reply(msg["seq"])
+        finally:
+            sp.end()
 
     def close(self):
         """Close for good: later requests fail fast instead of silently

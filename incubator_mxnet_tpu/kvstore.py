@@ -395,6 +395,11 @@ class KVStoreTPU(KVStore):
         }
         self._fill_hist = [0, 0, 0, 0]   # fill quartiles (<=25..<=100%)
         _LIVE_STORES.add(self)
+        # telemetry plane: the communication-economy counters under the
+        # stable 'kvstore' namespace (weakly held; the newest live
+        # store answers scrapes)
+        from .obs import metrics as _obs_metrics
+        _obs_metrics.register_producer("kvstore", self.stats)
 
     @property
     def _bucket_cap_bytes(self):
@@ -812,9 +817,12 @@ class KVStoreTPU(KVStore):
             for k in keys:
                 if _key(k) not in self._store:
                     raise MXNetError(f"Key {k} has not been initialized")
-            merged = self._reduce_many(values, keys)
-            for k, m in zip(keys, merged):
-                self._commit(k, m)
+            from .obs import trace as _obs_trace
+            with _obs_trace.span("kvstore.push", cat="kvstore",
+                                 keys=len(keys)):
+                merged = self._reduce_many(values, keys)
+                for k, m in zip(keys, merged):
+                    self._commit(k, m)
             return
         super().push(key, value, priority)
 

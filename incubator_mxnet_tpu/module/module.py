@@ -14,6 +14,7 @@ from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
                      save_checkpoint)
 from ..ndarray.ndarray import NDArray
 from .. import ndarray as nd
+from ..obs import trace as _obs_trace
 from .base_module import BaseModule, _as_list
 from .executor_group import DataParallelExecutorGroup
 
@@ -369,13 +370,17 @@ class Module(BaseModule):
 
     def fit_step(self, data_batch, eval_metric):
         """One train step + metric update; fused single-program when
-        available (see init_optimizer), reference semantics otherwise."""
-        if self._fused_step is not None and \
-                self._fused_step(data_batch, eval_metric):
-            return
-        self.forward_backward(data_batch)
-        self.update()
-        self.update_metric(eval_metric, data_batch.label)
+        available (see init_optimizer), reference semantics otherwise.
+        Traced as one span — the kvstore push/pull rpc spans it issues
+        parent into it, so a training step reads as one connected tree
+        across worker and server processes in the merged trace."""
+        with _obs_trace.span("fit.step", cat="train"):
+            if self._fused_step is not None and \
+                    self._fused_step(data_batch, eval_metric):
+                return
+            self.forward_backward(data_batch)
+            self.update()
+            self.update_metric(eval_metric, data_batch.label)
 
     def _fit_block_k(self):
         """K batches per `fit` dispatch: when the fused step is live, one
@@ -395,7 +400,13 @@ class Module(BaseModule):
         attempted — a later block may fuse (e.g. after deferred state
         materializes)."""
         fs = self._fused_step
-        return fs is not None and fs.call_block(data_batches, eval_metric)
+        if fs is None:
+            return False
+        with _obs_trace.span("fit.step_block", cat="train",
+                             k=len(data_batches)) as sp:
+            ran = fs.call_block(data_batches, eval_metric)
+            sp.note(fused=bool(ran))
+        return ran
 
     def _fit_block_cursor(self, j):
         """Point get_outputs() AND the in-graph metric totals at batch j

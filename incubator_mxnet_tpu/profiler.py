@@ -9,6 +9,7 @@ the reference; custom objects are recorded into the same trace via
 """
 from __future__ import annotations
 
+import collections
 import json
 import os
 import threading
@@ -26,8 +27,37 @@ _config = {"profile_all": False, "profile_symbolic": False,
            "profile_api": False, "filename": "profile.json",
            "aggregate_stats": False}
 _state = {"running": False, "dir": None}
-_custom_events = []
+# BOUNDED event buffer: a long supervised run with the profiler on must
+# never exhaust host memory — past MXNET_PROFILER_MAX_EVENTS the OLDEST
+# events drop (the newest window is the one being debugged), counted in
+# _dropped and surfaced as the 'profiler.dropped_events' metric
+_custom_events = collections.deque()
+_dropped = [0]
+_cap = [None]     # resolved lazily from config (tests re-point it)
 _lock = _alocks.make_lock("profiler")
+
+
+def _event_cap():
+    if _cap[0] is None:
+        from . import config as _config
+        _cap[0] = max(int(_config.get("MXNET_PROFILER_MAX_EVENTS")), 1)
+    return _cap[0]
+
+
+def set_event_cap(n):
+    """Override the in-memory event-buffer cap (tests; None re-reads
+    MXNET_PROFILER_MAX_EVENTS on the next emit)."""
+    _cap[0] = None if n is None else max(int(n), 1)
+
+
+def buffer_stats():
+    """{"events", "dropped_events", "cap", "running"} — registered as
+    the 'profiler' namespace in the obs metrics registry."""
+    with _lock:
+        return {"events": len(_custom_events),
+                "dropped_events": _dropped[0],
+                "cap": _event_cap(),
+                "running": _state["running"]}
 
 
 _kvstore_handle = [None]
@@ -126,8 +156,14 @@ def dumps(reset=False):
 
 
 def _emit(event):
+    cap = _event_cap()
     with _lock:
         _custom_events.append(event)
+        while len(_custom_events) > cap:
+            # drop-oldest, counted: memory stays bounded and the loss
+            # is visible in the scrape plane instead of silent
+            _custom_events.popleft()
+            _dropped[0] += 1
 
 
 def _tid():
@@ -322,3 +358,9 @@ def profiler_set_config(mode="symbolic", filename="profile.json"):
 
 def profiler_set_state(state_="stop"):
     set_state(state_)
+
+
+# telemetry plane: the buffer economy under the 'profiler' namespace
+from .obs import metrics as _obs_metrics  # noqa: E402
+
+_obs_metrics.register_producer("profiler", buffer_stats)

@@ -77,7 +77,6 @@ both deterministic.
 """
 from __future__ import annotations
 
-import json
 import os
 import random
 import re
@@ -206,7 +205,6 @@ _clauses = []
 _trace = []
 _seed = 0
 _log_path = None
-_log_fd = None
 
 
 def _load_env():
@@ -283,32 +281,20 @@ def trace():
 
 
 def _record(event):
-    # every event names its emitting process AND thread: pid always, the
-    # dmlc rank when the launcher set one (read per event — the
-    # shrink-and-resume path re-ranks a live process mid-run), and the
-    # worker-thread name so chaos/sanitizer artifacts attribute a fired
-    # fault to the router health loop vs a dispatch thread vs a
-    # supervisor heartbeat, not just to "the process"
-    import threading as _threading
-    rank = os.environ.get("DMLC_RANK")
-    event["pid"] = os.getpid()
-    event["thread"] = _threading.current_thread().name
-    event["rank"] = int(rank) if rank is not None and rank.isdigit() \
-        else None
+    # every event names its emitting process AND thread (the shared
+    # sink's pid/rank/thread stamping — obs.jsonl_sink — so chaos and
+    # sanitizer artifacts attribute a fired fault to the router health
+    # loop vs a dispatch thread vs a supervisor heartbeat, not just to
+    # "the process"; the rank is read per event because the
+    # shrink-and-resume path re-ranks a live process mid-run)
+    from ..obs import jsonl_sink as _jsonl
+    _jsonl.stamp(event)
     _trace.append(event)
     if _log_path is not None:
-        global _log_fd
-        try:
-            if _log_fd is None:
-                # O_APPEND + one write() per line: POSIX makes each line
-                # atomic, so every process of a chaos run can append to
-                # the SAME file without interleaving mid-line
-                _log_fd = os.open(_log_path,
-                                  os.O_APPEND | os.O_CREAT | os.O_WRONLY,
-                                  0o644)
-            os.write(_log_fd, (json.dumps(event) + "\n").encode())
-        except OSError:
-            pass
+        # O_APPEND + one write() per line (the sink's contract): every
+        # process of a chaos run appends to the SAME file without
+        # interleaving mid-line
+        _jsonl.sink(_log_path).write(event)
     try:
         from .. import profiler as _profiler
         _profiler.record_fault(event.get("site"), event.get("kind"),

@@ -186,39 +186,23 @@ def _record_event(event, **args):
 
 class QuarantineLog:
     """Append-only JSONL quarantine file shared by every process of a
-    run (O_APPEND line-atomic writes, the faults-log convention).  Each
+    run — written through the one tested sink (`obs.jsonl_sink`:
+    O_APPEND line-atomic appends, pid/rank/thread stamping).  Each
     entry is one poisoned unit: a batch position ({'epoch','nbatch'})
     or a record ({'source','record'})."""
 
     def __init__(self, path):
+        from ..obs import jsonl_sink as _jsonl
         self.path = str(path)
-        self._fd = None
+        self._jsonl = _jsonl
+        self._sink = _jsonl.sink(self.path)
 
     def append(self, **entry):
-        entry.setdefault("time", round(time.time(), 3))
-        entry["pid"] = os.getpid()
-        try:
-            if self._fd is None:
-                self._fd = os.open(self.path,
-                                   os.O_APPEND | os.O_CREAT | os.O_WRONLY,
-                                   0o644)
-            os.write(self._fd, (json.dumps(entry) + "\n").encode())
-        except OSError:
-            pass
+        self._sink.write(entry)
 
     def load(self):
         """Every entry written so far (any process), oldest first."""
-        out = []
-        try:
-            with open(self.path) as f:
-                for line in f:
-                    try:
-                        out.append(json.loads(line))
-                    except ValueError:
-                        continue
-        except OSError:
-            pass
-        return out
+        return self._jsonl.read_jsonl(self.path)
 
     def batch_positions(self):
         """{(epoch, nbatch)} of every quarantined stream position."""
@@ -232,12 +216,7 @@ class QuarantineLog:
                 (source is None or e.get("source") == source)}
 
     def close(self):
-        if self._fd is not None:
-            try:
-                os.close(self._fd)
-            except OSError:
-                pass
-            self._fd = None
+        self._sink.close()
 
 
 class TrainingGuardian:
@@ -318,6 +297,10 @@ class TrainingGuardian:
                        "spikes": 0, "rollbacks": 0, "quarantined": 0,
                        "sync_degraded": 0, "injected_nonfinite": 0,
                        "injected_spike": 0}
+        # telemetry plane: skip/rollback/quarantine counters under the
+        # stable 'guardian' namespace (weakly held — dies with the fit)
+        from ..obs import metrics as _obs_metrics
+        _obs_metrics.register_producer("guardian", self.stats)
 
     # -- wiring ---------------------------------------------------------------
     def attach(self, module):

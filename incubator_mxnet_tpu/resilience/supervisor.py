@@ -269,6 +269,10 @@ class JobSupervisor:
              "collective_timeouts": 0, "stragglers_flagged": 0,
              "hosts_lost": 0})
         _tsan.instrument(self, f"supervisor[rank{self.rank}]")
+        # telemetry plane: heartbeat/watchdog/straggler counters under
+        # the stable 'supervisor' namespace (weakly held)
+        from ..obs import metrics as _obs_metrics
+        _obs_metrics.register_producer("supervisor", self.stats)
 
     @classmethod
     def for_kvstore(cls, kv, **kw):

@@ -48,6 +48,7 @@ import numpy as _np
 from ..analysis import locks as _locks
 from ..analysis import tsan as _tsan
 from ..base import MXNetError
+from ..obs import trace as _obs_trace
 from ..resilience import CircuitBreaker, faults as _faults
 
 __all__ = ["MicroBatcher"]
@@ -55,7 +56,7 @@ __all__ = ["MicroBatcher"]
 
 class _Request:
     __slots__ = ("arrs", "rows", "deadline", "timeout_ms", "future",
-                 "t_enqueue", "rid", "prio")
+                 "t_enqueue", "rid", "prio", "tr")
 
     def __init__(self, arrs, rows, timeout_ms, rid, prio=1):
         self.arrs = arrs
@@ -67,6 +68,9 @@ class _Request:
         self.deadline = (self.t_enqueue + timeout_ms / 1e3
                          if timeout_ms is not None else None)
         self.future = Future()
+        # trace context captured on the SUBMITTING thread: the batch
+        # executes on the worker thread, where contextvars are blind
+        self.tr = _obs_trace.current_frame()
 
 
 class MicroBatcher:
@@ -422,6 +426,21 @@ class MicroBatcher:
         self._metrics.set_breaker_state(self._breaker.state)
         done = time.monotonic()
         self._metrics.record_batch(rows, bucket, done - t0)
+        if _obs_trace.enabled():
+            # ONE span per executed batch, parented into the first
+            # coalesced request's trace (span emission runs on the
+            # serialized batcher worker thread — per-request spans here
+            # would tax every request in the queue; the other requests'
+            # rids ride in args, and their trees stay rooted at their
+            # router.request spans)
+            dur_us = int((done - t0) * 1e6)
+            _obs_trace.record_span(
+                "batcher.execute", time.time_ns() // 1000 - dur_us,
+                dur_us, parent=next((r.tr for r in live
+                                     if r.tr is not None), None),
+                cat="serving", model=model.name, bucket=bucket,
+                batch_rows=rows, requests=len(live),
+                rids=",".join(str(r.rid) for r in live[:8]))
         ctx = model._ctx
         from ..ndarray.ndarray import NDArray
         off = 0

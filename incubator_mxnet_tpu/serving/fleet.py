@@ -57,6 +57,7 @@ from ..analysis import locks as _locks
 from ..analysis import tsan as _tsan
 from ..base import MXNetError
 from ..dist.membership import MembershipTable
+from ..obs import metrics as _obs_metrics
 from ..resilience import CircuitBreaker, faults as _faults
 
 __all__ = ["FleetManager", "Autoscaler", "ReplicaSpec", "FleetHost",
@@ -195,6 +196,12 @@ class FleetHost:
     def spawn_replica(self, spec, replica_id):
         raise NotImplementedError
 
+    def scrape(self):
+        """The host's telemetry snapshot ({"values", "prom"}), or None
+        when this host kind has no scrape leg (in-process hosts share
+        the manager's own registry)."""
+        return None
+
     def close(self):
         pass
 
@@ -300,6 +307,13 @@ class AgentHost(FleetHost):
 
     def heartbeat(self):
         return self._request(self._control, {"cmd": "hb"})
+
+    def scrape(self):
+        """The daemon process's registry snapshot over the control
+        channel (the fleet-wide scrape's per-host leg)."""
+        reply = self._request(self._control, {"cmd": "metrics"})
+        return {"values": dict(reply.get("values") or {}),
+                "prom": reply.get("prom", "")}
 
     def spawn_replica(self, spec, replica_id):
         from .replica import RemoteReplica
@@ -513,6 +527,9 @@ class FleetManager:
                 f"replica budget [{min_r}, {max_r}]")
         self._lock = _locks.make_lock("serving.fleet")
         _tsan.instrument(self, f"serving.fleet[{self.name}]")
+        _obs_metrics.register_producer(
+            "fleet" if self.name == "fleet" else f"fleet.{self.name}",
+            self.stats)
         self._placement = {}          # replica_id -> host_id
         self._rid_seq = itertools.count(1)
         # host liveness rides the SAME MembershipTable the elastic
@@ -989,3 +1006,36 @@ class FleetManager:
         snap["placement"] = placement
         snap["events"] = events[-32:]
         return snap
+
+    def scrape(self):
+        """The fleet-wide telemetry aggregate: this process's registry
+        (router, fleet, serving.* producers), every live host daemon's
+        snapshot, and every placed remote replica's worker snapshot —
+        one call, the whole fleet.  Dead or unreachable legs are
+        recorded under ``unreachable`` instead of failing the scrape
+        (a half-dead fleet is exactly when you need the numbers)."""
+        from ..obs.scrape import metrics_reply
+        local = metrics_reply()
+        out = {"fleet": self.name,
+               "local": {"values": local["values"],
+                         "prom": local["prom"]},
+               "hosts": {}, "replicas": {}, "unreachable": []}
+        with self._lock:
+            hosts = {hid: hs.handle for hid, hs in self._hosts.items()}
+        for hid, handle in hosts.items():
+            try:
+                snap = handle.scrape()
+            except Exception:
+                out["unreachable"].append(f"host:{hid}")
+                continue
+            if snap is not None:
+                out["hosts"][hid] = snap
+        for rid, slot in self._router_slots().items():
+            scrape_fn = getattr(slot.replica, "scrape", None)
+            if scrape_fn is None:
+                continue
+            try:
+                out["replicas"][rid] = scrape_fn()
+            except Exception:
+                out["unreachable"].append(f"replica:{rid}")
+        return out

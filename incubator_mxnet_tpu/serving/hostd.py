@@ -35,6 +35,8 @@ import sys
 import threading
 
 from ..analysis import locks as _locks
+from ..obs import metrics as _obs_metrics
+from ..obs import trace as _obs_trace
 
 __all__ = ["HostDaemon", "main"]
 
@@ -48,6 +50,8 @@ class HostDaemon:
         self._lock = _locks.make_lock("serving.hostd")
         self._workers = {}    # replica_id -> {"proc", "port", "ready"}
         self._spawning = {}   # replica_id -> Event (first spawn running)
+        self.spawns = 0
+        _obs_metrics.register_producer("hostd", self._obs_stats)
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -69,6 +73,8 @@ class HostDaemon:
                         break
                     if msg.get("cmd") == "stop":
                         outer._kill_workers()
+                        # os._exit skips atexit: flush buffered spans
+                        _obs_trace.flush()
                         os._exit(0)
 
         class Server(socketserver.ThreadingTCPServer):
@@ -85,6 +91,11 @@ class HostDaemon:
             if proc.poll() is not None:
                 del self._workers[rid]
 
+    def _obs_stats(self):
+        with self._lock:
+            self._reap_locked()
+            return {"workers": len(self._workers), "spawns": self.spawns}
+
     def _handle(self, msg):
         cmd = msg.get("cmd")
         seq = msg.get("seq")
@@ -94,8 +105,13 @@ class HostDaemon:
                 return {"ok": True, "host_id": self.host_id,
                         "workers": len(self._workers),
                         "pid": os.getpid(), "seq": seq}
+        if cmd == "metrics":
+            from ..obs.scrape import metrics_reply
+            return metrics_reply(seq=seq)
         if cmd == "spawn":
-            return dict(self._spawn(msg), seq=seq)
+            with _obs_trace.server_span(msg, "hostd.spawn", cat="fleet",
+                                        replica=msg.get("replica_id")):
+                return dict(self._spawn(msg), seq=seq)
         if cmd == "stop":
             return {"ok": True, "seq": seq}
         return {"error": f"hostd: unknown cmd {cmd!r}", "seq": seq}
@@ -141,6 +157,7 @@ class HostDaemon:
             with self._lock:
                 rec = self._workers[rid] = {"proc": proc, "port": port,
                                             "ready": ready}
+                self.spawns += 1
         finally:
             with self._lock:
                 ev = self._spawning.pop(rid, None)

@@ -26,6 +26,7 @@ import numpy as _np
 
 from ..analysis import locks as _locks
 from ..analysis import tsan as _tsan
+from ..obs import metrics as _obs_metrics
 
 __all__ = ["ServingMetrics", "LatencyReservoir"]
 
@@ -82,6 +83,11 @@ class ServingMetrics:
         # every counter write below must hold _lock; under MXNET_TSAN=1
         # an unsynchronized update is attributed to its exact site
         _tsan.instrument(self, f"serving.metrics[{model_name}]")
+        # telemetry plane: every per-model metrics instance is a
+        # producer under 'serving.<model>' (weakly held — a retired
+        # replica's metrics drop out of scrapes with it)
+        _obs_metrics.register_producer(f"serving.{model_name}",
+                                       self.snapshot)
         self._lat_ms = LatencyReservoir(window)
         self._window = int(window)
         # priority-class plane: class -> {"responses", "shed",

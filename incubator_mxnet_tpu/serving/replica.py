@@ -90,7 +90,9 @@ class Replica:
     def estimated_wait_s(self):
         return None
 
-    def stats(self):
+    # protocol stub: concrete replicas surface through the router's
+    # 'router' producer and each ServingMetrics' 'serving.<id>' one
+    def stats(self):   # mxlint: disable=untracked-stats
         return {}
 
     def close(self, drain=True):
@@ -236,7 +238,8 @@ class LocalReplica(Replica):
             return lat
         return est if lat is None else max(est, lat)
 
-    def stats(self):
+    # registered by this replica's ServingMetrics ('serving.<id>')
+    def stats(self):   # mxlint: disable=untracked-stats
         snap = self.metrics.snapshot()
         snap["version"] = self.version
         return snap
@@ -452,9 +455,16 @@ class RemoteReplica(Replica):
             else _np.asarray(v)
         arrs = {k: to_np(v) for k, v in inputs.items()} \
             if isinstance(inputs, dict) else [to_np(v) for v in inputs]
-        pend = self._Pending({"cmd": "infer", "rid": rid,
-                              "inputs": arrs, "timeout_ms": timeout_ms},
-                             rid)
+        msg = {"cmd": "infer", "rid": rid, "inputs": arrs,
+               "timeout_ms": timeout_ms}
+        from ..obs import trace as _obs_trace
+        tr = _obs_trace.current_frame()
+        if tr is not None:
+            # captured on the SUBMITTING thread: the dispatch loop that
+            # puts this frame on the wire runs where contextvars are
+            # blind — the channel's rpc span parents to this instead
+            msg["tr"] = tr
+        pend = self._Pending(msg, rid)
         with self._lock:
             self._seq_counter += 1
             seq = self._seq_counter
@@ -604,11 +614,20 @@ class RemoteReplica(Replica):
         return self._ewma_s * (outstanding + 1) / max(
             len(self._chans), 1)
 
-    def stats(self):
+    # a remote fetch, not a local producer: the worker process's own
+    # registry answers its scrapes (see scrape() below)
+    def stats(self):   # mxlint: disable=untracked-stats
         try:
             return self._control_request({"cmd": "stats"})
         except (ReplicaLostError, MXNetError):
             return {"lost": True}
+
+    def scrape(self):
+        """The worker process's telemetry snapshot ({"values", "prom"})
+        over the control channel — the fleet's per-replica scrape leg."""
+        reply = self._control_request({"cmd": "metrics"})
+        return {"values": dict(reply.get("values") or {}),
+                "prom": reply.get("prom", "")}
 
     def close(self, drain=True):
         if not self._lost.is_set() and drain:

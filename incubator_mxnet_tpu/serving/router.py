@@ -55,6 +55,8 @@ from concurrent.futures import Future
 from ..analysis import locks as _locks
 from ..analysis import tsan as _tsan
 from ..base import MXNetError
+from ..obs import metrics as _obs_metrics
+from ..obs import trace as _obs_trace
 from ..resilience import CircuitBreaker, faults as _faults
 from .metrics import ServingMetrics
 from .replica import ReplicaLostError
@@ -86,7 +88,7 @@ class _Slot:
 
 class _RouterRequest:
     __slots__ = ("rid", "inputs", "timeout_ms", "priority", "future",
-                 "dispatches", "replica_id", "t0", "lock", "done")
+                 "dispatches", "replica_id", "t0", "lock", "done", "span")
 
     def __init__(self, rid, inputs, timeout_ms, priority, now):
         self.rid = rid
@@ -100,6 +102,10 @@ class _RouterRequest:
         self.t0 = now
         self.lock = _locks.make_lock("serving.router.request")
         self.done = False
+        # the request's trace root: dispatch attempts and the remote
+        # worker's execute span parent into it (ends at _resolve)
+        self.span = _obs_trace.start_span("router.request", cat="serving",
+                                          rid=rid, priority=priority)
 
 
 class ReplicaRouter:
@@ -130,6 +136,11 @@ class ReplicaRouter:
             "interactive": float(
                 _config.get("MXNET_ROUTER_SHED_INTERACTIVE_MS"))}
         self.metrics = ServingMetrics(self.name)
+        # telemetry plane: this router's stats() under the stable
+        # 'router' namespace (dotted suffix for non-default names)
+        _obs_metrics.register_producer(
+            "router" if self.name == "router" else f"router.{self.name}",
+            self.stats)
         self._lock = _locks.make_lock("serving.router")
         self._slots = {}               # replica_id -> _Slot
         self._inflight = {}            # rid -> _RouterRequest
@@ -279,6 +290,7 @@ class ReplicaRouter:
             # caller's retry of the same request_id is refused forever
             with self._lock:
                 self._inflight.pop(rid, None)
+            req.span.end(outcome="rejected")
             raise
         return req.future
 
@@ -315,11 +327,14 @@ class ReplicaRouter:
             _faults.fire("router.dispatch", replica=req.replica_id,
                          rid=req.rid, attempt=req.dispatches)
             try:
-                inner = slot.replica.submit(req.inputs,
-                                            timeout_ms=req.timeout_ms,
-                                            rid=req.rid,
-                                            priority=PRIORITY_RANK[
-                                                req.priority])
+                # trace context: the replica's submit path (batcher
+                # enqueue / transport frame) parents into this request
+                with _obs_trace.activate(req.span):
+                    inner = slot.replica.submit(req.inputs,
+                                                timeout_ms=req.timeout_ms,
+                                                rid=req.rid,
+                                                priority=PRIORITY_RANK[
+                                                    req.priority])
             except ReplicaLostError:
                 self._on_replica_lost(slot)
                 return self._failover(req, exclude + (req.replica_id,))
@@ -402,6 +417,7 @@ class ReplicaRouter:
                 # bounded, oldest-first: idempotency only needs to
                 # cover the failover horizon, which is recent by nature
                 self._completed.pop(next(iter(self._completed)))
+        req.span.end(outcome="error" if error is not None else "ok")
         try:
             if error is not None:
                 req.future.set_exception(error)

@@ -32,6 +32,11 @@ class ModelServer:
         self._models = {}
         self._lock = _locks.make_lock("serving.server")
         self._closed = False
+        # telemetry plane: the per-model snapshots under 'server'
+        # (each model's ServingMetrics also self-registers under
+        # 'serving.<name>'; this is the whole-server view)
+        from ..obs import metrics as _obs_metrics
+        _obs_metrics.register_producer("server", self.stats)
 
     # -- model lifecycle -----------------------------------------------------
     def load_model(self, name, model=None, *, prefix=None, epoch=0,

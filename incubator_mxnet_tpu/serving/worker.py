@@ -36,6 +36,8 @@ import numpy as _np
 
 from ..analysis import locks as _locks
 from ..analysis import tsan as _tsan
+from ..obs import metrics as _obs_metrics
+from ..obs import trace as _obs_trace
 from .model import ServedModel
 
 __all__ = ["ReplicaWorker", "main"]
@@ -49,6 +51,9 @@ class ReplicaWorker:
         self.version = 0
         self._lock = _locks.make_lock("serving.worker")
         _tsan.instrument(self, "serving.worker")
+        # telemetry plane: this worker's counters under the 'worker'
+        # namespace, served by the 'metrics' frame below
+        _obs_metrics.register_producer("worker", self._obs_stats)
         self._outstanding = 0
         self._executed = 0
         self._dedup_hits = 0
@@ -81,6 +86,9 @@ class ReplicaWorker:
                     except (ConnectionError, OSError):
                         break
                     if msg.get("cmd") == "stop":
+                        # os._exit skips atexit: flush buffered spans
+                        # first or the merged trace loses this worker
+                        _obs_trace.flush()
                         os._exit(0)
 
         class Server(socketserver.ThreadingTCPServer):
@@ -91,12 +99,29 @@ class ReplicaWorker:
         self.port = self._server.server_address[1]
         self._thread = None
 
+    def _obs_stats(self):
+        with self._lock:
+            return {"executed": self._executed,
+                    "dedup_hits": self._dedup_hits,
+                    "outstanding": self._outstanding,
+                    "version": self.version,
+                    "programs": self.model.program_count()}
+
     # -- command dispatch ----------------------------------------------------
     def _handle(self, msg):
         cmd = msg.get("cmd")
         seq = msg.get("seq")
         if cmd == "infer":
-            return dict(self._infer(msg), seq=seq)
+            # the cross-process trace edge: adopt the router's span
+            # context from the frame so this execution is a CHILD of
+            # the dispatch that sent it
+            with _obs_trace.server_span(msg, "worker.infer",
+                                        cat="serving",
+                                        rid=msg.get("rid")):
+                return dict(self._infer(msg), seq=seq)
+        if cmd == "metrics":
+            from ..obs.scrape import metrics_reply
+            return metrics_reply(seq=seq)
         if cmd == "hb":
             with self._lock:
                 out = {"ok": True, "outstanding": self._outstanding,

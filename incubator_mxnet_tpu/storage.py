@@ -108,6 +108,10 @@ def default_pool():
         with _default_lock:
             if _default is None:
                 _default = HostStagingPool()
+                # telemetry plane: the staging pool's hit economy
+                # under the stable 'storage' namespace
+                from .obs import metrics as _obs_metrics
+                _obs_metrics.register_producer("storage", _default.stats)
     return _default
 
 

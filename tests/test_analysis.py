@@ -751,6 +751,10 @@ _SUPPRESSION_FIXTURES = {
         "class W:\n"
         "    def __init__(self):\n"
         "        threading.Thread(target=f, name='x').start()\n", 4),
+    "untracked-stats": (
+        "class KV:\n"
+        "    def stats(self):\n"
+        "        return {'pushes': 1}\n", 2),
 }
 
 

@@ -419,10 +419,11 @@ def test_faults_log_carries_rank_pid_and_is_line_atomic(tmp_path,
     monkeypatch.setenv("DMLC_RANK", "3")
     monkeypatch.setenv("MXNET_FAULTS_LOG", str(log))
     resilience.configure("demo.site:slow(ms=0,n=64)")
-    # re-read the env log path (configure keeps clauses, not the path)
+    # re-read the env log path (configure keeps clauses, not the path);
+    # the writes themselves ride the shared obs.jsonl_sink, which
+    # opens the fd for this fresh path on first append
     from incubator_mxnet_tpu.resilience import faults as _faults
     monkeypatch.setattr(_faults, "_log_path", str(log))
-    monkeypatch.setattr(_faults, "_log_fd", None)
     threads = [threading.Thread(
         target=lambda: [resilience.fire("demo.site") for _ in range(8)])
         for _ in range(4)]

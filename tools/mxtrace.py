@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""mxtrace — merge per-process telemetry into ONE Perfetto-loadable trace.
+
+Every process of a run appends its finished spans to the shared
+``MXNET_OBS_TRACE`` JSONL file (obs/trace.py), its fault events to
+``MXNET_FAULTS_LOG``, and its quarantine entries to the guardian's
+quarantine file — all through the one line-atomic sink
+(obs/jsonl_sink.py).  This tool reads any number of those files (plus
+profiler chrome-trace dumps) and writes one chrome-trace JSON where:
+
+* each process is a lane group (pid), each thread a lane (tid), every
+  span an ``X`` duration event carrying its trace/span/parent ids;
+* every cross-process (and cross-thread) parent->child link gets a
+  flow arrow (``s``/``f`` events), so a routed request reads as one
+  connected tree from the router's submit, through the transport rpc,
+  into the subprocess worker's execute — and a training step from
+  ``fit.step`` into the parameter server;
+* fault/quarantine JSONL events become instant events in their
+  process lane, aligned with the spans they disrupted.
+
+It also verifies span-tree integrity: an **orphan** is a span whose
+parent id appears nowhere in the merged set — the broken-propagation
+signal the obs CI stage gates to ZERO.
+
+Usage:
+    python tools/mxtrace.py SPANS.jsonl [MORE.jsonl ...] \
+        [--out merged_trace.json] [--json] [--check]
+
+    --out FILE   write the merged chrome trace (default: merged_trace.json
+                 next to the first input; '-' skips writing)
+    --json       print the summary as one JSON object
+    --check      exit 1 when any orphan span survives the merge
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _flow_id(span_id):
+    """Stable 31-bit int for chrome-trace flow binding ids."""
+    import zlib
+    return zlib.crc32(str(span_id).encode()) & 0x7FFFFFFF
+
+
+def _tid(thread_name):
+    import zlib
+    return zlib.crc32(str(thread_name or "main").encode()) & 0xFFFF
+
+
+def load_inputs(paths):
+    """Split input files into (span records, event records, chrome
+    events) by sniffing each line/file — span lines carry ``k ==
+    'span'``, profiler dumps are JSON objects with ``traceEvents``,
+    everything else JSONL-parseable is an event (faults, quarantine,
+    tsan dumps are skipped: they are one-line reports, not events)."""
+    spans, events, chrome = [], [], []
+    for path in paths:
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        except OSError as e:
+            print(f"mxtrace: cannot read {path}: {e}", file=sys.stderr)
+            continue
+        head = text.lstrip()[:1]
+        if head == "{" and '"traceEvents"' in text:
+            try:
+                chrome.extend(json.loads(text).get("traceEvents", []))
+                continue
+            except ValueError:
+                pass
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict):
+                continue
+            if rec.get("k") == "span":
+                spans.append(rec)
+            elif "lock_graph" in rec:
+                continue   # a tsan dump: a report, not a timeline event
+            else:
+                events.append(rec)
+    return spans, events, chrome
+
+
+def merge(spans, events=(), chrome=()):
+    """Build the chrome trace + integrity summary from loaded records."""
+    by_id = {s["sp"]: s for s in spans}
+    pids = {}
+    out_events = []
+    orphans = []
+    traces = {}
+    for s in spans:
+        pid = s.get("pid", 0)
+        tid = _tid(s.get("thread"))
+        pids.setdefault(pid, set()).add((tid, s.get("thread") or "main"))
+        args = dict(s.get("args") or {})
+        args.update(trace=s.get("tr"), span=s.get("sp"),
+                    parent=s.get("pa"), thread=s.get("thread"))
+        out_events.append({"ph": "X", "name": s.get("name", "?"),
+                           "cat": s.get("cat", "span"),
+                           "ts": s.get("ts", 0),
+                           "dur": max(int(s.get("dur", 0)), 1),
+                           "pid": pid, "tid": tid, "args": args})
+        traces.setdefault(s.get("tr"), []).append(s)
+        parent = s.get("pa")
+        if parent is None:
+            continue
+        p = by_id.get(parent)
+        if p is None:
+            orphans.append(s)
+            continue
+        if p.get("pid") != pid or _tid(p.get("thread")) != tid:
+            # the cross-lane edge: a flow arrow from the parent's span
+            # to the child's start — Perfetto draws the connected tree
+            fid = _flow_id(s["sp"])
+            out_events.append({"ph": "s", "cat": "flow", "name": "tr",
+                               "id": fid, "pid": p.get("pid", 0),
+                               "tid": _tid(p.get("thread")),
+                               "ts": p.get("ts", 0) + 1})
+            out_events.append({"ph": "f", "bp": "e", "cat": "flow",
+                               "name": "tr", "id": fid, "pid": pid,
+                               "tid": tid, "ts": s.get("ts", 0)})
+    for ev in events:
+        pid = ev.get("pid", 0)
+        tid = _tid(ev.get("thread"))
+        pids.setdefault(pid, set()).add((tid, ev.get("thread") or "main"))
+        name = ev.get("site") or ev.get("event") or ev.get("reason") \
+            or "event"
+        ts = float(ev.get("time", 0)) * 1e6
+        out_events.append({
+            "ph": "i", "s": "p", "name": str(name),
+            "cat": "fault" if ev.get("event") == "fault" else "event",
+            "ts": ts, "pid": pid, "tid": tid,
+            "args": {k: v for k, v in ev.items()
+                     if isinstance(v, (str, int, float, bool))}})
+    # lane naming metadata
+    for pid, tids in sorted(pids.items()):
+        out_events.append({"ph": "M", "name": "process_name", "pid": pid,
+                           "args": {"name": f"process {pid}"}})
+        for tid, tname in sorted(tids):
+            out_events.append({"ph": "M", "name": "thread_name",
+                               "pid": pid, "tid": tid,
+                               "args": {"name": tname}})
+    out_events.extend(chrome)
+    summary = {
+        "spans": len(spans),
+        "traces": len(traces),
+        "processes": len({s.get("pid", 0) for s in spans}) or 0,
+        "orphan_spans": len(orphans),
+        "orphans": [{"span": s.get("sp"), "name": s.get("name"),
+                     "parent": s.get("pa"), "pid": s.get("pid")}
+                    for s in orphans[:20]],
+        "events": len(events),
+    }
+    return {"traceEvents": out_events, "displayTimeUnit": "ms"}, summary
+
+
+def trace_tree(spans, trace_id):
+    """{span_id: [child ids]} plus roots for one trace (test helper)."""
+    children, roots = {}, []
+    ids = {s["sp"] for s in spans if s.get("tr") == trace_id}
+    for s in spans:
+        if s.get("tr") != trace_id:
+            continue
+        if s.get("pa") is None or s["pa"] not in ids:
+            roots.append(s["sp"])
+        else:
+            children.setdefault(s["pa"], []).append(s["sp"])
+    return {"roots": roots, "children": children}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="mxtrace", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="+",
+                    help="span/fault/quarantine JSONL files and/or "
+                         "profiler chrome-trace dumps")
+    ap.add_argument("--out", default=None,
+                    help="merged chrome-trace output path ('-' skips)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 when any orphan span survives")
+    args = ap.parse_args(argv)
+
+    spans, events, chrome = load_inputs(args.paths)
+    trace, summary = merge(spans, events, chrome)
+    out = args.out
+    if out is None:
+        out = os.path.join(os.path.dirname(os.path.abspath(args.paths[0]))
+                           or ".", "merged_trace.json")
+    if out != "-":
+        with open(out, "w") as f:
+            json.dump(trace, f)
+        summary["out"] = out
+    if args.as_json:
+        print(json.dumps(summary, indent=1))
+    else:
+        print("mxtrace: %d span(s) in %d trace(s) across %d process(es), "
+              "%d event(s), %d orphan span(s)%s"
+              % (summary["spans"], summary["traces"],
+                 summary["processes"], summary["events"],
+                 summary["orphan_spans"],
+                 f" -> {out}" if out != "-" else ""))
+        for o in summary["orphans"]:
+            print("  orphan: %(name)s span=%(span)s parent=%(parent)s "
+                  "pid=%(pid)s" % o)
+    if args.check and summary["orphan_spans"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -312,6 +312,31 @@ def tsan_stage():
     return out
 
 
+def obs_stage():
+    """Telemetry-plane stage: run tools/run_obs_gate.py --quick in a
+    throwaway process — a traced mini fused fit plus a serving burst
+    with a mid-flight replica kill, merged by mxtrace — and attach its
+    OBS_REPORT.json artifact to the round.  Gates: zero orphan spans
+    in the merged cross-process trace, tracing+metrics overhead < 2%
+    on the fused-step and serving hot paths (calibrated per-span cost
+    x measured span rate), and scrape output that parses as valid
+    Prometheus text with the core namespaces present.  Observability
+    claims become checkable evidence next to the parity outcomes."""
+    cmd = [sys.executable, os.path.join(REPO, "tools", "run_obs_gate.py"),
+           "--quick", "--json",
+           "--out", os.path.join(REPO, "OBS_REPORT.json")]
+    try:
+        out = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                             timeout=1800,
+                             env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        summary = json.loads(out.stdout)
+        summary["rc"] = out.returncode
+        summary.get("trace", {}).pop("orphans", None)
+        return summary
+    except Exception as exc:
+        return {"error": f"obs stage failed: {exc!r}"}
+
+
 def scaling_stage():
     """Scaling-curve stage: run tools/run_scaling.py --quick in a
     throwaway process — the dp=1/2/4/8 sweep over host-platform virtual
@@ -378,6 +403,7 @@ def main():
         "coldstart": coldstart_stage(),
         "scaling": scaling_stage(),
         "tsan": tsan_stage(),
+        "obs": obs_stage(),
         "cmd": " ".join(cmd[2:]),
         "tests": tests[:500],
         "tail": "\n".join(output.strip().splitlines()[-12:])[-2000:],
