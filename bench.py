@@ -606,6 +606,16 @@ def _run_real_data(batch, image, steps, dtype="float32"):
         shutil.rmtree(d, ignore_errors=True)
 
 
+def _h2d_probe(batch, image, n_bufs=12):
+    """memcpy / blocking / pipelined-ring MB/s — ONE implementation
+    shared with the run_io_bench CI gate (tools/bench_io.h2d_probe)."""
+    import sys as _sys
+    _sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    from bench_io import h2d_probe
+    return h2d_probe(batch, image, n_bufs=n_bufs)
+
+
 _REAL_PREFETCH = 8
 
 
@@ -821,21 +831,41 @@ def main():
     if os.environ.get("BENCH_REAL_DATA", "1") == "1" and left() > 180:
         _RESULT["phase"] = "real-data"
         try:
-            # raw H2D rate: says whether this lane is transfer-bound (dev
-            # tunnel ~90 MB/s) or pipeline-bound (real host, GB/s PCIe)
-            buf = np.random.rand(batch, 3, image, image).astype("f4")
-            t0 = time.perf_counter()
-            jax.block_until_ready(jax.device_put(buf))
-            h2d = buf.nbytes / (time.perf_counter() - t0) / 1e6
-            _RESULT["h2d_MBps"] = round(h2d, 1)
+            # h2d three ways: memcpy ceiling, the old BLOCKING device_put
+            # baseline, and the pipelined staging-ring rate (io_plane) —
+            # says whether this lane is transfer-bound (dev tunnel
+            # ~90 MB/s) or pipeline-bound (real host, GB/s PCIe)
+            h2d_probe = _h2d_probe(batch, image)
+            h2d = h2d_probe["blocking_MBps"]
+            _RESULT["h2d_MBps"] = h2d
+            _RESULT["h2d_pipelined_MBps"] = h2d_probe["pipelined_MBps"]
             # device-augment pipeline: batches cross as uint8 NHWC (the
             # normalize/cast finish is in-graph), a quarter of fp32 bytes
+            from incubator_mxnet_tpu import io_plane as _io_plane
+            io_before = _io_plane.stats()
             real, pipe = _run_real_data(batch, image, steps, dtype)
+            io_after = _io_plane.stats()
             _RESULT["real_data_img_s"] = round(real, 2)
             _RESULT["io_pipeline_img_s"] = round(pipe, 2)
             base = img_s
             if base:
                 _RESULT["real_data_vs_synthetic"] = round(real / base, 3)
+            # the io lane: probe numbers + the training run's own ring
+            # occupancy/stall evidence (io.* is the obs namespace too)
+            fit_batches = io_after["batches"] - io_before["batches"]
+            fit_stalls = io_after["stalls"] - io_before["stalls"]
+            _RESULT["io"] = {
+                **h2d_probe,
+                "real_vs_synthetic": round(real / base, 3) if base
+                else None,
+                "ring_batches": fit_batches,
+                "ring_stall_pct": round(100.0 * fit_stalls /
+                                        max(fit_batches, 1), 2),
+                "ring_stall_s": round(io_after["stall_s"] -
+                                      io_before["stall_s"], 4),
+                "zero_copy_transfers": io_after["zero_copy"] -
+                io_before["zero_copy"],
+            }
             if real > 1.15 * max(pipe, 1e-9) and real > 0.9 * (base or real):
                 # can't train faster than the pipeline decodes unless the
                 # window was fed from the prefetch buffer — flag it

@@ -110,6 +110,9 @@ CODE_TABLE = _build_code_table([
     ("untracked-stats", WARN, ("source.obs",),
      "public stats() dict not registered with the obs MetricsRegistry; "
      "invisible to the scrape plane"),
+    ("blocking-h2d-in-loop", WARN, ("source.io",),
+     "blocking device_put/as_in_context feed inside a training loop; "
+     "the h2d staging ring (MXNET_IO_RING) overlaps the transfer"),
     # -- runtime trace passes ------------------------------------------------
     ("shape-churn", WARN, ("trace.recompile",),
      "new jit signature forced a fresh XLA compile (ragged batches etc.)"),

@@ -67,6 +67,14 @@ walking a script's AST:
   (`hidden-host-transfer`) is the runtime-graph side of the same
   hazard.  Move the computation in-graph or hoist the read out of the
   traced region.
+* ``blocking-h2d-in-loop`` — a direct host→device feed
+  (`jax.device_put` / `.as_in_context(...)`) lexically inside a
+  TRAINING loop (one whose body also runs `fit`/`fit_step`/
+  `forward_backward`/a trainer's ``.step``): the transfer serializes
+  with the step it feeds — the 13.8 MB/s h2d failure mode.  The
+  prefetch ring (``MXNET_IO_RING``, `io_plane.DevicePrefetchIter`)
+  stages and transfers batches on the ``mx-io-h2d`` thread with
+  device-resident prefetch; feed the loop from it instead.
 * ``unsupervised-collective`` — a host-level cross-host collective
   dispatch (`collectives.all_reduce` / `all_gather` / `reduce_scatter` /
   `ppermute` / a collective plane's `allreduce`) outside a supervisor/
@@ -166,7 +174,8 @@ _PASS_BY_CODE = {"host-sync-in-loop": "source.hostsync",
                  "bare-acquire": "source.locks",
                  "sleep-under-lock": "source.locks",
                  "unjoined-thread-in-init": "source.thread",
-                 "untracked-stats": "source.obs"}
+                 "untracked-stats": "source.obs",
+                 "blocking-h2d-in-loop": "source.io"}
 
 # identifiers that mark a with-scope as a critical section for the
 # sleep-under-lock lint (token substrings of the context expression)
@@ -208,9 +217,36 @@ class _Visitor(ast.NodeVisitor):
         self.lock_with_depth = 0   # inside a `with <lock-ish>:` block
         self.stats_defs = []       # (lineno, class name) of `def stats`
         self.registers_producer = False   # file calls register_producer
+        self._h2d_seen = set()     # node ids already flagged (nested loops)
 
     # -- loops ---------------------------------------------------------------
+    def _check_blocking_h2d(self, node):
+        """A TRAINING loop (its body runs a training update) that also
+        feeds arrays to the device directly: every `device_put` /
+        `.as_in_context()` there blocks the loop on the transfer it
+        could have overlapped — the h2d staging ring's job."""
+        if self._train_update_call(node) is None:
+            return
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call) or id(sub) in self._h2d_seen:
+                continue
+            func = sub.func
+            name = func.attr if isinstance(func, ast.Attribute) else \
+                func.id if isinstance(func, ast.Name) else None
+            if name in ("device_put", "as_in_context"):
+                self._h2d_seen.add(id(sub))
+                self._add(
+                    "blocking-h2d-in-loop", sub.lineno,
+                    f"{name}() inside a training loop blocks the step on "
+                    "its own input transfer; the h2d staging ring "
+                    "(MXNET_IO_RING / io_plane.DevicePrefetchIter) "
+                    "decodes, stages and transfers batch k+1 on the "
+                    "mx-io-h2d thread while batch k computes — feed the "
+                    "loop from the ring (Module.fit wraps its iterator "
+                    "automatically)")
+
     def _loop(self, node):
+        self._check_blocking_h2d(node)
         targets = set()
         if isinstance(node, (ast.For, ast.AsyncFor)):
             for sub in ast.walk(node.target):

@@ -411,6 +411,44 @@ KNOBS = {
                          "an MXNetError at the acquisition site instead "
                          "of only recording a finding (the lock is "
                          "released before raising)"),
+    # -- production data plane (io_plane.py) ---------------------------------
+    "MXNET_IO_RING": (_BOOL, True, "honored",
+                      "h2d staging ring: Module.fit (and the gluon "
+                      "Estimator) wrap the training iterator in a "
+                      "DevicePrefetchIter — batches stage into reusable "
+                      "host buffers, transfer on a dedicated mx-io-h2d "
+                      "thread, and park in a device-resident prefetch "
+                      "queue, so the train loop never blocks on "
+                      "device_put; 0 restores the blocking path"),
+    "MXNET_IO_PREFETCH": (int, 3, "honored",
+                          "device-resident prefetch depth of the h2d "
+                          "ring (bounded queue of already-transferred "
+                          "batches; floor 2 — double buffering is the "
+                          "minimum that overlaps transfer with compute)"),
+    "MXNET_IO_STAGING": (_BOOL, True, "honored",
+                         "assemble batches into reusable preallocated "
+                         "host staging buffers before transfer (the "
+                         "pinned-memory pattern: stable buffers, one "
+                         "copy that also applies the dtype cast); 0 "
+                         "transfers straight from the producer's arrays"),
+    "MXNET_IO_UINT8_WIRE": (_BOOL, True, "honored",
+                            "ImageRecordIter(device_augment='auto') "
+                            "resolves to uint8-on-the-wire: the host "
+                            "stops at crop+mirror and ships uint8 NHWC "
+                            "(4x fewer h2d bytes than fp32), with "
+                            "normalize/cast/layout fused into the step "
+                            "program via normalize_symbol (explicit "
+                            "device_augment=True/False always wins)"),
+    "MXNET_IO_AUTO_SHARD": (_BOOL, True, "honored",
+                            "an EXPLICIT num_parts='auto' on RecordIO-"
+                            "backed iterators splits the record set by "
+                            "this process's (rank, world) — DMLC_RANK/"
+                            "DMLC_NUM_WORKER or the jax process grid — "
+                            "re-resolved at every reset(), so "
+                            "shrink-and-resume re-shards on the epoch "
+                            "fence; 0 forces even 'auto' to a single "
+                            "part (unset num_parts NEVER shards: eval "
+                            "iterators must score the full set)"),
     # -- unified telemetry plane (obs/) --------------------------------------
     "MXNET_OBS_TRACE": (str, "", "honored",
                         "obs/trace.py: shared span JSONL file enabling "

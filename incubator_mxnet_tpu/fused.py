@@ -1871,6 +1871,16 @@ class FusedTrainStep:
         except Exception:
             self._prestaged = None
 
+    def ring_placement(self):
+        """This step's staging target for the h2d ring
+        (`io_plane.RingPlacement`): the data sharding plus per-input
+        target dtypes, exactly what `_stage_inputs` produces — so ring
+        batches are adopted by sharding identity with no second
+        transfer and no signature churn (zero steady-state
+        recompiles)."""
+        from .io_plane import RingPlacement
+        return RingPlacement.for_fused_step(self)
+
     def set_block_cursor(self, j):
         """Point `get_outputs()` AND the in-graph metrics at logical
         step j of the last block — the fit loop calls this as it fires

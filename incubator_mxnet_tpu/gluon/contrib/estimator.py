@@ -232,6 +232,23 @@ class Estimator:
             from ..fused_step import GluonFusedStep
             fused = self._fused = GluonFusedStep.try_build(
                 self.net, self.loss, self.trainer, self.train_metrics)
+        # h2d staging ring (io_plane.py, MXNET_IO_RING): wrap the
+        # training loader so (data, label) pairs transfer on the
+        # mx-io-h2d thread with device-resident prefetch — the fused
+        # Gluon step's device_put then adopts already-placed buffers
+        # and the Trainer never blocks on a transfer
+        io_loader = None
+        if fused is not None and _config.get("MXNET_IO_RING"):
+            from ... import io_plane as _io_plane
+            ctx = self._ctx()
+            if ctx is not None and \
+                    not isinstance(train_data,
+                                   _io_plane.DevicePrefetchLoader):
+                try:
+                    train_data = io_loader = \
+                        _io_plane.DevicePrefetchLoader(train_data, ctx=ctx)
+                except Exception:
+                    io_loader = None
         handlers = list(event_handlers or [LoggingHandler()])
         # block mode: K batches per dispatch as ONE lax.scan program
         # (gluon/fused_step.py call_block) — handlers still fire per batch,
@@ -333,6 +350,9 @@ class Estimator:
                     h.epoch_end(self)
         except StopTraining as e:
             logging.getLogger("Estimator").info(str(e))
+        finally:
+            if io_loader is not None:
+                io_loader.close()
         for h in handlers:
             h.train_end(self)
         return self

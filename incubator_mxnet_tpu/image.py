@@ -247,9 +247,9 @@ class ImageIter(DataIter):
 
     def __init__(self, batch_size, data_shape, label_width=1,
                  path_imgrec=None, path_imglist=None, path_root=None,
-                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
-                 imglist=None, data_name="data", label_name="softmax_label",
-                 **kwargs):
+                 shuffle=False, part_index=None, num_parts=None,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="softmax_label", **kwargs):
         super().__init__(batch_size)
         assert path_imgrec or path_imglist or imglist
         self.data_shape = tuple(data_shape)
@@ -286,9 +286,16 @@ class ImageIter(DataIter):
             self.imglist = {i: (np.asarray(l, dtype="float32"), p)
                             for i, (l, p) in enumerate(imglist)}
             self.seq = list(self.imglist.keys())
-        if self.seq is not None and num_parts > 1:
-            n = len(self.seq) // num_parts
-            self.seq = self.seq[part_index * n:(part_index + 1) * n]
+        # per-host sharding over the sequence: `recordio.shard_range`
+        # (disjoint/exhaustive — the old `len//num_parts` slice silently
+        # DROPPED the remainder records); num_parts=None/'auto' resolves
+        # from the dist environment, re-checked at reset() so a shrunk
+        # pod re-shards on the epoch fence
+        self._full_seq = list(self.seq) if self.seq is not None else None
+        self._part_index_req = part_index
+        self._num_parts_req = num_parts
+        self._quarantined_ids = set()
+        self._reshard_seq()
         self.cur = 0
         self.data_name = data_name
         self.label_name = label_name
@@ -307,14 +314,41 @@ class ImageIter(DataIter):
 
     def apply_quarantine(self, entries):
         """Drop records previously quarantined for this source (resume
-        path): their ids never enter the epoch sequence again."""
+        path): their ids never enter the epoch sequence again — held on
+        the quarantine set so an epoch-fence re-shard cannot resurrect
+        them."""
         if self.seq is None:
             return
         bad = {int(e["record"]) for e in entries
                if e.get("record") is not None and e.get("source") in (
                    None, getattr(self.imgrec, "uri", None))}
         if bad:
+            self._quarantined_ids.update(bad)
             self.seq = [k for k in self.seq if k not in bad]
+
+    def _reshard_seq(self):
+        """This epoch's sequence from the full list: the resolved shard
+        window (`recordio.shard_range`) minus quarantined ids."""
+        if self._full_seq is None:
+            return
+        pi, nparts = self._part_index_req, self._num_parts_req
+        if nparts == "auto":
+            # explicit opt-in only (an unset num_parts must not shard
+            # eval iterators in dist runs); MXNET_IO_AUTO_SHARD=0 is
+            # the ops off-switch
+            from . import config as _config
+            from . import io_plane as _io_plane
+            if _config.get("MXNET_IO_AUTO_SHARD"):
+                pi, nparts = _io_plane.auto_shard(
+                    pi if pi != "auto" else None, None)
+            else:
+                pi, nparts = 0, 1
+        elif nparts in (None, 0):
+            pi, nparts = 0, 1
+        lo, hi = _recordio.shard_range(len(self._full_seq), int(nparts),
+                                       int(pi or 0))
+        bad = self._quarantined_ids
+        self.seq = [k for k in self._full_seq[lo:hi] if k not in bad]
 
     def _corrupt_sample(self, idx, exc):
         self.corrupt_records += 1
@@ -350,6 +384,9 @@ class ImageIter(DataIter):
         return [DataDesc(self.label_name, shape)]
 
     def reset(self):
+        # the epoch fence: re-resolve the shard (a shrunk pod's
+        # rewritten rank/world re-splits the sequence here)
+        self._reshard_seq()
         if self.shuffle and self.seq is not None:
             _pyrandom.shuffle(self.seq)
         if self.imgrec is not None and self.seq is None:
@@ -429,7 +466,7 @@ class ImageRecordIterImpl(DataIter):
     def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
                  shuffle=False, rand_crop=False, rand_mirror=False,
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
-                 std_b=1.0, resize=0, part_index=0, num_parts=1,
+                 std_b=1.0, resize=0, part_index=None, num_parts=None,
                  preprocess_threads=None, prefetch_buffer=4,
                  round_batch=True, data_name="data",
                  label_name="softmax_label", seed=0, fast_decode=True,
@@ -445,6 +482,10 @@ class ImageRecordIterImpl(DataIter):
         self._rand_mirror = rand_mirror
         self._resize = resize
         self._mean = np.array([mean_r, mean_g, mean_b], dtype="float32")
+        # keep the ORIGINAL std too: normalize_symbol passes it to the
+        # in-graph ImageNormalize, whose f32 reciprocal then matches the
+        # host kernel's `_stdinv` bit-for-bit (uint8-wire parity)
+        self._std = np.array([std_r, std_g, std_b], dtype="float32")
         self._stdinv = 1.0 / np.array([std_r, std_g, std_b], dtype="float32")
         # clamp to physical cores: batch builders are CPU-bound (decode +
         # augment), so threads beyond the core count only add GIL ping-pong
@@ -473,6 +514,13 @@ class ImageRecordIterImpl(DataIter):
         # passes on a busy CPU); normalize/cast/NCHW become graph ops —
         # compose the model with `self.normalize_symbol(data)` (the
         # ImageNormalize op), which XLA fuses into the first conv.
+        # 'auto'/None-as-string resolves from MXNET_IO_UINT8_WIRE — the
+        # production data-plane default (bench io lane, run_io_bench);
+        # an explicit True/False always wins.
+        if isinstance(device_augment, str) and \
+                device_augment.lower() in ("auto", "none"):
+            from . import config as _config
+            device_augment = bool(_config.get("MXNET_IO_UINT8_WIRE"))
         self._device_augment = bool(device_augment)
 
         import mmap
@@ -492,16 +540,52 @@ class ImageRecordIterImpl(DataIter):
                 "ImageRecordIter: %s holds %d corrupt region(s); the "
                 "damaged records are skipped (corrupt_records counts "
                 "them)", path_imgrec, n_corrupt)
-        if num_parts > 1:
-            # contiguous shards; the remainder spreads over the first
-            # parts so every record belongs to exactly one part
-            n, rem = divmod(len(self._records), num_parts)
-            start = part_index * n + min(part_index, rem)
-            stop = start + n + (1 if part_index < rem else 0)
-            self._records = self._records[start:stop]
-        self._order = np.arange(len(self._records))
+        # per-host input sharding: record ids stay GLOBAL (indexes into
+        # the full record list) so quarantine entries keep attributing
+        # after a re-shard; the shard only restricts the epoch ORDER.
+        # num_parts=None/0/'auto' auto-resolves from this process's
+        # (rank, world) — re-resolved at every reset(), so the
+        # supervisor's shrink-and-resume re-shards on the epoch fence.
+        self._part_index_req = part_index
+        self._num_parts_req = num_parts
+        self._quarantined = set()
+        self.part_index = 0
+        self.num_parts = 1
         self._pool = None
         self.reset()
+
+    def _resolve_parts(self):
+        """(part_index, num_parts) for the NEXT epoch.  Only an
+        EXPLICIT ``num_parts='auto'`` consults the dist environment
+        (`io_plane.auto_shard`) — an unset num_parts must stay
+        unsharded, or every validation/eval iterator in a dist run
+        would silently score 1/N of its data.  MXNET_IO_AUTO_SHARD=0
+        is the ops off-switch forcing even 'auto' to a single part."""
+        pi, nparts = self._part_index_req, self._num_parts_req
+        if nparts == "auto":
+            from . import config as _config
+            if _config.get("MXNET_IO_AUTO_SHARD"):
+                from . import io_plane as _io_plane
+                return _io_plane.auto_shard(pi if pi != "auto" else None,
+                                            None)
+            return 0, 1
+        if nparts in (None, 0):
+            return 0, 1
+        return int(pi or 0), int(nparts)
+
+    def _reshard(self):
+        """Recompute this epoch's record order from the resolved shard
+        (`recordio.shard_range`: disjoint, exhaustive, deterministic),
+        minus quarantined ids."""
+        self.part_index, self.num_parts = self._resolve_parts()
+        lo, hi = _recordio.shard_range(len(self._records),
+                                       self.num_parts, self.part_index)
+        if self._quarantined:
+            self._order = np.asarray(
+                [i for i in range(lo, hi) if i not in self._quarantined],
+                dtype=np.int64)
+        else:
+            self._order = np.arange(lo, hi, dtype=np.int64)
 
     @property
     def provide_data(self):
@@ -524,24 +608,38 @@ class ImageRecordIterImpl(DataIter):
         with this iterator's mean/std."""
         from . import symbol as _sym
         mean = tuple(float(v) for v in self._mean)
-        std = tuple(float(1.0 / v) for v in self._stdinv)
+        # the ORIGINAL std values, not a 1/(1/std) float roundtrip: the
+        # op's own f32 reciprocal then equals the host kernel's _stdinv
+        # bit-for-bit, so uint8-wire + in-graph normalize reproduces the
+        # host-side fp32 path EXACTLY
+        std = tuple(float(v) for v in self._std)
         return _sym.ImageNormalize(
             data, mean=mean, std=std, input_layout="NHWC",
             output_layout="NCHW", dtype=dtype)
 
-    def reset(self):
+    def _rebuild_pool(self):
+        """(Re)build the batch pool over the current epoch order.
+        Reference round_batch semantics: the tail partial batch wraps
+        around to the epoch start and reports the wrapped count as
+        pad."""
         if self._pool is not None:
             self._pool.stop()
-        if self._shuffle:
-            self._rng.shuffle(self._order)
-        self._epoch += 1
-        # reference round_batch semantics: the tail partial batch wraps
-        # around to the epoch start and reports the wrapped count as pad
         n = len(self._order)
         n_batches = (-(-n // self.batch_size) if self._round_batch and
                      n % self.batch_size else n // self.batch_size)
         self._pool = _BatchPool(self._build_batch, n_batches, self._threads,
                                 self._prefetch)
+
+    def reset(self):
+        # the epoch fence: the shard re-resolves here, so a pod that
+        # shrank (DMLC_NUM_WORKER rewritten by shrink-and-resume) walks
+        # the re-split record set from the next epoch on
+        # (_rebuild_pool below stops the previous pool)
+        self._reshard()
+        if self._shuffle:
+            self._rng.shuffle(self._order)
+        self._epoch += 1
+        self._rebuild_pool()
 
     def set_quarantine(self, log):
         """Attach a quarantine log: corrupt records the batch builders
@@ -559,13 +657,20 @@ class ImageRecordIterImpl(DataIter):
                if e.get("record") is not None and
                e.get("source") in (None, self._path_imgrec)}
         if bad:
+            # poisoned ids are remembered on the QUARANTINE SET (not by
+            # editing one epoch's order): every future _reshard()
+            # excludes them, so a re-shard on the epoch fence cannot
+            # resurrect a diagnosed record — and a quarantined record on
+            # ANOTHER host's shard simply never intersects this order
+            # (the poison stays local to the shard that read it)
+            self._quarantined.update(bad)
             self._order = np.asarray(
-                [i for i in self._order if int(i) not in bad])
+                [i for i in self._order if int(i) not in bad],
+                dtype=np.int64)
             # rebuild the batch pool for the shorter order without
             # advancing the epoch counter (reset() increments it, and
             # the augmentation RNG streams key on the epoch)
-            self._epoch -= 1
-            self.reset()
+            self._rebuild_pool()
 
     def record_range(self, nbatch):
         """(source, lo, hi) record-position range batch `nbatch` of this
@@ -810,12 +915,13 @@ class _BatchPool:
         self._task = iter(range(n_batches))
         self._task_lock = _alocks.make_lock("image.batchpool.tasks")
         self._threads = [threading.Thread(target=self._work, daemon=True,
-                                          name=f"mx-image-worker-{i}")
+                                          name=f"mx-io-decode-{i}")
                          for i in range(n_threads)]
         for t in self._threads:
             t.start()
 
     def _work(self):
+        from .obs import metrics as _metrics, trace as _trace
         while not self._stop_evt.is_set():
             with self._task_lock:
                 bidx = next(self._task, None)
@@ -829,11 +935,14 @@ class _BatchPool:
                 if self._stop_evt.is_set():
                     return
             try:
-                out = self._build(bidx)
+                with _trace.span("io.decode", cat="io", batch=bidx):
+                    out = self._build(bidx)
             except BaseException as e:   # deliver to the consumer, always
                 out = _WorkerError(e)
             with self._cond:
                 self._results[bidx] = out
+                _metrics.registry().gauge("io.decode.queue_depth").set(
+                    len(self._results))
                 self._cond.notify_all()
 
     def next(self):

@@ -22,7 +22,19 @@ from .ndarray.ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
-           "LibSVMIter", "pad_to_bucket"]
+           "LibSVMIter", "pad_to_bucket", "DevicePrefetchIter",
+           "H2DRing", "RingPlacement", "auto_shard"]
+
+
+def __getattr__(name):
+    # the h2d staging ring lives in io_plane.py (which imports this
+    # module for DataIter/DataBatch): re-exported lazily to avoid the
+    # circular import while keeping the public `mx.io.*` surface
+    if name in ("DevicePrefetchIter", "H2DRing", "RingPlacement",
+                "auto_shard"):
+        from . import io_plane
+        return getattr(io_plane, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 class DataDesc:

@@ -447,6 +447,15 @@ class BaseModule:
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params, mesh=mesh)
         sup = self._start_supervisor()
+        # h2d staging ring (io_plane.py, MXNET_IO_RING): wrap the
+        # training iterator so batches decode, stage into reusable host
+        # buffers, and transfer on the mx-io-h2d thread WHILE the
+        # current step computes — the fit loop pops device-resident
+        # batches and never blocks on device_put.  Wrapped here (after
+        # init_optimizer) so the fused step's exact staging target —
+        # data sharding + per-input dtypes — binds the ring; checkpoint
+        # capture, guardian quarantine and seek all delegate through.
+        train_data, io_ring = self._wrap_io_ring(train_data)
         if checkpoint_dir is not None:
             from .. import checkpoint as _ckpt
             # dist layout: the resolved kvstore names this process's rank —
@@ -513,6 +522,13 @@ class BaseModule:
             server_lost = True   # either failover signal must not be
             raise                # masked by a deferred flush error
         finally:
+            if io_ring is not None:
+                # stop the feeder thread and drop read-ahead; the INNER
+                # iterator stays usable for the caller/restart loop
+                try:
+                    io_ring._pause()
+                except Exception:
+                    pass
             if sup is not None:
                 # stop the heartbeat loop but KEEP self._supervisor: the
                 # restart loop's shrink barrier still needs its identity
@@ -534,6 +550,33 @@ class BaseModule:
                         raise
                 finally:
                     ckpt_mgr.close()
+
+    def _wrap_io_ring(self, train_data):
+        """Wrap the training iterator with the h2d staging ring
+        (io_plane.DevicePrefetchIter) when MXNET_IO_RING is on and a
+        fused train step is live to provide the placement.  Returns
+        ``(iterator, ring_or_None)``; the caller closes the ring when
+        the attempt ends."""
+        from .. import config as _config
+        fs = getattr(self, "_fused_step", None)
+        if fs is None or getattr(fs, "broken", False) or \
+                not _config.get("MXNET_IO_RING"):
+            return train_data, None
+        from .. import io_plane as _io_plane
+        if isinstance(train_data, _io_plane.DevicePrefetchIter):
+            return train_data, None
+        if not hasattr(train_data, "next") or \
+                not hasattr(train_data, "reset"):
+            return train_data, None   # a bare iterable: leave it alone
+        try:
+            wrapped = _io_plane.DevicePrefetchIter(
+                train_data, placement=fs.ring_placement, name="fit")
+        except Exception as e:
+            self.logger.warning(
+                "h2d ring unavailable (%s); using the blocking input "
+                "path", str(e)[:200])
+            return train_data, None
+        return wrapped, wrapped
 
     def _start_supervisor(self):
         """Attach a `JobSupervisor` to a multi-worker dist fit: heartbeat

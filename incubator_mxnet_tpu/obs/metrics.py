@@ -13,7 +13,9 @@ own dict and its own reader.  The registry gives them one product:
 * **producers** — every existing ``stats()`` dict registers under a
   stable dotted namespace (``kvstore``, ``router``, ``fleet``,
   ``supervisor``, ``guardian``, ``cache``, ``serving.<model>``,
-  ``worker``, ``profiler``...) via `register_producer(ns, fn)`.  The
+  ``worker``, ``profiler``, ``io`` — the data plane's h2d ring:
+  prefetch depth, occupancy, stalls, bytes, decode queue depth...)
+  via `register_producer(ns, fn)`.  The
   callable is only invoked at scrape time, so a registered subsystem
   pays NOTHING between scrapes; bound methods are held weakly, so
   registration can never leak a router or a kvstore.

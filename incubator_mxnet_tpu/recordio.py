@@ -34,7 +34,33 @@ _log = logging.getLogger(__name__)
 _WARN_CAP = 5   # per-reader structured warnings before dropping to debug
 
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
-           "pack_img", "unpack_img"]
+           "pack_img", "unpack_img", "shard_range", "shard_ranges"]
+
+
+def shard_range(n, num_parts, part_index):
+    """THE per-host input-partition rule: contiguous ``[start, stop)``
+    over `n` records for shard `part_index` of `num_parts`, with the
+    remainder spread over the first shards.  Deterministic, disjoint
+    and exhaustive — every record belongs to exactly one shard, and the
+    same ``(n, num_parts, part_index)`` always yields the same range
+    (the resume/re-shard invariant the epoch fence relies on).  Shared
+    by `ImageRecordIter`/`ImageIter` auto-sharding and the data-plane
+    tests."""
+    n = int(n)
+    num_parts = int(num_parts)
+    part_index = int(part_index)
+    if num_parts < 1 or not 0 <= part_index < num_parts:
+        raise MXNetError(
+            f"shard_range: part_index {part_index} out of range for "
+            f"num_parts {num_parts}")
+    per, rem = divmod(n, num_parts)
+    start = part_index * per + min(part_index, rem)
+    return start, start + per + (1 if part_index < rem else 0)
+
+
+def shard_ranges(n, num_parts):
+    """Every shard's ``(start, stop)`` under `shard_range`'s rule."""
+    return [shard_range(n, num_parts, p) for p in range(int(num_parts))]
 
 _MAGIC = 0xced7230a
 _CFLAG_BITS = 29

@@ -755,6 +755,11 @@ _SUPPRESSION_FIXTURES = {
         "class KV:\n"
         "    def stats(self):\n"
         "        return {'pushes': 1}\n", 2),
+    "blocking-h2d-in-loop": (
+        "import jax\n"
+        "for batch in it:\n"
+        "    x = jax.device_put(batch)\n"
+        "    mod.fit_step(x, metric)\n", 3),
 }
 
 

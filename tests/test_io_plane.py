@@ -1,0 +1,438 @@
+"""Production data plane (io_plane.py): h2d staging ring, per-host
+sharded readers, device-resident prefetch, uint8-on-the-wire parity."""
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import io_plane, recordio
+from incubator_mxnet_tpu.image import ImageRecordIterImpl
+from incubator_mxnet_tpu.io import DataBatch, DataDesc, NDArrayIter
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    x = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    x = mx.sym.BatchNorm(x, name="bn1")
+    x = mx.sym.Activation(x, act_type="relu")
+    x = mx.sym.FullyConnected(x, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(x, name="softmax")
+
+
+def _iter(n=48, bs=8, dim=12, seed=0):
+    rng = np.random.RandomState(seed)
+    return NDArrayIter(rng.randn(n, dim).astype("f4"),
+                       rng.randint(0, 4, n).astype("f4"), batch_size=bs)
+
+
+def _fit(num_epoch=2, seed=0):
+    mx.random.seed(7)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(_iter(seed=seed), num_epoch=num_epoch, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            eval_metric="acc", initializer=mx.initializer.Xavier(),
+            kvstore=None)
+    return mod
+
+
+def _sha(mod):
+    args, auxs = mod.get_params()
+    h = hashlib.sha256()
+    for d in (args, auxs):
+        for k in sorted(d):
+            h.update(k.encode())
+            h.update(d[k].asnumpy().tobytes())
+    return h.hexdigest()
+
+
+def _write_rec(path, n=16, size=28, seed=1):
+    """A small .rec of decodable PNGs, label i on record i."""
+    w = recordio.MXRecordIO(path, "w")
+    rng = np.random.RandomState(seed)
+    for i in range(n):
+        img = (rng.rand(size, size + 2, 3) * 255).astype("uint8")
+        w.write(recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, img_fmt=".png"))
+    w.close()
+
+
+# ---------------------------------------------------------------------------
+# the ring itself
+# ---------------------------------------------------------------------------
+
+def test_ring_preserves_content_and_order():
+    it = _iter(n=40, bs=8)
+    ref = [(b.data[0].asnumpy().copy(), b.label[0].asnumpy().copy())
+           for b in it]
+    it.reset()
+    w = io_plane.DevicePrefetchIter(it)
+    got = [(np.asarray(b.data[0]._data).copy(),
+            np.asarray(b.label[0]._data).copy()) for b in w]
+    w.close()
+    assert len(got) == len(ref)
+    for (rd, rl), (gd, gl) in zip(ref, got):
+        np.testing.assert_array_equal(rd, gd)
+        np.testing.assert_array_equal(rl, gl)
+
+
+def test_ring_slot_reuse_never_corrupts_in_flight_batches():
+    """Hold EVERY emitted device batch alive across the whole epoch and
+    verify afterwards — the zero-copy-adoption hazard (a refilled
+    staging slot mutating an already-emitted batch) regression test."""
+    it = _iter(n=80, bs=8)
+    ref = [b.data[0].asnumpy().copy() for b in it]
+    it.reset()
+    w = io_plane.DevicePrefetchIter(it)
+    held = [b.data[0] for b in w]
+    for r, h in zip(ref, held):
+        np.testing.assert_array_equal(r, np.asarray(h._data))
+    w.close()
+
+
+def test_feeder_failure_surfaces_not_hangs():
+    """A transfer/iterator failure on the mx-io-h2d thread must raise on
+    the consumer, never leave it waiting on a dead feeder."""
+    class Exploding(NDArrayIter):
+        def next(self):
+            b = super().next()
+            if self.cursor >= 2 * self.batch_size:
+                raise ValueError("decode exploded")
+            return b
+
+    w = io_plane.DevicePrefetchIter(
+        Exploding(np.zeros((32, 4), "f4"), np.zeros(32, "f4"),
+                  batch_size=8))
+    with pytest.raises(ValueError, match="decode exploded"):
+        for _ in range(10):
+            w.next()
+    w.close()
+
+
+def test_iter_next_protocol_returns_every_batch():
+    """The DataIter protocol (iter_next()/next() pairs) must yield every
+    batch exactly once — iter_next buffers, next returns the buffer."""
+    w = io_plane.DevicePrefetchIter(_iter(n=40, bs=8))
+    seen = []
+    while w.iter_next():
+        seen.append(w.next().data[0].asnumpy().copy())
+    w.close()
+    ref = [b.data[0].asnumpy() for b in _iter(n=40, bs=8)]
+    assert len(seen) == len(ref)
+    for r, g in zip(ref, seen):
+        np.testing.assert_array_equal(r, g)
+
+
+def test_unset_num_parts_never_shards(tmp_path, monkeypatch):
+    """An unset num_parts must read the FULL record set even in a dist
+    environment (eval iterators must not silently score 1/N)."""
+    rec = str(tmp_path / "imgs.rec")
+    _write_rec(rec, n=9)
+    monkeypatch.setenv("DMLC_NUM_WORKER", "3")
+    monkeypatch.setenv("DMLC_RANK", "1")
+    it = ImageRecordIterImpl(path_imgrec=rec, data_shape=(3, 24, 24),
+                             batch_size=1, preprocess_threads=1,
+                             round_batch=False)
+    assert len(it._order) == 9
+    it.close()
+
+
+def test_exhausted_wrapper_keeps_raising_stopiteration():
+    """Iterating a drained DevicePrefetchIter again WITHOUT reset()
+    must raise StopIteration immediately (DataIter contract) — not
+    hang waiting on a feeder that already exited."""
+    w = io_plane.DevicePrefetchIter(_iter(n=16, bs=8))
+    assert len(list(w)) == 2
+    assert list(w) == []          # second pass: immediate StopIteration
+    w.reset()
+    assert len(list(w)) == 2      # reset restores a full epoch
+    w.close()
+
+
+def test_ring_bit_parity_vs_blocking(monkeypatch):
+    """Training through the ring must be BIT-identical to the blocking
+    input path (staging = copy + cast, nothing else)."""
+    monkeypatch.setenv("MXNET_IO_RING", "0")
+    sha_block = _sha(_fit())
+    monkeypatch.setenv("MXNET_IO_RING", "1")
+    before = io_plane.stats()["batches"]
+    mod = _fit()
+    assert io_plane.stats()["batches"] > before, "ring was not engaged"
+    assert _sha(mod) == sha_block
+
+
+def test_ring_delegation_and_stats():
+    it = _iter(n=40, bs=8)
+    w = io_plane.DevicePrefetchIter(it)
+    # checkpoint-state and record-range delegate to the inner iterator
+    assert w.record_range(2) == it.record_range(2)
+    st = w.checkpoint_state()
+    assert "idx" in st
+    first = next(iter(w)).data[0].asnumpy()
+    w.set_checkpoint_state(st, nbatch=0)
+    again = next(iter(w)).data[0].asnumpy()
+    np.testing.assert_array_equal(first, again)
+    s = w.ring_stats()
+    assert s["depth"] >= 2 and s["batches"] >= 1
+    w.close()
+    # the io producer is registered with the obs registry
+    from incubator_mxnet_tpu.obs import metrics as obs_metrics
+    snap = obs_metrics.registry().collect()
+    assert any(k.startswith("io.") for k in snap)
+
+
+def test_device_prefetch_loader_pairs():
+    pairs = [(mx.nd.array(np.full((4, 3), i, "f4")),
+              mx.nd.array(np.full((4,), i, "f4"))) for i in range(6)]
+    loader = io_plane.DevicePrefetchLoader(pairs, ctx=mx.cpu())
+    got = list(loader)
+    loader.close()
+    assert len(got) == 6
+    for i, (d, l) in enumerate(got):
+        assert float(d.asnumpy()[0, 0]) == i
+        assert float(l.asnumpy()[0]) == i
+
+
+# ---------------------------------------------------------------------------
+# uint8-on-the-wire + in-graph normalize parity
+# ---------------------------------------------------------------------------
+
+def test_uint8_wire_in_graph_parity_bit_exact(tmp_path):
+    """device_augment uint8 NHWC + normalize_symbol must reproduce the
+    host-side fp32 path BIT-FOR-BIT (same crops, same f32 ops, and the
+    symbol carries the ORIGINAL std so the op's reciprocal equals the
+    host kernel's)."""
+    rec = str(tmp_path / "imgs.rec")
+    _write_rec(rec, n=12, size=30)
+    kw = dict(path_imgrec=rec, data_shape=(3, 24, 24), batch_size=4,
+              rand_crop=True, rand_mirror=True, seed=9,
+              mean_r=123.68, mean_g=116.78, mean_b=103.94,
+              std_r=58.4, std_g=57.1, std_b=57.4, preprocess_threads=1)
+    host = ImageRecordIterImpl(device_augment=False, **kw)
+    wire = ImageRecordIterImpl(device_augment=True, **kw)
+    data = mx.sym.Variable("data")
+    norm = wire.normalize_symbol(data)
+    seen = 0
+    for bh, bw in zip(host, wire):
+        assert bw.data[0].dtype == np.uint8
+        ex = norm.bind(mx.cpu(), {"data": bw.data[0]})
+        y = ex.forward()[0].asnumpy()
+        np.testing.assert_array_equal(y, bh.data[0].asnumpy())
+        seen += 1
+    assert seen >= 2
+    host.close()
+    wire.close()
+
+
+def test_uint8_wire_auto_resolves_from_knob(monkeypatch, tmp_path):
+    rec = str(tmp_path / "imgs.rec")
+    _write_rec(rec, n=8)
+    kw = dict(path_imgrec=rec, data_shape=(3, 24, 24), batch_size=4,
+              preprocess_threads=1)
+    monkeypatch.setenv("MXNET_IO_UINT8_WIRE", "1")
+    it = ImageRecordIterImpl(device_augment="auto", **kw)
+    assert it.provide_data[0].dtype == np.uint8
+    it.close()
+    monkeypatch.setenv("MXNET_IO_UINT8_WIRE", "0")
+    it = ImageRecordIterImpl(device_augment="auto", **kw)
+    assert it.provide_data[0].dtype != np.uint8
+    it.close()
+
+
+# ---------------------------------------------------------------------------
+# per-host sharded readers
+# ---------------------------------------------------------------------------
+
+def test_shard_range_disjoint_exhaustive_deterministic():
+    for n in (0, 1, 7, 16, 100, 1001):
+        for parts in (1, 2, 3, 7, 16):
+            ranges = recordio.shard_ranges(n, parts)
+            # exhaustive + disjoint + ordered
+            covered = []
+            for lo, hi in ranges:
+                covered.extend(range(lo, hi))
+            assert covered == list(range(n)), (n, parts)
+            # balanced: sizes differ by at most one
+            sizes = [hi - lo for lo, hi in ranges]
+            assert max(sizes) - min(sizes) <= 1
+            # deterministic across calls (the resume invariant)
+            assert ranges == recordio.shard_ranges(n, parts)
+    with pytest.raises(mx.base.MXNetError):
+        recordio.shard_range(10, 2, 2)
+
+
+def test_record_iter_shards_are_exact(tmp_path):
+    rec = str(tmp_path / "imgs.rec")
+    _write_rec(rec, n=13)
+    kw = dict(path_imgrec=rec, data_shape=(3, 24, 24), batch_size=1,
+              preprocess_threads=1, round_batch=False)
+    seen = []
+    for p in range(3):
+        it = ImageRecordIterImpl(part_index=p, num_parts=3, **kw)
+        labels = [float(b.label[0].asnumpy()[0]) for b in it]
+        it.close()
+        seen.append(labels)
+    flat = sorted(x for part in seen for x in part)
+    assert flat == [float(i) for i in range(13)]          # exhaustive
+    assert len(set(map(tuple, seen))) == 3                # disjoint
+    # deterministic across a fresh construction (resume)
+    it = ImageRecordIterImpl(part_index=1, num_parts=3, **kw)
+    again = [float(b.label[0].asnumpy()[0]) for b in it]
+    it.close()
+    assert again == seen[1]
+
+
+def test_auto_shard_env_and_epoch_fence_reshard(tmp_path, monkeypatch):
+    rec = str(tmp_path / "imgs.rec")
+    _write_rec(rec, n=12)
+    kw = dict(path_imgrec=rec, data_shape=(3, 24, 24), batch_size=1,
+              preprocess_threads=1, round_batch=False)
+    # auto resolution from the dist environment
+    monkeypatch.setenv("DMLC_NUM_WORKER", "3")
+    monkeypatch.setenv("DMLC_RANK", "1")
+    assert io_plane.auto_shard() == (1, 3)
+    it = ImageRecordIterImpl(num_parts="auto", **kw)
+    assert (it.part_index, it.num_parts) == (1, 3)
+    assert len(it._order) == 4
+    # shrink-and-resume rewrites the env; the NEXT epoch re-shards
+    monkeypatch.setenv("DMLC_NUM_WORKER", "2")
+    monkeypatch.setenv("DMLC_RANK", "0")
+    it.reset()
+    assert (it.part_index, it.num_parts) == (0, 2)
+    assert len(it._order) == 6
+    it.close()
+    monkeypatch.delenv("DMLC_NUM_WORKER")
+    monkeypatch.delenv("DMLC_RANK")
+    assert io_plane.auto_shard() == (0, 1)
+
+
+def test_quarantined_record_stays_local_to_its_shard(tmp_path):
+    """A poisoned record quarantined on shard 0 disappears from shard
+    0's order — including after an epoch-fence re-shard — and shard 1
+    never sees any of it."""
+    rec = str(tmp_path / "imgs.rec")
+    _write_rec(rec, n=10)
+    kw = dict(path_imgrec=rec, data_shape=(3, 24, 24), batch_size=1,
+              preprocess_threads=1, round_batch=False)
+    s0 = ImageRecordIterImpl(part_index=0, num_parts=2, **kw)
+    s1 = ImageRecordIterImpl(part_index=1, num_parts=2, **kw)
+    bad_id = int(s0._order[2])
+    entries = [{"record": bad_id, "source": rec,
+                "reason": "corrupt_record"}]
+    s0.apply_quarantine(entries)
+    s1.apply_quarantine(entries)
+    assert bad_id not in set(int(i) for i in s0._order)
+    assert len(s1._order) == 5                     # other shard untouched
+    labels0 = [float(b.label[0].asnumpy()[0]) for b in s0]
+    assert float(bad_id) not in labels0
+    s0.reset()                                     # re-shard on the fence
+    assert bad_id not in set(int(i) for i in s0._order)
+    s0.close()
+    s1.close()
+
+
+# ---------------------------------------------------------------------------
+# recompiles + concurrency
+# ---------------------------------------------------------------------------
+
+def test_zero_steady_state_recompiles_with_ring(monkeypatch):
+    """With the ring enabled, epoch 2 of a fixed-shape fit must not
+    compile anything new (the ring's staged batches keep the dispatch
+    signature constant)."""
+    monkeypatch.setenv("MXNET_IO_RING", "1")
+    from incubator_mxnet_tpu import compile as mxcompile
+
+    compiles = []
+
+    def cb(param):
+        compiles.append((param.epoch, param.nbatch,
+                         mxcompile.stats()["counters"]["compiles"]))
+
+    mx.random.seed(7)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(_iter(n=48, bs=8), num_epoch=3, optimizer="sgd",
+            eval_metric="acc", initializer=mx.initializer.Xavier(),
+            batch_end_callback=cb, kvstore=None)
+    assert mod._fused_step is not None and not mod._fused_step.broken
+    first_epoch2 = next(c for e, n, c in compiles if e == 1)
+    assert compiles[-1][2] == first_epoch2, \
+        f"steady-state compiles moved: {compiles}"
+
+
+def test_tsan_clean_ring_and_decode(tmp_path):
+    """The new mx-io-* threads (ring feeder + decode pool) sweep clean
+    under MXNET_TSAN=1 in a throwaway process."""
+    rec = str(tmp_path / "imgs.rec")
+    _write_rec(rec, n=8)
+    log = str(tmp_path / "tsan.json")
+    child = f"""
+import numpy as np
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import io_plane
+from incubator_mxnet_tpu.image import ImageRecordIterImpl
+rng = np.random.RandomState(0)
+it = mx.io.NDArrayIter(rng.randn(32, 8).astype('f4'),
+                       rng.randint(0, 4, 32).astype('f4'), batch_size=8)
+w = io_plane.DevicePrefetchIter(it)
+for _ in range(2):
+    for b in w:
+        pass
+    w.reset()
+w.close()
+img = ImageRecordIterImpl(path_imgrec={rec!r}, data_shape=(3, 24, 24),
+                          batch_size=4, preprocess_threads=2)
+for b in img:
+    pass
+img.close()
+"""
+    env = dict(os.environ, MXNET_TSAN="1", MXNET_TSAN_LOG=log,
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", child], cwd=REPO,
+                          capture_output=True, text=True, timeout=300,
+                          env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    with open(log) as f:
+        dumps = [json.loads(ln) for ln in f.read().splitlines()
+                 if ln.strip()]
+    found = [fi for d in dumps for fi in d.get("findings", [])]
+    assert not found, found
+
+
+# ---------------------------------------------------------------------------
+# lint + knobs
+# ---------------------------------------------------------------------------
+
+def test_blocking_h2d_lint_fires_and_spares_ring_feeds():
+    from incubator_mxnet_tpu import analysis
+    src = ("import jax\n"
+           "for batch in it:\n"
+           "    x = jax.device_put(batch)\n"
+           "    mod.fit_step(x, metric)\n")
+    rep = analysis.check_source(src, filename="t.py")
+    assert any(f.code == "blocking-h2d-in-loop" for f in rep)
+    # a non-training loop is not flagged
+    src2 = ("import jax\n"
+            "for batch in it:\n"
+            "    x = jax.device_put(batch)\n"
+            "    outs.append(x)\n")
+    rep2 = analysis.check_source(src2, filename="t.py")
+    assert not any(f.code == "blocking-h2d-in-loop" for f in rep2)
+
+
+def test_io_knobs_registered():
+    from incubator_mxnet_tpu import config
+    for knob in ("MXNET_IO_RING", "MXNET_IO_PREFETCH", "MXNET_IO_STAGING",
+                 "MXNET_IO_UINT8_WIRE", "MXNET_IO_AUTO_SHARD"):
+        assert knob in config.KNOBS, knob
+        assert config.KNOBS[knob][2] == "honored", knob
+    assert config.get("MXNET_IO_PREFETCH") >= 2

@@ -20,6 +20,71 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def h2d_probe(batch, image, n_bufs=12):
+    """THE h2d three-way probe, shared by bench.py's io lane and
+    tools/run_io_bench.py's CI gate (one implementation so the BENCH
+    artifact and the gate always measure the same thing): host memcpy
+    bandwidth (the physical ceiling a staged transfer can approach),
+    the BLOCKING `device_put` baseline (what the pre-ring training loop
+    paid per batch — the 13.8 MB/s BENCH_r05 number on the dev
+    tunnel), and the PIPELINED staging-ring rate (transfers on the
+    mx-io-h2d thread, the consumer pops device-resident batches).
+    Returns MB/s numbers plus the ring's own stats."""
+    import threading
+
+    import jax
+    from incubator_mxnet_tpu.io_plane import H2DRing, RingPlacement
+
+    buf = np.random.rand(batch, 3, image, image).astype("f4")
+    nbytes = buf.nbytes
+    # memcpy reference: one host copy of the same bytes
+    dst = np.empty_like(buf)
+    t0 = time.perf_counter()
+    reps = 0
+    while time.perf_counter() - t0 < 0.2 or reps < 3:
+        np.copyto(dst, buf)
+        reps += 1
+    memcpy = nbytes * reps / (time.perf_counter() - t0) / 1e6
+    # blocking baseline: the transfer serializes with the caller
+    t0 = time.perf_counter()
+    for _ in range(3):
+        jax.block_until_ready(jax.device_put(buf))
+    blocking = 3 * nbytes / (time.perf_counter() - t0) / 1e6
+    # pipelined ring: a feeder stages+transfers while the consumer pops
+    ring = H2DRing(RingPlacement(), name="bench")
+
+    def _feed():
+        for _ in range(n_bufs):
+            if not ring.put([buf]):
+                return
+        ring.put_end()
+
+    th = threading.Thread(target=_feed, daemon=True, name="mx-io-h2d")
+    t0 = time.perf_counter()
+    th.start()
+    got = 0
+    while True:
+        try:
+            ring.get()
+        except StopIteration:
+            break
+        got += 1
+    dt = time.perf_counter() - t0
+    th.join(timeout=10)
+    st = ring.ring_stats()
+    ring.close()
+    pipelined = got * nbytes / dt / 1e6
+    return {
+        "bytes_per_batch": int(nbytes),
+        "memcpy_MBps": round(memcpy, 1),
+        "blocking_MBps": round(blocking, 1),
+        "pipelined_MBps": round(pipelined, 1),
+        "pipelined_vs_blocking": round(pipelined / max(blocking, 1e-9), 2),
+        "ring": {k: round(v, 4) if isinstance(v, float) else v
+                 for k, v in st.items()},
+    }
+
+
 def build_corpus(path, n=1024, size=256, quality=90):
     import cv2
     from incubator_mxnet_tpu import recordio

@@ -312,6 +312,29 @@ def tsan_stage():
     return out
 
 
+def io_stage():
+    """Data-plane stage: run tools/run_io_bench.py --quick in a
+    throwaway process — h2d probe (memcpy / blocking / pipelined ring),
+    real-vs-synthetic training lanes on the uint8-wire convnet, the
+    zero-steady-recompile check, and the MXNET_TSAN=1 ring sweep — and
+    attach its BENCH_IO.json gates to the round.  The input pipeline's
+    "real data trains as fast as synthetic" claim becomes checkable
+    evidence next to the parity outcomes."""
+    cmd = [sys.executable, os.path.join(REPO, "tools", "run_io_bench.py"),
+           "--quick", "--json",
+           "--out", os.path.join(REPO, "BENCH_IO.json")]
+    try:
+        out = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                             timeout=1800,
+                             env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        summary = json.loads(out.stdout)
+        summary["rc"] = out.returncode
+        summary.get("tsan", {}).pop("detail", None)
+        return summary
+    except Exception as exc:
+        return {"error": f"io stage failed: {exc!r}"}
+
+
 def obs_stage():
     """Telemetry-plane stage: run tools/run_obs_gate.py --quick in a
     throwaway process — a traced mini fused fit plus a serving burst
@@ -404,6 +427,7 @@ def main():
         "scaling": scaling_stage(),
         "tsan": tsan_stage(),
         "obs": obs_stage(),
+        "io": io_stage(),
         "cmd": " ".join(cmd[2:]),
         "tests": tests[:500],
         "tail": "\n".join(output.strip().splitlines()[-12:])[-2000:],
