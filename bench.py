@@ -208,7 +208,7 @@ def _run_framework(batch, image, steps, dtype):
     fused = mod._fused_step
     assert fused is not None and not fused.broken, \
         "public fit path must run the fused train step"
-    return init_s, probe.compile_s, probe.img_s
+    return init_s, probe.compile_s, probe.img_s, fused.compile_phase_stats()
 
 
 def _run_gluon(batch, image, steps, dtype):
@@ -283,7 +283,8 @@ def _run_gluon(batch, image, steps, dtype):
     assert est._fused is not None and not est._fused.broken, \
         "Estimator must run the fused Gluon step"
     assert "img_s" in times, "gluon probe missed its window"
-    return times["compile"], times["img_s"]
+    return times["compile"], times["img_s"], \
+        est._fused.compile_phase_stats()
 
 
 # ---------------------------------------------------------------------------
@@ -370,7 +371,8 @@ def _run_lstm_framework(steps):
     fused = mod._fused_step
     assert fused is not None and not fused.broken, \
         "lstm lane must run the fused train step"
-    return probe.compile_s, probe.img_s * seq   # tokens/s
+    return (probe.compile_s, probe.img_s * seq,   # tokens/s
+            fused.compile_phase_stats())
 
 
 def _pure_jax_lstm(steps):
@@ -743,10 +745,16 @@ def main():
 
     # -- framework path (headline dtype) -----------------------------------
     _RESULT["phase"] = f"framework-{dtype}"
-    init_s, compile_s, img_s = _run_framework(batch, image, steps, dtype)
+    init_s, compile_s, img_s, phases = _run_framework(batch, image, steps,
+                                                      dtype)
     _RESULT.update(value=round(img_s, 2),
                    vs_baseline=round(img_s / BASELINE_IMG_S, 3),
                    init_s=round(init_s, 2), compile_s=round(compile_s, 2))
+    # per-lane cold-start phase breakdown: framework trace seconds,
+    # traced-jaxpr equation count (the graph size XLA compiles — scan
+    # dedup shows up here as one layer body per run), and per-program
+    # lower vs XLA-compile seconds from the unified cache
+    _RESULT["compile_phases"] = {"module": phases}
 
     # -- guardian overhead probe -------------------------------------------
     # the headline lane above ran with the training guardian ON (its
@@ -761,7 +769,8 @@ def main():
             prev = os.environ.get("MXNET_GUARDIAN")
             os.environ["MXNET_GUARDIAN"] = "0"
             try:
-                _, _, img_off = _run_framework(batch, image, steps, dtype)
+                _, _, img_off, _ = _run_framework(batch, image, steps,
+                                                  dtype)
             finally:
                 if prev is None:
                     os.environ.pop("MXNET_GUARDIAN", None)
@@ -792,10 +801,12 @@ def main():
     if os.environ.get("BENCH_GLUON", "1") == "1" and left() > 150:
         _RESULT["phase"] = f"gluon-{dtype}"
         try:
-            g_compile, g_img_s = _run_gluon(batch, image, steps, dtype)
+            g_compile, g_img_s, g_phases = _run_gluon(batch, image, steps,
+                                                      dtype)
             _RESULT["gluon_img_s"] = round(g_img_s, 2)
             _RESULT["gluon_compile_s"] = round(g_compile, 2)
             _RESULT["gluon_vs_module"] = round(g_img_s / img_s, 3)
+            _RESULT.setdefault("compile_phases", {})["gluon"] = g_phases
         except Exception as e:
             _RESULT["gluon_error"] = repr(e)[:200]
 
@@ -803,7 +814,7 @@ def main():
     if want_fp32 and dtype != "float32" and left() > 150:
         _RESULT["phase"] = "framework-float32"
         try:
-            _, _, img32 = _run_framework(batch, image, steps, "float32")
+            _, _, img32, _ = _run_framework(batch, image, steps, "float32")
             _RESULT["fp32_img_s"] = round(img32, 2)
             if want_control:
                 ctl = _pure_jax_resnet50(batch, image, "float32")
@@ -817,9 +828,10 @@ def main():
     if os.environ.get("BENCH_LSTM", "1") == "1" and left() > 150:
         _RESULT["phase"] = "lstm"
         try:
-            l_compile, tok_s = _run_lstm_framework(steps)
+            l_compile, tok_s, l_phases = _run_lstm_framework(steps)
             _RESULT["lstm_tokens_s"] = round(tok_s, 1)
             _RESULT["lstm_compile_s"] = round(l_compile, 2)
+            _RESULT.setdefault("compile_phases", {})["lstm"] = l_phases
             if want_control and left() > 60:
                 _, c_tok_s = _pure_jax_lstm(steps)
                 _RESULT["lstm_pure_jax_tokens_s"] = round(c_tok_s, 1)
@@ -885,6 +897,9 @@ def main():
         _RESULT["program_cache"] = {
             **{k: st["counters"][k] for k in
                ("compiles", "disk_hits", "stores")},
+            "disk_misses": st["counters"].get("disk_misses", 0),
+            "lower_s": st["counters"].get("lower_s_total", 0.0),
+            "compile_s": st["counters"].get("compile_s_total", 0.0),
             "hit_rate": st["hit_rate"],
         }
         _compile.write_stats()

@@ -42,7 +42,7 @@ from .findings import Finding, Report, ERROR, WARN, HINT
 
 __all__ = ["DeviceProfile", "PROFILES", "get_profile", "OpCost",
            "ProgramCost", "analyze_symbol", "analyze_callable",
-           "analyze_jaxpr", "enumerate_collectives",
+           "analyze_jaxpr", "jaxpr_dying_inputs", "enumerate_collectives",
            "analyze_executor", "build_bench_convnet", "bench_programs",
            "analyze_bench_set", "CODES"]
 
@@ -785,6 +785,25 @@ def analyze_jaxpr(closed, name="jaxpr", profile=None, donated=()):
            prog.bytes_moved / (1 << 20), prog.arithmetic_intensity,
            prog.bound), location=name))
     return prog
+
+
+def jaxpr_dying_inputs(closed, indices=None):
+    """Flat input positions whose buffers provably DIE inside the traced
+    program: the invar is never aliased straight through to an outvar,
+    so donating that argument lets XLA reuse its buffer for
+    intermediates (lower peak HBM, no copy).  `indices` restricts the
+    check to a candidate slice of the flattened inputs.
+
+    This is the trace-time liveness oracle `fused.FusedTrainStep`
+    consults for auto-donation (MXNET_FUSED_AUTODONATE): an input that
+    IS returned — an echoed batch, a passthrough label — stays
+    undonated, because its buffer must outlive the step."""
+    jaxpr = closed.jaxpr
+    live_out = {id(v) for v in jaxpr.outvars}
+    rng = range(len(jaxpr.invars)) if indices is None else indices
+    return [i for i in rng
+            if 0 <= i < len(jaxpr.invars)
+            and id(jaxpr.invars[i]) not in live_out]
 
 
 def analyze_callable(fn, avals, name=None, profile=None,

@@ -74,6 +74,9 @@ CODE_TABLE = _build_code_table([
      "variable shape not inferable from inputs/attrs; bind fails there"),
     ("tpu-layout", HINT, ("graph.layout",),
      "feature dim off the 8/128 tile grid pads the MXU tile"),
+    ("scan-opportunity", HINT, ("graph.scan",),
+     "run of >=4 structurally identical blocks did not lower to "
+     "lax.scan; XLA compiles N inlined copies of the layer body"),
     # -- script AST lints (source_lint.py) -----------------------------------
     ("syntax-error", WARN, ("source.parse",),
      "script does not parse; nothing else was checked"),

@@ -32,7 +32,8 @@ import numpy as _np
 from ..base import np_dtype
 from .findings import Finding, Report, ERROR, WARN, HINT
 
-__all__ = ["check", "check_json", "PASS_CATALOG"]
+__all__ = ["check", "check_json", "scan_plan", "PASS_CATALOG",
+           "SCAN_MIN_RUN", "SCAN_HINT_RUN"]
 
 PASS_CATALOG = {
     "graph.names": ("duplicate-name", "empty-name", "bad-json",
@@ -42,6 +43,7 @@ PASS_CATALOG = {
     "graph.dtype": ("f64-promotion", "f64-output"),
     "graph.unbound": ("unbound-input",),
     "graph.layout": ("tpu-layout",),
+    "graph.scan": ("scan-opportunity",),
 }
 
 # feature/channel attrs per op for the layout pass
@@ -358,6 +360,295 @@ def _abstract_env(symbol, shapes, dtypes=None):
 
 
 # ---------------------------------------------------------------------------
+# scan-over-layers: repeated-subgraph isomorphism over the linear spine
+# ---------------------------------------------------------------------------
+
+# lower runs of >= SCAN_MIN_RUN identical segments; lint only complains
+# about runs >= SCAN_HINT_RUN that could NOT lower (the compile-time win
+# below 4 repeats rarely justifies a graph rewrite worth shouting about)
+SCAN_MIN_RUN = 2
+SCAN_HINT_RUN = 4
+
+
+def _clean_cuts(ops, pos, heads):
+    """Positions p where every op->op edge crossing the cut after ops[p]
+    originates AT ops[p] — i.e. the graph's linear spine points.  `_topo`
+    guarantees edges go earlier->later, so a clean cut means everything
+    after it sees only ops[p]'s outputs (plus variables).  Graph heads
+    act as virtual consumers past the end: a head produced mid-graph
+    dirties every later cut, so a scanned run can never hide a value a
+    caller reads."""
+    n = len(ops)
+    dirty = [False] * n
+    spans = []
+    for node in ops:
+        j = pos[id(node)]
+        for src, _ in node.inputs:
+            if not src.is_variable:
+                spans.append((pos[id(src)], j))
+    for hnode, _ in heads:
+        if not hnode.is_variable:
+            spans.append((pos[id(hnode)], n))
+    for i, j in spans:
+        for p in range(i + 1, j):
+            dirty[p] = True
+    return [p for p in range(n) if not dirty[p]]
+
+
+def _seg_signature(seg, seg_ids, prev_boundary, aux_ids):
+    """Structural signature of one spine segment: op names + attrs +
+    input wiring with node identities erased (local position / carry /
+    param slot / aux slot).  Two segments with equal signatures are
+    isomorphic layer bodies differing only in which parameters feed
+    them."""
+    seg_pos = {id(node): i for i, node in enumerate(seg)}
+    params_order, aux_order = [], []
+    param_slot, aux_slot = {}, {}
+    rng_order = []
+    sig = []
+    for node in seg:
+        naux = node.op.num_aux(node.attrs)
+        n_in = len(node.inputs)
+        enc = []
+        for k, (src, idx) in enumerate(node.inputs):
+            if src.is_variable:
+                if naux and k >= n_in - naux and id(src) in aux_ids:
+                    if id(src) not in aux_slot:
+                        aux_slot[id(src)] = len(aux_order)
+                        aux_order.append(src)
+                    enc.append(("aux", aux_slot[id(src)]))
+                else:
+                    if id(src) not in param_slot:
+                        param_slot[id(src)] = len(params_order)
+                        params_order.append(src)
+                    enc.append(("param", param_slot[id(src)]))
+            elif id(src) in seg_ids:
+                enc.append(("local", seg_pos[id(src)], idx))
+            elif prev_boundary is not None and src is prev_boundary \
+                    and idx == 0:
+                enc.append(("carry",))
+            else:
+                # not the immediately-preceding boundary's output 0:
+                # structurally unique, never joins a run
+                enc.append(("extern", id(src), idx))
+        if node.op.needs_rng:
+            rng_order.append(node)
+        sig.append((node.op.name,
+                    tuple(sorted((str(k), str(v))
+                                 for k, v in node.attrs.items())),
+                    tuple(enc)))
+    return tuple(sig), params_order, aux_order, rng_order
+
+
+def _run_eligible(segments, params, auxs, head_nodes, var_consumers,
+                  heads):
+    """Why a run of equal-signature segments cannot lower, or None."""
+    covered = {id(n) for seg in segments for n in seg}
+    final_boundary = segments[-1][-1]
+    for seg in segments:
+        if seg[-1].num_outputs() != 1:
+            return "multi-output block boundary"
+    for seg in segments[:-1]:
+        if id(seg[-1]) in head_nodes:
+            return "intermediate block output is a graph head"
+    for n_id in covered:
+        if n_id in head_nodes and n_id != id(final_boundary):
+            return "internal node is a graph head"
+    for layer_vars in list(params) + list(auxs):
+        for seg, v in zip(segments, layer_vars):
+            seg_ids = {id(n) for n in seg}
+            consumers = var_consumers.get(id(v), ())
+            if any(id(c) not in seg_ids for c in consumers):
+                return "parameter '%s' shared outside its layer" % v.name
+            if any(h is v for h, _ in heads):
+                return "parameter '%s' is a graph head" % v.name
+    return None
+
+
+def scan_plan(symbol, min_run=SCAN_MIN_RUN):
+    """Detect runs of structurally identical layer blocks on the graph's
+    linear spine — the repeated-subgraph isomorphism pass behind
+    scan-over-layers lowering (`symbol.graph_eval_fn`) and the
+    ``scan-opportunity`` lint.
+
+    Returns ``{"runs": [...], "rejected": [...]}``.  Each run dict
+    carries everything the evaluator needs to emit ONE `lax.scan` body
+    over stacked per-layer parameters instead of N inlined copies:
+
+    * ``length``    — layer count N
+    * ``carry``     — (node, out_idx) feeding the first layer
+    * ``boundary``  — final layer's output node (single-output)
+    * ``segments``  — per-layer op node lists (topo order)
+    * ``params``    — [slot][layer] parameter variable nodes
+    * ``aux``       — [slot][layer] aux-state variable nodes
+    * ``rng``       — [slot][layer] rng-consuming op nodes
+    * ``covered``   — ids of every op the scan replaces
+
+    Rejected entries ({"node", "length", "reason"}) are equal-signature
+    runs that cannot lower (shared weights, exposed internals, ...) —
+    the lint surfaces the ones >= SCAN_HINT_RUN."""
+    topo = symbol._topo()
+    ops = [n for n in topo if not n.is_variable]
+    out = {"runs": [], "rejected": []}
+    if len(ops) < 2 * max(min_run, 2):
+        return out
+    pos = {id(n): i for i, n in enumerate(ops)}
+    aux_ids = symbol._aux_node_ids()
+    heads = list(symbol._entries)
+    head_nodes = {id(n) for n, _ in heads}
+    var_consumers = {}
+    for n in ops:
+        for src, _ in n.inputs:
+            if src.is_variable:
+                var_consumers.setdefault(id(src), []).append(n)
+
+    cuts = _clean_cuts(ops, pos, heads)
+    if len(cuts) < 2:
+        return out
+    # segments between consecutive clean cuts (first segment starts at 0)
+    segs, seg_meta = [], []
+    start = 0
+    for p in cuts:
+        seg = ops[start:p + 1]
+        prev_boundary = ops[start - 1] if start else None
+        seg_ids = {id(n) for n in seg}
+        sig, params_order, aux_order, rng_order = _seg_signature(
+            seg, seg_ids, prev_boundary, aux_ids)
+        segs.append(seg)
+        seg_meta.append((sig, params_order, aux_order, rng_order))
+        start = p + 1
+
+    # A "layer" can span several unit segments (e.g. Conv+BN+Act between
+    # three consecutive clean cuts): look for period-p repetition in the
+    # unit-signature sequence, then re-derive the signature of each
+    # MERGED layer segment exactly.  Unit-level equality is the cheap
+    # filter; merged-level equality is the proof.
+    m = len(segs)
+    unit = [meta[0] for meta in seg_meta]
+    max_p = max(1, min(8, m // max(min_run, 2)))
+    candidates = []
+    for p in range(1, max_p + 1):
+        i = 0
+        while i + 2 * p <= m:
+            length = 1
+            while i + (length + 1) * p <= m and \
+                    unit[i + length * p:i + (length + 1) * p] == \
+                    unit[i:i + p]:
+                length += 1
+            if length >= min_run:
+                # coverage first, then the smaller period (one layer per
+                # repetition, not two)
+                candidates.append((length * p, -p, i, p, length))
+                i += length * p
+            else:
+                i += 1
+    taken = [False] * m
+    runs_spec = []
+    for _cov, _negp, i, p, length in sorted(candidates, reverse=True):
+        if any(taken[i:i + length * p]):
+            continue
+        for q in range(i, i + length * p):
+            taken[q] = True
+        runs_spec.append((i, p, length))
+    runs_spec.sort()
+
+    for i, p, length in runs_spec:
+        segments = [sum((segs[i + l * p + q] for q in range(p)), [])
+                    for l in range(length)]
+        metas = []
+        ok = True
+        for l in range(length):
+            u0 = i + l * p
+            prev_boundary = segs[u0 - 1][-1] if u0 else None
+            seg = segments[l]
+            metas.append(_seg_signature(seg, {id(n) for n in seg},
+                                        prev_boundary, aux_ids))
+            if metas[l][0] != metas[0][0]:
+                ok = False
+                break
+        first = segments[0][0]
+        if not ok or not any(e == ("carry",) for _, _, enc in metas[0][0]
+                             for e in enc):
+            out["rejected"].append({
+                "node": first.name, "length": length,
+                "reason": "layer bodies are not structurally identical "
+                          "under the carry chain"})
+            continue
+        # [slot][layer] variable/rng nodes
+        params = [[metas[l][1][s] for l in range(length)]
+                  for s in range(len(metas[0][1]))]
+        auxs = [[metas[l][2][s] for l in range(length)]
+                for s in range(len(metas[0][2]))]
+        rngs = [[metas[l][3][s] for l in range(length)]
+                for s in range(len(metas[0][3]))]
+        reason = _run_eligible(segments, params, auxs, head_nodes,
+                               var_consumers, heads)
+        if reason is None:
+            carry_src = None
+            seg0_ids = {id(n) for n in segments[0]}
+            for src, idx in (inp for n in segments[0]
+                             for inp in n.inputs):
+                if not src.is_variable and id(src) not in seg0_ids:
+                    carry_src = (src, idx)
+                    break
+            if carry_src is not None:
+                out["runs"].append({
+                    "length": length,
+                    "carry": carry_src,
+                    "boundary": segments[-1][-1],
+                    "segments": segments,
+                    "params": params,
+                    "aux": auxs,
+                    "rng": rngs,
+                    "covered": {id(n) for seg in segments for n in seg},
+                    "first": first,
+                    "name": first.name,
+                })
+            else:
+                out["rejected"].append({
+                    "node": first.name, "length": length,
+                    "reason": "no op-produced carry feeds the first "
+                              "layer"})
+        else:
+            out["rejected"].append({"node": first.name,
+                                    "length": length,
+                                    "reason": reason})
+    return out
+
+
+def _pass_scan(symbol, topo):
+    """scan-opportunity: a run of >= SCAN_HINT_RUN structurally identical
+    blocks that the scan-over-layers lowering will NOT collapse — XLA
+    still receives N inlined copies of the layer body."""
+    out = []
+    try:
+        plan = scan_plan(symbol)
+    except Exception:
+        return out
+    from .. import config as _config
+    lowering_on = bool(_config.get("MXNET_FUSED_SCAN"))
+    candidates = list(plan["rejected"])
+    if not lowering_on:
+        candidates += [{"node": r["name"], "length": r["length"],
+                        "reason": "lowering disabled (MXNET_FUSED_SCAN=0)"}
+                       for r in plan["runs"]]
+    for rej in candidates:
+        if rej["length"] < SCAN_HINT_RUN:
+            continue
+        node = next((n for n in topo if n.name == rej["node"]), None)
+        f = Finding(
+            "graph.scan", "scan-opportunity", HINT,
+            "run of %d structurally identical blocks starting at '%s' "
+            "did not lower to lax.scan (%s) — XLA compiles %d inlined "
+            "copies of the layer body" % (rej["length"], rej["node"],
+                                          rej["reason"], rej["length"]),
+            node=rej["node"])
+        if node is None or not _suppressed(node, "scan-opportunity"):
+            out.append(f)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # entry points
 # ---------------------------------------------------------------------------
 
@@ -386,6 +677,7 @@ def check(symbol, shapes=None, hints=True, target=None):
         report.extend(_pass_unbound(symbol, topo, shapes))
     if hints:
         report.extend(_pass_layout(symbol, topo))
+        report.extend(_pass_scan(symbol, topo))
     return report
 
 

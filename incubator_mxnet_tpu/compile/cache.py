@@ -124,8 +124,10 @@ class ProgramCache:
         self.sources = []
         self._limit_mb = limit_mb
         self.counters = {"compiles": 0, "mem_hits": 0, "disk_hits": 0,
-                         "live_hits": 0, "stores": 0, "corrupt": 0,
-                         "evicted": 0, "errors": 0, "fallbacks": 0}
+                         "disk_misses": 0, "live_hits": 0, "stores": 0,
+                         "corrupt": 0, "evicted": 0, "errors": 0,
+                         "fallbacks": 0, "lower_s_total": 0.0,
+                         "compile_s_total": 0.0}
         self.events = []       # per-compile: {label, signature} (capped)
         self._programs = []    # weakrefs of live CachedPrograms
         # live tier: entry-key -> the loaded executable THIS process
@@ -389,11 +391,30 @@ class ProgramCache:
         return removed
 
     # -- stats plane ---------------------------------------------------------
-    def note_compile(self, label, sig_repr):
+    def note_compile(self, label, sig_repr, lower_s=None, compile_s=None):
+        """Record one cold compile.  ``lower_s``/``compile_s`` split the
+        cold-start cost into trace->StableHLO and XLA-compile phases
+        (CachedProgram._acquire times them); they accumulate into the
+        ``compile_s_total``/``lower_s_total`` counters so mxtop's CACHE
+        line can show the fleet's cold-compile debt in seconds, not
+        just counts."""
         with self._lock:
             self.counters["compiles"] += 1
+            if compile_s is not None:
+                self.counters["compile_s_total"] = round(
+                    self.counters.get("compile_s_total", 0.0) +
+                    float(compile_s), 3)
+            if lower_s is not None:
+                self.counters["lower_s_total"] = round(
+                    self.counters.get("lower_s_total", 0.0) +
+                    float(lower_s), 3)
             if len(self.events) < 512:
-                self.events.append({"label": label, "signature": sig_repr})
+                ev = {"label": label, "signature": sig_repr}
+                if lower_s is not None:
+                    ev["lower_s"] = round(float(lower_s), 4)
+                if compile_s is not None:
+                    ev["compile_s"] = round(float(compile_s), 4)
+                self.events.append(ev)
 
     def bump(self, counter, n=1):
         with self._lock:
@@ -432,7 +453,10 @@ class ProgramCache:
                 "signatures": len(p.signatures()),
                 "compiles": p.compile_count,
                 "disk_hits": p.disk_hits,
+                "disk_misses": getattr(p, "disk_misses", 0),
                 "mem_hits": p.mem_hits,
+                "lower_s": round(getattr(p, "lower_s_total", 0.0), 4),
+                "compile_s": round(getattr(p, "compile_s_total", 0.0), 4),
             })
         counters["mem_hits"] = counters.get("mem_hits", 0) + mem_hits
         lookups = counters["compiles"] + counters["mem_hits"] + \

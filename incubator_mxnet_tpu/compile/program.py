@@ -32,6 +32,7 @@ from __future__ import annotations
 import hashlib
 import logging
 import re
+import time as _time
 
 from ..analysis import locks as _alocks
 
@@ -102,7 +103,10 @@ class CachedProgram:
         self._lock = _alocks.make_lock("compile.program")
         self.compile_count = 0
         self.disk_hits = 0
+        self.disk_misses = 0   # disk tier enabled but had no entry
         self.mem_hits = 0   # plain int: the warm path must not take locks
+        self.lower_s_total = 0.0    # trace->StableHLO seconds (cold only)
+        self.compile_s_total = 0.0  # XLA compile seconds (cold only)
         if cache is None:
             from . import get_cache
             cache = get_cache()
@@ -157,10 +161,22 @@ class CachedProgram:
                     self._entry_keys[sig] = key
                     cache.live_put(key, exe)
                     return exe
+                self.disk_misses += 1
+                cache.bump("disk_misses")
         sig_repr = "%d leaves: %s" % (len(sig[1]), repr(sig[1])[:160])
-        cache.note_compile(self.label, sig_repr)
         self.compile_count += 1
-        exe = self._jit.lower(*args).compile()
+        # phase-split timing: lower (trace -> StableHLO) vs the XLA
+        # compile proper — the cold-start debt mxtop's CACHE line and
+        # bench's compile_phases block report per program
+        t0 = _time.perf_counter()
+        lowered = self._jit.lower(*args)
+        t1 = _time.perf_counter()
+        exe = lowered.compile()
+        t2 = _time.perf_counter()
+        self.lower_s_total += t1 - t0
+        self.compile_s_total += t2 - t1
+        cache.note_compile(self.label, sig_repr, lower_s=t1 - t0,
+                           compile_s=t2 - t1)
         if key is not None:
             cache.live_put(key, exe)
             if cache.enabled() and \

@@ -109,6 +109,24 @@ KNOBS = {
     "MXNET_FUSED_BACKWARD": (_BOOL, True, "honored",
                              "eager loss.backward() as ONE jitted tape "
                              "replay per structure (autograd.py)"),
+    "MXNET_FUSED_SCAN": (_BOOL, True, "honored",
+                         "scan-over-layers graph dedup: runs of "
+                         "structurally identical layer blocks lower to "
+                         "ONE lax.scan body over per-layer params "
+                         "stacked in-program (Symbol graphs via "
+                         "analysis.scan_plan, Gluon HybridSequential "
+                         "via identical-config children), shrinking "
+                         "the graph XLA compiles while params/"
+                         "checkpoints keep per-layer layout; "
+                         "bit-identical to the inlined path"),
+    "MXNET_FUSED_AUTODONATE": (_BOOL, True, "honored",
+                               "donate per-step staged inputs whose "
+                               "buffers provably die inside the fused "
+                               "step (trace-time jaxpr liveness via "
+                               "analysis.cost), letting XLA reuse them "
+                               "for intermediates — peak-HBM relief; "
+                               "staged inputs are re-owned first "
+                               "(reown_for_donation discipline)"),
     "MXNET_KVSTORE_BIGARRAY_BOUND": (int, 1000000, "honored",
                                      "arrays with more elements flat-split "
                                      "into one range per server "

@@ -37,6 +37,7 @@ Two layers:
 """
 from __future__ import annotations
 
+import contextlib
 import logging
 
 import numpy as _np
@@ -217,6 +218,38 @@ _REOWN_JIT = None
 # multi-thousand-op graph and double first-step latency for no safety.
 
 
+@contextlib.contextmanager
+def _quiet_donation():
+    """Warning scope for an auto-donating dispatch: jax warns when a
+    donated buffer cannot alias any program output, and for donated
+    batch INPUTS that is the common case (the step's outputs are small)
+    — the donation still lets the runtime release the staged buffer at
+    dispatch instead of holding it across the step.  Expected, not
+    actionable; silence exactly that message."""
+    import warnings
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        yield
+
+
+def _maybe_scan_plan(symbol):
+    """The symbol's scan-over-layers plan when MXNET_FUSED_SCAN is on and
+    the graph has at least one eligible run, else None.  Never raises —
+    a failed detection pass just means the inlined lowering."""
+    from . import config as _config
+    if not bool(_config.get("MXNET_FUSED_SCAN")):
+        return None
+    try:
+        from .analysis.graph_passes import scan_plan
+        plan = scan_plan(symbol)
+        return plan if plan.get("runs") else None
+    except Exception as e:
+        _log.debug("scan-over-layers detection failed (%s); using the "
+                   "inlined lowering", str(e)[:200])
+        return None
+
+
 def _donated_invalidated(*trees):
     """True when any jax-array leaf in the given pytrees was deleted by a
     donating dispatch (promoted into `analysis.donation.any_deleted`; kept
@@ -303,6 +336,7 @@ class _TracedCore:
 
     def __init__(self, core, example_args, axis_env=None):
         import jax
+        import time as _time
         flat, in_tree = jax.tree_util.tree_flatten(tuple(example_args))
 
         def flat_core(*leaves):
@@ -312,14 +346,42 @@ class _TracedCore:
         # (its jaxpr contains psum/pmean/pmin eqns over the dp axis and
         # is traced with SHARD-local input shapes; the shard_map wrapper
         # binds the axis for real at lowering time)
+        t0 = _time.perf_counter()
         closed, out_shape = jax.make_jaxpr(
             flat_core, return_shape=True,
             axis_env=axis_env)(*flat)
+        self.trace_s = _time.perf_counter() - t0
         self._closed = closed
         self._in_tree = in_tree
         self._out_tree = jax.tree_util.tree_structure(out_shape)
         self.out_shape = out_shape   # (inner, step_out) ShapeDtypeStructs
         self._graph_hash = None
+
+    def num_eqns(self):
+        """Total equation count of the traced step, recursing into
+        nested jaxprs (scan/cond/pjit bodies) — the graph-size number
+        the cold-start work scales with.  A scan-deduped graph counts
+        ONE layer body where the inlined lowering counts N."""
+        def subs(v):
+            vals = v if isinstance(v, (tuple, list)) else (v,)
+            out = []
+            for x in vals:
+                inner = getattr(x, "jaxpr", None)
+                if inner is not None and hasattr(inner, "eqns"):
+                    out.append(inner)
+                elif hasattr(x, "eqns"):
+                    out.append(x)
+            return out
+
+        def count(jaxpr):
+            n = len(jaxpr.eqns)
+            for eqn in jaxpr.eqns:
+                for v in eqn.params.values():
+                    for sub in subs(v):
+                        n += count(sub)
+            return n
+
+        return count(self._closed.jaxpr)
 
     @property
     def graph_hash(self):
@@ -498,15 +560,32 @@ def predict_pod_plan(shapes, dtypes=None, cap_bytes=None, extras=True,
         name="pod-plan")
 
 
-def _one_step_jit(traced, label="", call_fn=None, key_tag=None):
+def _one_step_jit(traced, label="", call_fn=None, key_tag=None,
+                  donate_inputs=False):
     """1-step program over a traced core; the inner carry is donated.
     Compiled through the unified program cache (compile/): a process
     that traced an identical core loads the executable from the disk
     tier instead of paying the XLA compile.  `call_fn` substitutes a
     wrapped core (the pod path's shard_map) while `traced` still
-    provides the cache identity; `key_tag` disambiguates the wrapper."""
+    provides the cache identity; `key_tag` disambiguates the wrapper.
+
+    `donate_inputs=True` builds the auto-donation variant: the batch
+    inputs ride as their OWN argument (donated) while the hyper rows
+    (lr/wd[/gmul]) stay in the non-donated remainder — the caller
+    proved via jaxpr liveness (analysis.cost.jaxpr_dying_inputs) that
+    every input buffer dies inside the step, and re-owns the staged
+    inputs first (reown_for_donation discipline), so XLA reuses the
+    batch's HBM for activations instead of holding it live."""
     from .compile import cached_jit
     fn = call_fn if call_fn is not None else traced
+
+    if donate_inputs:
+        def step1d(inner, inputs, xrest, *extras):
+            return fn(inner, (inputs,) + tuple(xrest), *extras)
+
+        return cached_jit(step1d, donate_argnums=(0, 1),
+                          graph_key=("step1d", key_tag, traced.graph_hash),
+                          label=label or "fused/step1")
 
     def step1(inner, x, *extras):
         return fn(inner, x, *extras)
@@ -517,7 +596,7 @@ def _one_step_jit(traced, label="", call_fn=None, key_tag=None):
 
 
 def _scan_block_jit(traced, mcarry_index=None, label="", call_fn=None,
-                    key_tag=None):
+                    key_tag=None, donate_inputs=False):
     """K-step program: `lax.scan` of the traced core over K stacked
     per-step inputs.  Returns (new_inner, ys, mys, last): `ys` stacks
     every step's outputs (so callers can expose batch j's outputs to a
@@ -535,7 +614,7 @@ def _scan_block_jit(traced, mcarry_index=None, label="", call_fn=None,
     from .compile import cached_jit
     fn = call_fn if call_fn is not None else traced
 
-    def stepk(inner, xs_list, *extras):
+    def _run(inner, xs_list, extras):
         xs = jax.tree_util.tree_map(lambda *vs: jnp.stack(vs), *xs_list)
 
         def body(inn, x):
@@ -547,6 +626,23 @@ def _scan_block_jit(traced, mcarry_index=None, label="", call_fn=None,
         new_inner, (ys, mys) = lax.scan(body, inner, xs)
         last = jax.tree_util.tree_map(lambda y: y[-1], ys)
         return new_inner, ys, mys, last
+
+    if donate_inputs:
+        # auto-donation variant: per-step batch inputs as their own
+        # donated argument; hyper rows stay non-donated (see
+        # _one_step_jit).  xs_inputs[j] pairs back with xs_rest[j].
+        def stepkd(inner, xs_inputs, xs_rest, *extras):
+            xs_list = tuple((inp,) + tuple(rest)
+                            for inp, rest in zip(xs_inputs, xs_rest))
+            return _run(inner, xs_list, extras)
+
+        return cached_jit(stepkd, donate_argnums=(0, 1),
+                          graph_key=("scan2d", mcarry_index, key_tag,
+                                     traced.graph_hash),
+                          label=label or "fused/scan")
+
+    def stepk(inner, xs_list, *extras):
+        return _run(inner, xs_list, extras)
 
     return cached_jit(stepk, donate_argnums=(0,),
                       graph_key=("scan2", mcarry_index, key_tag,
@@ -848,7 +944,16 @@ class FusedTrainStep:
             self._mesh is not None and self._dp_size > 1
 
         from .symbol.symbol import graph_eval_fn
-        self._gfn, _, _, self._n_rng = graph_eval_fn(self._symbol, True)
+        # scan-over-layers (MXNET_FUSED_SCAN): runs of structurally
+        # identical blocks lower to ONE lax.scan body over stacked
+        # per-layer params instead of N inlined copies — the jaxpr (and
+        # so the unified program cache key, via graph_hash_of_jaxpr)
+        # shrinks to one layer body; XLA compiles the layer once
+        self._scan_plan = _maybe_scan_plan(self._symbol)
+        self.scan_runs = [] if self._scan_plan is None else \
+            [(r["name"], r["length"]) for r in self._scan_plan["runs"]]
+        self._gfn, _, _, self._n_rng = graph_eval_fn(
+            self._symbol, True, scan=self._scan_plan)
         # pod SPMD fast path (MXNET_POD_SPMD): run the WHOLE step core
         # inside shard_map over the dp axis with a bucketed single-psum
         # gradient exchange.  The GSPMD global-view lowering inserts one
@@ -881,6 +986,8 @@ class FusedTrainStep:
         self._core_sig = None     # input signature the core was traced for
         self._core_cache = {}     # in_sig -> traced program set (retrace
                                   # survival for alternating signatures)
+        self._autodonate_on = False  # per-core liveness decision (see
+                                     # _decide_autodonate)
         self._derive_fn = None    # masters -> low-precision weights (flush)
         self.last_outputs = None
         self._block_outs = None   # scan ys: per-batch outputs of a block
@@ -1423,7 +1530,8 @@ class FusedTrainStep:
     def _build1(self):
         self._jit = _one_step_jit(self._core_closed, label=self._audit_key,
                                   call_fn=self._pod_call(),
-                                  key_tag=self._pod_tag())
+                                  key_tag=self._pod_tag(),
+                                  donate_inputs=self._autodonate_on)
 
     def _buildk(self, k):
         # one scan-jit serves every K (xs arity keys the jit's own cache);
@@ -1434,10 +1542,41 @@ class FusedTrainStep:
             else _scan_block_jit(self._core_closed, mcarry_index=3,
                                  label=self._audit_key,
                                  call_fn=self._pod_call(),
-                                 key_tag=self._pod_tag())
+                                 key_tag=self._pod_tag(),
+                                 donate_inputs=self._autodonate_on)
         self._scan_jit = jitk
         self._jit_block[k] = jitk
         return jitk
+
+    def _decide_autodonate(self, inner, x0):
+        """Trace-time auto-donation decision (MXNET_FUSED_AUTODONATE):
+        donate the staged batch inputs iff EVERY input leaf provably
+        dies inside the traced step — its invar never reaches the core
+        jaxpr's outvars (analysis.cost.jaxpr_dying_inputs).  A graph
+        that echoes an input into its heads keeps the buffer live in
+        `last_outputs`, so donation stays off for the whole input set.
+        The dispatch re-owns staged inputs before a donating call
+        (reown_for_donation): staged arrays can be device_put of HOST
+        memory or adopted caller-owned arrays (prestage/io ring), both
+        unsafe to donate raw."""
+        from . import config as _config
+        if not bool(_config.get("MXNET_FUSED_AUTODONATE")):
+            return False
+        try:
+            import jax
+            from .analysis import cost as _cost
+            n_inner = len(jax.tree_util.tree_leaves(inner))
+            n_inputs = len(jax.tree_util.tree_leaves(tuple(x0[0])))
+            if not n_inputs:
+                return False
+            idx = list(range(n_inner, n_inner + n_inputs))
+            dying = _cost.jaxpr_dying_inputs(self._core_closed._closed,
+                                             idx)
+            return len(dying) == n_inputs
+        except Exception as e:
+            _log.debug("auto-donation liveness analysis failed (%s); "
+                       "keeping inputs undonated", str(e)[:200])
+            return False
 
     # -- per-call ------------------------------------------------------------
     def _metric_leaves(self, eval_metric):
@@ -1635,7 +1774,7 @@ class FusedTrainStep:
                  self._jit_block, self._derive_ws, self._mp_pos,
                  self._w_dtypes, self._pod_axis,
                  self._pod_example, self._pod_plan,
-                 self.pod_stats) = cached
+                 self.pod_stats, self._autodonate_on) = cached
             else:
                 self._core_closed = None
 
@@ -1721,21 +1860,39 @@ class FusedTrainStep:
                         from . import profiler as _profiler
                         _profiler.record_kvstore(
                             "pod_exchange", **self.pod_stats)
+                    self._autodonate_on = self._decide_autodonate(
+                        inner, xs[0])
                     self._jit = None
                     self._jit_block = {}
                     self._scan_jit = None
                 if k == 1:
                     if self._jit is None:
                         self._build1()
-                    new_inner, outs = self._jit(inner, xs[0], fixed,
-                                                rescale_dev)
+                    if self._autodonate_on:
+                        with _quiet_donation():
+                            new_inner, outs = self._jit(
+                                inner,
+                                reown_for_donation(tuple(xs[0][0])),
+                                tuple(xs[0][1:]), fixed, rescale_dev)
+                    else:
+                        new_inner, outs = self._jit(inner, xs[0], fixed,
+                                                    rescale_dev)
                     ys = mys = None
                 else:
                     jitk = self._jit_block.get(k)
                     if jitk is None:
                         jitk = self._buildk(k)
-                    new_inner, ys, mys, outs = jitk(inner, tuple(xs), fixed,
-                                                    rescale_dev)
+                    if self._autodonate_on:
+                        with _quiet_donation():
+                            new_inner, ys, mys, outs = jitk(
+                                inner,
+                                reown_for_donation(
+                                    tuple(tuple(x[0]) for x in xs)),
+                                tuple(tuple(x[1:]) for x in xs),
+                                fixed, rescale_dev)
+                    else:
+                        new_inner, ys, mys, outs = jitk(
+                            inner, tuple(xs), fixed, rescale_dev)
         except Exception as e:
             opt._index_update_count = counts_before
             opt.num_update = num_update_before
@@ -1818,7 +1975,8 @@ class FusedTrainStep:
                 getattr(self, "_mp_pos", None),
                 getattr(self, "_w_dtypes", None),
                 self._pod_axis, getattr(self, "_pod_example", None),
-                getattr(self, "_pod_plan", None), self.pod_stats)
+                getattr(self, "_pod_plan", None), self.pod_stats,
+                self._autodonate_on)
         if was_cold:
             # first step of a signature: write through immediately so the
             # `_seen_*` identity snapshots exist for the fast-path check
@@ -1909,6 +2067,32 @@ class FusedTrainStep:
         """Serialize this step's compiled executables into `directory`
         as program-cache entries (checkpoint payload); returns count."""
         return sum(p.export_to(directory) for p in self.cached_programs())
+
+    def compile_phase_stats(self):
+        """Cold-start phase breakdown for the traced step: framework
+        trace seconds, the traced jaxpr's (recursive) equation count —
+        the graph-size number the XLA compile scales with, ONE layer
+        body per scan-deduped run — and per-program lower/compile
+        seconds from the unified cache (bench's `compile_phases`
+        artifact block reads this)."""
+        core = getattr(self, "_core_closed", None)
+        out = {
+            "trace_s": getattr(core, "trace_s", None)
+            if core is not None else None,
+            "jaxpr_eqns": core.num_eqns() if core is not None else None,
+            "scan_runs": list(getattr(self, "scan_runs", []) or []),
+            "autodonate": bool(getattr(self, "_autodonate_on", False)),
+            "programs": [],
+        }
+        for p in self.cached_programs():
+            out["programs"].append({
+                "label": getattr(p, "label", ""),
+                "compiles": int(getattr(p, "compile_count", 0)),
+                "disk_hits": int(getattr(p, "disk_hits", 0)),
+                "lower_s": float(getattr(p, "lower_s_total", 0.0)),
+                "compile_s": float(getattr(p, "compile_s_total", 0.0)),
+            })
+        return out
 
     def current_outputs(self):
         """Outputs of the batch `block_cursor` points at (per-batch view
@@ -2034,7 +2218,11 @@ class FusedInference:
         self._slot_names = [n for n in self._arg_names
                             if n not in self._data_names]
         self._input_names = list(self._data_names)
-        self._gfn, _, _, self._n_rng = graph_eval_fn(symbol, False)
+        self._scan_plan = _maybe_scan_plan(symbol)
+        self.scan_runs = [] if self._scan_plan is None else \
+            [(r["name"], r["length"]) for r in self._scan_plan["runs"]]
+        self._gfn, _, _, self._n_rng = graph_eval_fn(
+            symbol, False, scan=self._scan_plan)
         # (jit, extra_names, params, aux): ONE reference, swapped whole,
         # so a concurrent dispatch never pairs a rebuilt program with the
         # previous partition's param list (or new params with old aux)

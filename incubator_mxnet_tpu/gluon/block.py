@@ -319,7 +319,12 @@ class _CachedGraph:
             import jax
             is_train = bool(params.get("_train", False))
             if is_train not in cache:
-                cache[is_train] = graph_eval_fn(symbol, is_train)[0]
+                # scan-over-layers: identical repeated blocks in the
+                # hybridized graph lower to one lax.scan body
+                # (MXNET_FUSED_SCAN; None when off or no eligible run)
+                from ..fused import _maybe_scan_plan
+                cache[is_train] = graph_eval_fn(
+                    symbol, is_train, scan=_maybe_scan_plan(symbol))[0]
             gfn = cache[is_train]
             if self.n_rng:
                 key = arrays[-1]

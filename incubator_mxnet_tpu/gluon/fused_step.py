@@ -33,6 +33,7 @@ loop): single-context trainer, no ZeRO/TP sharding, no RNG-consuming ops
 from __future__ import annotations
 
 import logging
+import threading as _threading
 
 import numpy as _np
 
@@ -64,6 +65,35 @@ class _SwapParams:
     def __exit__(self, *exc):
         for p, d in zip(self._params, self._saved):
             p._data = d
+
+
+_SCAN_TRACE = _threading.local()
+
+
+class _ScanLowering:
+    """Arms scan-over-layers for the duration of the fused core's
+    forward trace (MXNET_FUSED_SCAN): `HybridSequential` lowers runs of
+    structurally identical children to ONE `lax.scan` body over stacked
+    per-layer parameters instead of N inlined copies, so XLA compiles
+    the layer body once.  Scoped to the trace — eager user forwards
+    never pay the detection walk."""
+
+    def __enter__(self):
+        from .. import config as _cfg
+        self._on = bool(_cfg.get("MXNET_FUSED_SCAN"))
+        if self._on:
+            _SCAN_TRACE.depth = getattr(_SCAN_TRACE, "depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        if self._on:
+            _SCAN_TRACE.depth -= 1
+
+
+def scan_lowering_active():
+    """True while a fused gluon core trace wants scan-over-layers
+    (checked by `HybridSequential.hybrid_forward`)."""
+    return getattr(_SCAN_TRACE, "depth", 0) > 0
 
 
 class GluonFusedStep:
@@ -157,7 +187,8 @@ class GluonFusedStep:
                 with _SwapParams(tparams, shells), \
                         _SwapParams(aparams, aux_shells), \
                         _autograd.pause(train_mode=True):
-                    out = net(NDArray(data, ctx=ctx))
+                    with _ScanLowering():
+                        out = net(NDArray(data, ctx=ctx))
                     losses = loss_fn(out, NDArray(label, ctx=ctx))
                 # BatchNorm-style aux updates landed in-place on the shells
                 new_aux = tuple(s._data for s in aux_shells)
@@ -451,3 +482,11 @@ class GluonFusedStep:
         """Serialize compiled executables into `directory` (checkpoint
         ``programs/`` payload); returns entries written."""
         return sum(p.export_to(directory) for p in self.cached_programs())
+
+    def compile_phase_stats(self):
+        """Cold-start phase breakdown — the same artifact shape as
+        `fused.FusedTrainStep.compile_phase_stats`, which only touches
+        the attributes both step classes share (traced core, scan runs,
+        unified-cache program wrappers)."""
+        from ..fused import FusedTrainStep
+        return FusedTrainStep.compile_phase_stats(self)

@@ -42,8 +42,50 @@ class Sequential(Block):
         return iter(self._children.values())
 
 
+def _scan_child_sig(block):
+    """Structural identity of a child for scan-over-layers grouping:
+    class, scalar config attributes, the (suffix, shape, dtype,
+    grad_req) list of every owned parameter (CURRENT shapes — deferred
+    init already resolved inside a fused trace), recursively over
+    children.  Two children with equal signatures run the same math
+    modulo their parameter values, so a run of them can lower to one
+    `lax.scan` body over stacked per-layer params."""
+    cfg = []
+    for k, v in vars(block).items():
+        if k in ("_prefix", "_name"):
+            continue
+        if isinstance(v, (bool, int, float, str, type(None))):
+            cfg.append((k, v))
+        elif isinstance(v, tuple) and all(
+                isinstance(e, (bool, int, float, str)) for e in v):
+            cfg.append((k, v))
+        elif isinstance(v, dict) and all(
+                isinstance(e, (bool, int, float, str, type(None)))
+                for e in v.values()):
+            cfg.append((k, tuple(sorted(
+                (str(a), b) for a, b in v.items()))))
+    plist = []
+    for suffix, p in block._collect_params_with_prefix().items():
+        d = p.data()
+        plist.append((suffix, tuple(d.shape), str(d.dtype),
+                      p.grad_req))
+    return (type(block).__name__,
+            tuple(sorted(cfg, key=lambda t: t[0])),
+            tuple(plist),
+            tuple(_scan_child_sig(c) for c in block._children.values()))
+
+
 class HybridSequential(HybridBlock):
-    """Hybridizable stack (reference `basic_layers.py:HybridSequential`)."""
+    """Hybridizable stack (reference `basic_layers.py:HybridSequential`).
+
+    Inside a fused-step trace with MXNET_FUSED_SCAN armed
+    (`gluon.fused_step.scan_lowering_active`), runs of >= 2 structurally
+    identical children (`_scan_child_sig`) evaluate as ONE `lax.scan`
+    body over their stacked parameters instead of N inlined copies —
+    the graph handed to XLA carries one layer body, shrinking compile
+    time for deep repeated stacks.  Bit-parity with the plain loop:
+    stacking is lossless, the body is the same child math, and any
+    failure falls back to inlining that run."""
 
     def __init__(self, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
@@ -53,9 +95,80 @@ class HybridSequential(HybridBlock):
             self.register_child(block)
 
     def hybrid_forward(self, F, x):
-        for block in self._children.values():
+        blocks = list(self._children.values())
+        from ...ndarray.ndarray import NDArray
+        if len(blocks) >= 2 and isinstance(x, NDArray):
+            from ..fused_step import scan_lowering_active
+            if scan_lowering_active():
+                try:
+                    sigs = [_scan_child_sig(b) for b in blocks]
+                except Exception:
+                    sigs = None
+                if sigs is not None:
+                    return self._scan_forward(blocks, sigs, x)
+        for block in blocks:
             x = block(x)
         return x
+
+    def _scan_forward(self, blocks, sigs, x):
+        """The plain child loop with every maximal run of >= 2 equal-
+        signature children collapsed into one `lax.scan` (per-run
+        fallback to inlining on any lowering failure)."""
+        i = 0
+        while i < len(blocks):
+            j = i + 1
+            while j < len(blocks) and sigs[j] == sigs[i]:
+                j += 1
+            if j - i >= 2:
+                try:
+                    x = self._scan_run(blocks[i:j], x)
+                    i = j
+                    continue
+                except Exception:
+                    pass   # inline this run (dead stack eqns are DCE'd)
+            x = blocks[i](x)
+            i += 1
+        return x
+
+    def _scan_run(self, blocks, x):
+        """Evaluate a run of structurally identical children as one
+        `lax.scan`: per-layer params stack as scan xs, the template
+        (first) child runs the body with its Parameters swapped to the
+        per-layer slices, and aux-state updates (BN running stats, in-
+        place on the body shells) come back as scan ys, written back to
+        each layer's parameter storage after the scan."""
+        import jax
+        import jax.numpy as jnp
+        from ...ndarray.ndarray import NDArray
+        from ..fused_step import _SwapParams
+
+        template = blocks[0]
+        plists = [list(b._collect_params_with_prefix().values())
+                  for b in blocks]
+        tparams = plists[0]
+        aux_slots = [s for s, p in enumerate(tparams)
+                     if p.grad_req in (None, "null")]
+        stacks = tuple(
+            jnp.stack([pl[s].data()._data for pl in plists])
+            for s in range(len(tparams)))
+        ctx = x.context
+        x_in = x._data
+
+        def body(c, row):
+            shells = [NDArray(v, ctx=ctx) for v in row]
+            with _SwapParams(tparams, shells):
+                out = template(NDArray(c, ctx=ctx))
+            aux_out = tuple(shells[s]._data for s in aux_slots)
+            return out._data, aux_out
+
+        c_out, ys = jax.lax.scan(body, x_in, stacks)
+        # aux updates land back on each layer's CURRENT storage (the
+        # outer trace's shells) so the fused core gathers them exactly
+        # as the inlined path would
+        for slot_j, s in enumerate(aux_slots):
+            for layer, pl in enumerate(plists):
+                pl[s].data()._data = ys[slot_j][layer]
+        return NDArray(c_out, ctx=ctx)
 
     def __len__(self):
         return len(self._children)

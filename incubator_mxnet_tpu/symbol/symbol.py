@@ -612,10 +612,20 @@ def load_json(json_str):
 # Graph-level inference helpers shared with the executor
 # ---------------------------------------------------------------------------
 
-def graph_eval_fn(symbol, is_train, n_rng_hint=None):
+def graph_eval_fn(symbol, is_train, n_rng_hint=None, scan=None):
     """Build a pure function (args_dict_values, aux_values, key) -> (outputs,
     new_aux) executing the graph.  This function is what the executor jits:
-    the entire Symbol becomes ONE XLA computation."""
+    the entire Symbol becomes ONE XLA computation.
+
+    `scan` is an optional scan-over-layers plan
+    (`analysis.graph_passes.scan_plan(symbol)`): each planned run of
+    structurally identical layer blocks is emitted as ONE `lax.scan`
+    body over per-layer parameters stacked INSIDE the traced program, so
+    XLA compiles the layer body once instead of N inlined copies while
+    arguments, aux states and checkpoints keep their per-layer layout.
+    A run whose per-layer shapes turn out unequal at trace time (or
+    whose carry changes shape) silently falls back to the inlined path —
+    the plan is structural, shapes are only known here."""
     import jax
     import jax.numpy as jnp
 
@@ -626,7 +636,12 @@ def graph_eval_fn(symbol, is_train, n_rng_hint=None):
     arg_nodes = [n for n in topo if n.is_variable and id(n) not in aux_ids]
     aux_nodes = [n for n in topo if n.is_variable and id(n) in aux_ids]
     rng_nodes = [n for n in topo if (not n.is_variable) and n.op.needs_rng]
+    rng_index = {id(n): i for i, n in enumerate(rng_nodes)}
     use_nhwc = _layout.enabled()
+    scan_first = {}
+    if scan:
+        for run in scan.get("runs", ()):
+            scan_first[id(run["segments"][0][0])] = run
 
     def fn(arg_values, aux_values, key):
         env = {}
@@ -637,24 +652,22 @@ def graph_eval_fn(symbol, is_train, n_rng_hint=None):
             env[id(node)] = (v,)
             aux_env[id(node)] = v
         keys = jax.random.split(key, max(len(rng_nodes), 1))
-        rng_i = 0
         new_aux = dict(aux_env)
         # internal execution-layout pass (ops/layout.py): spatial ops run
         # NHWC (MXU-friendly), elementwise ops flow the tag through, every
         # other consumer and the graph heads see the API's NCHW — the
         # reference's cuDNN/MKLDNN layout selection done at graph level
         tags = {}
-        for node in topo:
-            if node.is_variable:
-                continue
+
+        def eval_node(node, e_env, e_tags, e_aux, key_for):
             params = dict(node.attrs)
             if node.op.mode_dependent:
                 params["_train"] = bool(is_train)
-            ins = [env[id(src)][idx] for src, idx in node.inputs]
+            ins = [e_env[id(src)][idx] for src, idx in node.inputs]
             op_fn = node.op.fn
             out_tag = None
             if use_nhwc:
-                in_tags = [tags.get((id(src), idx))
+                in_tags = [e_tags.get((id(src), idx))
                            for src, idx in node.inputs]
                 nat = _layout.NATIVE.get(node.op.name)
                 if nat is not None and nat[1](node.op.name, params, ins[0]):
@@ -678,8 +691,7 @@ def graph_eval_fn(symbol, is_train, n_rng_hint=None):
                 for pname in node.op.dynamic_params:
                     ins.append(jnp.asarray(params.pop(pname), dtype="float32"))
             if node.op.needs_rng:
-                ins.append(keys[rng_i])
-                rng_i += 1
+                ins.append(key_for(node))
             out = op_fn(params, *ins)
             if not isinstance(out, (tuple, list)):
                 out = (out,)
@@ -688,14 +700,99 @@ def graph_eval_fn(symbol, is_train, n_rng_hint=None):
             if naux and len(out) > nout:
                 # write back aux updates
                 for (src, _), upd in zip(node.inputs[-naux:], out[nout:]):
-                    if id(src) in new_aux:
-                        new_aux[id(src)] = upd
-            env[id(node)] = tuple(out[:nout])
+                    if id(src) in e_aux:
+                        e_aux[id(src)] = upd
+            e_env[id(node)] = tuple(out[:nout])
             if out_tag == "native":
-                tags[(id(node), 0)] = "NHWC"
+                e_tags[(id(node), 0)] = "NHWC"
             elif out_tag == "all":
                 for oi in range(nout):
-                    tags[(id(node), oi)] = "NHWC"
+                    e_tags[(id(node), oi)] = "NHWC"
+
+        def main_key(node):
+            return keys[rng_index[id(node)]]
+
+        def try_scan_run(run):
+            """Emit one planned run as lax.scan; False -> inline it."""
+            length = run["length"]
+            carry_src, carry_idx = run["carry"]
+            c0 = env[id(carry_src)][carry_idx]
+            if tags.get((id(carry_src), carry_idx)) == "NHWC":
+                # scan carries cross in API layout (a lossless transpose
+                # pair against the inlined path's flowing tag)
+                c0 = _layout.to_nchw(c0)
+            stacks, aux_stacks, key_stacks = [], [], []
+            for slot_nodes in run["params"]:
+                vals = [env[id(v)][0] for v in slot_nodes]
+                s0 = (vals[0].shape, vals[0].dtype)
+                if any((v.shape, v.dtype) != s0 for v in vals[1:]):
+                    return False
+                stacks.append(jnp.stack(vals))
+            for slot_nodes in run["aux"]:
+                vals = [new_aux[id(v)] for v in slot_nodes]
+                s0 = (vals[0].shape, vals[0].dtype)
+                if any((v.shape, v.dtype) != s0 for v in vals[1:]):
+                    return False
+                aux_stacks.append(jnp.stack(vals))
+            for slot_nodes in run["rng"]:
+                key_stacks.append(jnp.stack(
+                    [keys[rng_index[id(n)]] for n in slot_nodes]))
+            template = run["segments"][0]
+            t_param = {id(v): s
+                       for s, slots in enumerate(run["params"])
+                       for v in (slots[0],)}
+            t_aux_vars = [slots[0] for slots in run["aux"]]
+            t_rng = {id(n): s for s, slots in enumerate(run["rng"])
+                     for n in (slots[0],)}
+            boundary0 = template[-1]
+
+            def body(c, xs):
+                pvals, avals, kvals = xs
+                benv = {id(carry_src):
+                        tuple(c if i == carry_idx else None
+                              for i in range(carry_idx + 1))}
+                for v, s in t_param.items():
+                    benv[v] = (pvals[s],)
+                baux = {}
+                for v, a in zip(t_aux_vars, avals):
+                    benv[id(v)] = (a,)
+                    baux[id(v)] = a
+                btags = {}
+                for n in template:
+                    eval_node(n, benv, btags, baux,
+                              lambda m: kvals[t_rng[id(m)]])
+                c_out = benv[id(boundary0)][0]
+                if btags.get((id(boundary0), 0)) == "NHWC":
+                    c_out = _layout.to_nchw(c_out)
+                return c_out, tuple(baux[id(v)] for v in t_aux_vars)
+
+            xs = (tuple(stacks), tuple(aux_stacks), tuple(key_stacks))
+            xs0 = jax.tree_util.tree_map(
+                lambda s: jax.ShapeDtypeStruct(s.shape[1:], s.dtype), xs)
+            try:
+                c_aval = jax.eval_shape(lambda c, x: body(c, x)[0], c0, xs0)
+            except Exception:
+                return False
+            if tuple(c_aval.shape) != tuple(c0.shape) or \
+                    c_aval.dtype != c0.dtype:
+                return False   # shape-changing block: scan carry invalid
+            carry_out, ys = jax.lax.scan(body, c0, xs)
+            env[id(run["boundary"])] = (carry_out,)
+            for slot, layer_nodes in enumerate(run["aux"]):
+                for li, v in enumerate(layer_nodes):
+                    if id(v) in new_aux:
+                        new_aux[id(v)] = ys[slot][li]
+            skip.update(run["covered"])
+            return True
+
+        skip = set()
+        for node in topo:
+            if node.is_variable or id(node) in skip:
+                continue
+            run = scan_first.get(id(node))
+            if run is not None and try_scan_run(run):
+                continue
+            eval_node(node, env, tags, new_aux, main_key)
         outputs = tuple(
             _layout.to_nchw(env[id(node)][idx])
             if tags.get((id(node), idx)) == "NHWC" else env[id(node)][idx]

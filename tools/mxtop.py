@@ -162,12 +162,15 @@ def render(snap):
                         _fmt(sup.get("stragglers_flagged"), 0)))
     cache = _namespace(fleet, "cache.counters")
     if cache:
-        lines.append("  CACHE   compiles=%s disk_hits=%s mem_hits=%s "
-                     "stores=%s"
+        lines.append("  CACHE   compiles=%s disk_hits=%s disk_misses=%s "
+                     "mem_hits=%s stores=%s lower_s=%s compile_s=%s"
                      % (_fmt(cache.get("compiles"), 0),
                         _fmt(cache.get("disk_hits"), 0),
+                        _fmt(cache.get("disk_misses"), 0),
                         _fmt(cache.get("mem_hits"), 0),
-                        _fmt(cache.get("stores"), 0)))
+                        _fmt(cache.get("stores"), 0),
+                        _fmt(cache.get("lower_s_total"), 2),
+                        _fmt(cache.get("compile_s_total"), 2)))
     worker = _namespace(fleet, "worker")
     if worker:
         lines.append("  WORKER  executed=%s dedup_hits=%s outstanding=%s"

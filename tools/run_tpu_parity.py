@@ -390,13 +390,39 @@ def coldstart_stage():
     compiles).  The artifact records cold vs warm compile_s and the
     warm/cold ratio, so program-cache regressions (a key that stops
     matching across processes, a serialization break) become checkable
-    evidence next to the parity outcomes."""
+    evidence next to the parity outcomes.
+
+    A second subprocess runs ``warmup.py --measure-budgets`` against
+    COST_BUDGETS.json's 'measured' section: per-program compile_s and
+    peak_hbm_mb, plus the fused-step-vs-pure-JAX compile ratio (<=1.5x
+    cap).  A missing required entry or a regression past tolerance
+    fails the stage (``budget_gate_ok`` false, rc nonzero)."""
+    out = {}
     try:
         sys.path.insert(0, os.path.join(REPO, "tools"))
         from warmup import coldstart_probe
-        return coldstart_probe()
+        out = coldstart_probe()
     except Exception as exc:
-        return {"error": f"coldstart stage failed: {exc!r}"}
+        out = {"error": f"coldstart stage failed: {exc!r}"}
+    cmd = [sys.executable, os.path.join(REPO, "tools", "warmup.py"),
+           "--measure-budgets", "--budgets",
+           os.path.join(REPO, "COST_BUDGETS.json"), "--json"]
+    try:
+        r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                           timeout=900)
+        gate = json.loads(r.stdout.strip().splitlines()[-1])
+        out["budgets"] = {
+            "rc": gate.get("rc"),
+            "missing": gate.get("missing"),
+            "measured": gate.get("measured"),
+            "findings": [f for f in gate.get("findings", ())
+                         if f.get("severity") != "hint"],
+        }
+        out["budget_gate_ok"] = gate.get("rc") == 0
+    except Exception as exc:
+        out["budgets"] = {"error": f"budget gate failed: {exc!r}"}
+        out["budget_gate_ok"] = False
+    return out
 
 
 def main():
