@@ -116,6 +116,10 @@ CODE_TABLE = _build_code_table([
     ("blocking-h2d-in-loop", WARN, ("source.io",),
      "blocking device_put/as_in_context feed inside a training loop; "
      "the h2d staging ring (MXNET_IO_RING) overlaps the transfer"),
+    ("kv-cache-recompile", WARN, ("source.decode",),
+     "KV cache grown by concatenate in a decode loop recompiles every "
+     "step; preallocate fixed-shape + dynamic_update_slice "
+     "(serving.DecodeEngine)"),
     # -- runtime trace passes ------------------------------------------------
     ("shape-churn", WARN, ("trace.recompile",),
      "new jit signature forced a fresh XLA compile (ragged batches etc.)"),

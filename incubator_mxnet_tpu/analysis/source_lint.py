@@ -175,7 +175,13 @@ _PASS_BY_CODE = {"host-sync-in-loop": "source.hostsync",
                  "sleep-under-lock": "source.locks",
                  "unjoined-thread-in-init": "source.thread",
                  "untracked-stats": "source.obs",
-                 "blocking-h2d-in-loop": "source.io"}
+                 "blocking-h2d-in-loop": "source.io",
+                 "kv-cache-recompile": "source.decode"}
+
+# identifiers that mark a concatenation target as a decode KV cache
+# (token substrings of the assignment target)
+_CACHEY = ("cache", "kv", "past_key", "past_kv")
+_CONCAT_CALLS = {"concatenate", "concat", "hstack", "vstack", "stack"}
 
 # identifiers that mark a with-scope as a critical section for the
 # sleep-under-lock lint (token substrings of the context expression)
@@ -440,7 +446,41 @@ class _Visitor(ast.NodeVisitor):
             for tgt in node.targets:
                 if isinstance(tgt, ast.Name):
                     self.served_names.add(tgt.id)
+        self._check_kv_cache_growth(node)
         self.generic_visit(node)
+
+    def _check_kv_cache_growth(self, node):
+        """``cache = concatenate([cache, new], ...)`` inside a decode
+        loop: the cache's length axis grows every token, so every step
+        presents XLA a NOVEL shape — one multi-second compile per token
+        generated.  The fix is a fixed-shape preallocated cache written
+        with dynamic_update_slice (a donated carry, the
+        `serving.DecodeEngine` / `llm.decode_core` discipline) so the
+        step program's signature never changes."""
+        if not self.loop_depth or not isinstance(node.value, ast.Call):
+            return
+        func = node.value.func
+        name = func.attr if isinstance(func, ast.Attribute) else \
+            func.id if isinstance(func, ast.Name) else None
+        if name not in _CONCAT_CALLS:
+            return
+        targets = [t.id for tgt in node.targets for t in ast.walk(tgt)
+                   if isinstance(t, ast.Name)]
+        fed_back = {s.id for s in ast.walk(node.value)
+                    if isinstance(s, ast.Name)}
+        for tgt in targets:
+            if tgt in fed_back and \
+                    any(tok in tgt.lower() for tok in _CACHEY):
+                self._add(
+                    "kv-cache-recompile", node.lineno,
+                    f"KV cache '{tgt}' grows by {name}() every loop "
+                    "iteration: each decode step presents XLA a new "
+                    "shape, costing one compile per generated token — "
+                    "preallocate a fixed-shape cache and write with "
+                    "dynamic_update_slice (the serving.DecodeEngine "
+                    "donated-carry discipline), padding prompts onto a "
+                    "bucket ladder")
+                return
 
     # -- supervised scopes ---------------------------------------------------
     def _visit_with(self, node):

@@ -65,6 +65,23 @@ KNOBS = {
     # -- kvstore / distributed ----------------------------------------------
     "MXNET_KVSTORE_REDUCTION_NTHREADS": (int, 4, "subsumed",
                                          "reduce is one XLA collective"),
+    "MXNET_DECODE_SLOTS": (int, 8, "honored",
+                           "KV-cache rows the continuous-batching "
+                           "DecodeEngine advances per tick (the decode-"
+                           "step program's fixed batch dimension)"),
+    "MXNET_DECODE_BUCKETS": (str, "8,16,32", "honored",
+                             "prompt-length bucket ladder for decode "
+                             "prefill: one compiled signature per "
+                             "bucket, prompts padded up"),
+    "MXNET_DECODE_ADMIT_PER_TICK": (int, 2, "honored",
+                                    "max sequences admitted (prefilled) "
+                                    "per decode tick, so long prefill "
+                                    "bursts never stall the running "
+                                    "slots' decode step"),
+    "MXNET_DECODE_MAX_NEW": (int, 32, "honored",
+                             "default generation budget per sequence "
+                             "when a request does not set "
+                             "max_new_tokens"),
     "MXNET_KVSTORE_BIGARRAY_BOUND": (int, 1000000, "honored",
                                      "dist server round accounting "
                                      "threshold (dist/server.py)"),

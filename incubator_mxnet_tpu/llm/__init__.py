@@ -1,0 +1,17 @@
+"""Transformer language model: the flagship attention workload.
+
+`model.py` defines the gluon `TransformerLM` (embedding, N identical
+pre-norm blocks over the registered `BlockwiseAttention` op, tied
+output head) and `lm_symbol`, its `Module.fit`-ready training graph.
+`decode_core.py` holds the pure-function decode plane: stacked
+per-layer parameters scanned by one fixed-shape decode-step program
+and per-bucket prefill programs, with the KV cache as a donated carry
+— what `serving/decode.py`'s continuous-batching `DecodeEngine` runs.
+"""
+from .model import (LMConfig, TransformerBlock, TransformerLM, lm_symbol,
+                    lm_block_op_count)
+from .decode_core import (DecodePrograms, stack_lm_params, init_kv_cache)
+
+__all__ = ["LMConfig", "TransformerBlock", "TransformerLM", "lm_symbol",
+           "lm_block_op_count", "DecodePrograms", "stack_lm_params",
+           "init_kv_cache"]

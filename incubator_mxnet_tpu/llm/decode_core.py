@@ -1,0 +1,260 @@
+"""Pure-function decode plane for the transformer LM.
+
+Training owns the (B, T) full-sequence graph; serving owns two other
+programs built from the SAME parameters:
+
+* **prefill** — one bucketed-length forward of a single new sequence
+  that writes its K/V into an assigned cache slot and returns the
+  first generated token.  One compiled signature per prompt bucket.
+* **decode step** — ONE fixed-shape program advancing every slot by
+  one token against the cache.  Its input signature never changes
+  (slots, max_len and the parameter shapes are baked), so the steady
+  state runs zero XLA compiles no matter how sequences arrive, finish,
+  or interleave.
+
+The KV cache is a donated carry: both programs consume their cache
+arguments (`donate_argnums`) and return the updated cache, so HBM
+holds one copy regardless of decode depth — the same donation
+discipline as the fused train step, through the same
+`compile.cached_jit` tiers (disk-warm processes spin up with zero
+compiles).
+
+Everything here is torch-free math on stacked parameters:
+`stack_lm_params` turns a trained Module/gluon parameter dict into
+per-layer arrays stacked on a leading L axis, so both programs scan
+one layer body instead of unrolling N copies — mirroring the
+scan-over-layers dedup the training graph gets from `scan_plan`.
+"""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+__all__ = ["stack_lm_params", "init_kv_cache", "DecodePrograms"]
+
+_NEG = -1e30
+
+# suffix -> stacked key; every transformer block parameter the decode
+# plane needs, in one table so a missing/renamed parameter fails loudly
+_LAYER_SUFFIXES = {
+    "ln1_gamma": "ln1_gamma", "ln1_beta": "ln1_beta",
+    "qkv_weight": "qkv_weight", "qkv_bias": "qkv_bias",
+    "out_proj_weight": "out_weight", "out_proj_bias": "out_bias",
+    "ln2_gamma": "ln2_gamma", "ln2_beta": "ln2_beta",
+    "fc1_weight": "fc1_weight", "fc1_bias": "fc1_bias",
+    "fc2_weight": "fc2_weight", "fc2_bias": "fc2_bias",
+}
+
+
+def _as_np(a):
+    return a.asnumpy() if hasattr(a, "asnumpy") else np.asarray(a)
+
+
+def stack_lm_params(arg_params, cfg):
+    """Trained parameter dict -> stacked decode pytree.
+
+    Accepts the `Module.get_params()` arg dict (or any name->array
+    mapping with the `llm.model` naming scheme; NDArray or numpy
+    values).  Returns ``{"embed", "final_ln_gamma", "final_ln_beta",
+    "layers": {suffix: (L, ...)}}`` as jax arrays.
+    """
+    import jax.numpy as jnp
+    from ..base import MXNetError
+    names = dict(arg_params)
+
+    def find(suffix):
+        hits = [k for k in names if k.endswith(suffix)]
+        if len(hits) != 1:
+            raise MXNetError(
+                "stack_lm_params: expected exactly one parameter ending "
+                "with %r, found %r" % (suffix, sorted(hits)))
+        return _as_np(names[hits[0]])
+
+    out = {"embed": jnp.asarray(find("embed_weight")),
+           "final_ln_gamma": jnp.asarray(find("final_ln_gamma")),
+           "final_ln_beta": jnp.asarray(find("final_ln_beta"))}
+    layers = {}
+    for i in range(cfg.num_layers):
+        for suffix, key in _LAYER_SUFFIXES.items():
+            layers.setdefault(key, []).append(
+                find("block%d_%s" % (i, suffix)))
+    out["layers"] = {k: jnp.asarray(np.stack(v)) for k, v in layers.items()}
+    return out
+
+
+def init_kv_cache(cfg, slots):
+    """Zeroed (cache_k, cache_v), each (L, slots, max_len, H, D)."""
+    import jax.numpy as jnp
+    shape = (cfg.num_layers, int(slots), cfg.max_len, cfg.num_heads,
+             cfg.head_dim)
+    dtype = jnp.dtype(cfg.param_dtype)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+# ---------------------------------------------------------------------------
+# layer math (must match ops used by llm/model.py exactly: LayerNorm
+# eps 1e-5, exact gelu, 1/sqrt(D)-scaled attention)
+# ---------------------------------------------------------------------------
+
+def _ln(x, gamma, beta, eps=1e-5):
+    import jax
+    import jax.numpy as jnp
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + eps) * gamma + beta
+
+
+def _layer_full(h, lp, heads, attn_block_size):
+    """Full-sequence block forward; returns (h_out, (k, v)) with k/v
+    shaped (B, T, H, D) for the prefill cache write."""
+    import jax
+    import jax.numpy as jnp
+    from ..parallel.ring_attention import blockwise_attention
+    b, t, c = h.shape
+    d = c // heads
+    hn = _ln(h, lp["ln1_gamma"], lp["ln1_beta"])
+    qkv = hn @ lp["qkv_weight"].T + lp["qkv_bias"]
+    q, k, v = (a.reshape(b, t, heads, d)
+               for a in jnp.split(qkv, 3, axis=-1))
+    attn = blockwise_attention(q, k, v, block_size=attn_block_size,
+                               causal=True)
+    h = h + attn.reshape(b, t, c) @ lp["out_weight"].T + lp["out_bias"]
+    hn = _ln(h, lp["ln2_gamma"], lp["ln2_beta"])
+    f = jax.nn.gelu(hn @ lp["fc1_weight"].T + lp["fc1_bias"],
+                    approximate=False)
+    h = h + f @ lp["fc2_weight"].T + lp["fc2_bias"]
+    return h, (k, v)
+
+
+def _layer_step(h, lp, ck, cv, positions, heads):
+    """One-token block forward against the slot cache.
+
+    h (S, C) current activations; ck/cv (S, M, H, D) this layer's
+    cache; positions (S,) the index each slot's new K/V lands at.
+    Returns (h_out, ck, cv) with the new K/V written in.
+    """
+    import jax
+    import jax.numpy as jnp
+    s, c = h.shape
+    m = ck.shape[1]
+    d = c // heads
+    hn = _ln(h, lp["ln1_gamma"], lp["ln1_beta"])
+    qkv = hn @ lp["qkv_weight"].T + lp["qkv_bias"]
+    q, k, v = (a.reshape(s, heads, d) for a in jnp.split(qkv, 3, axis=-1))
+
+    def put(cache_row, new, pos):
+        z = jnp.zeros((), pos.dtype)
+        return jax.lax.dynamic_update_slice(cache_row, new[None], (pos, z, z))
+
+    ck = jax.vmap(put)(ck, k, positions)
+    cv = jax.vmap(put)(cv, v, positions)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, dtype=h.dtype))
+    scores = jnp.einsum("shd,smhd->shm", q, ck) * scale
+    visible = jnp.arange(m)[None, :] <= positions[:, None]     # (S, M)
+    scores = jnp.where(visible[:, None, :], scores,
+                       jnp.asarray(_NEG, dtype=scores.dtype))
+    probs = jax.nn.softmax(scores, axis=-1)
+    attn = jnp.einsum("shm,smhd->shd", probs, cv).reshape(s, c)
+    h = h + attn @ lp["out_weight"].T + lp["out_bias"]
+    hn = _ln(h, lp["ln2_gamma"], lp["ln2_beta"])
+    f = jax.nn.gelu(hn @ lp["fc1_weight"].T + lp["fc1_bias"],
+                    approximate=False)
+    return h + f @ lp["fc2_weight"].T + lp["fc2_bias"], ck, cv
+
+
+# ---------------------------------------------------------------------------
+# programs
+# ---------------------------------------------------------------------------
+
+class DecodePrograms:
+    """The two cached-jit programs of the decode plane.
+
+    One `CachedProgram` per logical graph: ``prefill`` accumulates one
+    compiled signature per prompt bucket; ``step`` holds exactly one.
+    Both donate their cache arguments.  `program_count()` is the
+    zero-recompile certification hook (same contract as
+    `FusedInference.program_count`).
+    """
+
+    def __init__(self, cfg, params, label="lm"):
+        from ..compile import cached_jit, graph_hash_of_text
+        self.cfg = cfg
+        self.params = params
+        sig = [(k, tuple(v.shape), str(v.dtype))
+               for k, v in sorted(params["layers"].items())]
+        base = graph_hash_of_text("llm-decode", cfg.to_dict(), sig,
+                                  tuple(params["embed"].shape))
+        heads, bs = cfg.num_heads, cfg.attn_block_size
+
+        def prefill(p, ck, cv, tokens, slot, length):
+            import jax
+            import jax.numpy as jnp
+            emb = p["embed"][tokens]                     # (1, Tb, C)
+
+            def body(h, lp):
+                h, kv = _layer_full(h, lp, heads, bs)
+                return h, kv
+
+            h, (ks, vs) = jax.lax.scan(body, emb, p["layers"])
+            # ks (L, 1, Tb, H, D) -> cache rows [l, slot, :Tb]
+            z = jnp.zeros((), jnp.int32)
+            start = (z, jnp.asarray(slot).astype(jnp.int32), z, z, z)
+            ck = jax.lax.dynamic_update_slice(ck, ks, start)
+            cv = jax.lax.dynamic_update_slice(cv, vs, start)
+            hn = _ln(h, p["final_ln_gamma"], p["final_ln_beta"])
+            logits = hn[0, length - 1] @ p["embed"].T    # (V,)
+            return ck, cv, jnp.argmax(logits).astype(jnp.int32), logits
+
+        def step(p, ck, cv, tokens, positions):
+            import jax
+            import jax.numpy as jnp
+            h = p["embed"][tokens]                       # (S, C)
+
+            def body(carry, xs):
+                lp, ck_l, cv_l = xs
+                h, ck_l, cv_l = _layer_step(carry, lp, ck_l, cv_l,
+                                            positions, heads)
+                return h, (ck_l, cv_l)
+
+            h, (ck, cv) = jax.lax.scan(body, h, (p["layers"], ck, cv))
+            hn = _ln(h, p["final_ln_gamma"], p["final_ln_beta"])
+            logits = hn @ p["embed"].T                   # (S, V)
+            return ck, cv, jnp.argmax(logits, axis=-1).astype(jnp.int32), \
+                logits
+
+        self.prefill = cached_jit(prefill, donate_argnums=(1, 2),
+                                  graph_key=base + "-prefill",
+                                  label="%s.prefill" % label)
+        self.step = cached_jit(step, donate_argnums=(1, 2),
+                               graph_key=base + "-step",
+                               label="%s.step" % label)
+
+    def program_count(self):
+        return self.prefill._cache_size() + self.step._cache_size()
+
+    def compile_count(self):
+        return self.prefill.compile_count + self.step.compile_count
+
+    def warmup(self, slots, buckets):
+        """Compile every signature the engine will ever dispatch: one
+        prefill per bucket plus the decode step, against a scratch
+        cache (donation consumes it; the engine's live cache is built
+        after).  Returns the number of cold compiles this cost."""
+        import jax.numpy as jnp
+        from .. import fused as _fused
+        before = self.compile_count()
+        ck, cv = init_kv_cache(self.cfg, slots)
+        # donation safety: never hand a possibly-host-staged buffer to
+        # a donating AOT program (see fused.reown_for_donation)
+        ck, cv = _fused.reown_for_donation((ck, cv))
+        for b in sorted(set(int(x) for x in buckets)):
+            tokens = jnp.zeros((1, b), jnp.int32)
+            ck, cv, _, _ = self.prefill(self.params, ck, cv, tokens,
+                                        jnp.int32(0), jnp.int32(1))
+        s = ck.shape[1]
+        ck, cv, _, _ = self.step(self.params, ck, cv,
+                                 jnp.zeros((s,), jnp.int32),
+                                 jnp.zeros((s,), jnp.int32))
+        del ck, cv
+        return self.compile_count() - before

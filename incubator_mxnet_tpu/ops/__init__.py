@@ -17,6 +17,7 @@ from . import matrix        # noqa: F401
 from . import init_ops      # noqa: F401
 from . import random_ops    # noqa: F401
 from . import nn            # noqa: F401
+from . import attention     # noqa: F401
 from . import loss_output   # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import linalg_ops    # noqa: F401

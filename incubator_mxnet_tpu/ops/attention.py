@@ -1,0 +1,85 @@
+"""Attention operators for the transformer LM workload.
+
+One registered op, ``BlockwiseAttention``: multi-head scaled-dot-product
+attention over packed ``(batch, time, channels)`` activations, lowered
+through `parallel/ring_attention.blockwise_attention` — the flash-style
+online-softmax recurrence that never materializes the (T, T) score
+matrix.  The projections around it (qkv, out_proj) stay ordinary
+`FullyConnected` nodes so the megatron sharding rules
+(`parallel/tensor_parallel.ShardingRules.megatron`) see them by name and
+the mxcost dot-class rules price them; this op prices only the
+score/value contractions it owns via `cost_meta`.
+
+Registering the op here (rather than hiding the attention math inside a
+gluon block) keeps saved LM symbol JSON self-describing: a checkpoint's
+``*-symbol.json`` round-trips through `sym.load` in a fresh process with
+no llm/ import.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register, REQUIRED
+
+
+def _attn_flops(params, in_avals, out_avals):
+    """2*B*H*T*T*D for QK^T plus the same for scores@V."""
+    q = in_avals[0]
+    b, t, c = (int(d) for d in q.shape[-3:])
+    return 4.0 * b * t * t * c
+
+
+@register("BlockwiseAttention", nin=3,
+          params={"num_heads": REQUIRED, "causal": True,
+                  "block_size": None},
+          input_names=["query", "key", "value"],
+          cost_meta={"flops": _attn_flops})
+def _blockwise_attention(params, q, k, v):
+    """Multi-head attention on (B, T, C) inputs.
+
+    Splits channels into ``num_heads`` heads, runs the blockwise exact-
+    softmax recurrence, and re-packs.  ``block_size=None`` lets the
+    kernel pick its tile; ``causal`` masks future positions.
+    """
+    from ..parallel.ring_attention import blockwise_attention
+    heads = int(params["num_heads"])
+    causal = bool(params.get("causal", True))
+    block_size = params.get("block_size")
+    if block_size is not None:
+        block_size = int(block_size)
+    b, t, c = q.shape[-3], q.shape[-2], q.shape[-1]
+    if c % heads:
+        from ..base import MXNetError
+        raise MXNetError(
+            "BlockwiseAttention: channels (%d) not divisible by "
+            "num_heads (%d)" % (c, heads))
+    d = c // heads
+
+    def split(x):
+        return x.reshape(b, t, heads, d)
+
+    out = blockwise_attention(split(q), split(k), split(v),
+                              block_size=block_size, causal=causal)
+    return out.reshape(b, t, c)
+
+
+def naive_attention(q, k, v, num_heads, causal=True):
+    """Reference O(T^2)-memory attention on (B, T, C) packed inputs —
+    materializes the full score matrix.  The parity oracle for
+    `BlockwiseAttention` (tests/test_ring_attention.py) and the naive
+    lane of the bench_ops attention battery; not a registered op."""
+    b, t, c = q.shape
+    d = c // num_heads
+    qh = q.reshape(b, t, num_heads, d).transpose(0, 2, 1, 3)
+    kh = k.reshape(b, t, num_heads, d).transpose(0, 2, 1, 3)
+    vh = v.reshape(b, t, num_heads, d).transpose(0, 2, 1, 3)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / jnp.sqrt(
+        jnp.asarray(d, dtype=q.dtype))
+    if causal:
+        mask = jnp.tril(jnp.ones((t, t), dtype=bool))
+        scores = jnp.where(mask[None, None], scores,
+                           jnp.asarray(-1e30, dtype=scores.dtype))
+    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return out.transpose(0, 2, 1, 3).reshape(b, t, c)

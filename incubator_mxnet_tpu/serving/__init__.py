@@ -35,6 +35,12 @@ Layers:
   weight-swap with zero dropped requests and zero XLA compiles, and
   priority classes (interactive/batch/best_effort) that shed lowest
   first under overload.
+* `DecodeEngine` / `DecodeReplica` (decode.py) — the state-carrying
+  request path: continuous-batching autoregressive LM decode over a
+  fixed slot pool and donated KV-cache carry (llm.decode_core), per-
+  bucket prefill + one fixed-shape decode-step program, admitting and
+  evicting sequences every tick with zero steady-state recompiles;
+  the Replica face plugs it into the router/fleet layers unchanged.
 * `FleetManager` (fleet.py) over `FleetHost` handles + `serving.hostd`
   host agents — the fleet layer: host-aware anti-affinity placement,
   host liveness through the SAME `dist.membership` table the elastic
@@ -69,9 +75,11 @@ from .replica import (Replica, LocalReplica, RemoteReplica,
 from .router import ReplicaRouter, PRIORITIES
 from .fleet import (FleetManager, Autoscaler, ReplicaSpec, FleetHost,
                     InProcessHost, AgentHost)
+from .decode import DecodeEngine, DecodeReplica
 
 __all__ = ["ServedModel", "MicroBatcher", "ModelServer", "ServingMetrics",
            "LatencyReservoir", "Replica", "LocalReplica", "RemoteReplica",
            "ReplicaLostError", "ReplicaRouter", "PRIORITIES",
            "DEFAULT_BUCKETS", "FleetManager", "Autoscaler", "ReplicaSpec",
-           "FleetHost", "InProcessHost", "AgentHost"]
+           "FleetHost", "InProcessHost", "AgentHost", "DecodeEngine",
+           "DecodeReplica"]

@@ -760,6 +760,11 @@ _SUPPRESSION_FIXTURES = {
         "for batch in it:\n"
         "    x = jax.device_put(batch)\n"
         "    mod.fit_step(x, metric)\n", 3),
+    "kv-cache-recompile": (
+        "import jax.numpy as jnp\n"
+        "for t in range(max_new):\n"
+        "    kv_cache = jnp.concatenate([kv_cache, new_kv], axis=1)\n"
+        "    tok = decode_step(params, kv_cache, tok)\n", 3),
 }
 
 

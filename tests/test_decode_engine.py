@@ -1,0 +1,201 @@
+"""Continuous-batching decode engine: admission/eviction lifecycle,
+priority ordering, the zero-steady-state-recompile contract, kill
+semantics, hot weight swap, and router failover over `DecodeReplica`s."""
+import time
+
+import numpy as np
+import pytest
+
+from concurrent.futures import wait as _wait
+
+from incubator_mxnet_tpu import analysis
+from incubator_mxnet_tpu.base import MXNetError
+from incubator_mxnet_tpu.llm import LMConfig
+from incubator_mxnet_tpu.serving import (DecodeEngine, DecodeReplica,
+                                         ReplicaLostError, ReplicaRouter)
+
+BUCKETS = (4, 8)
+
+
+def _cfg():
+    return LMConfig(vocab_size=32, num_layers=2, num_heads=2, hidden=8,
+                    ffn_mult=2, max_len=24, eos_id=0)
+
+
+def _params(cfg, seed=0):
+    """Random parameters under the llm.model naming scheme (the decode
+    plane only needs names + shapes, not trained weights)."""
+    rng = np.random.default_rng(seed)
+    c, f = cfg.hidden, cfg.hidden * cfg.ffn_mult
+    mk = lambda *s: rng.standard_normal(s).astype(np.float32) * 0.1  # noqa: E731
+    p = {"lm_embed_weight": mk(cfg.vocab_size, c),
+         "lm_final_ln_gamma": np.ones((c,), np.float32),
+         "lm_final_ln_beta": np.zeros((c,), np.float32)}
+    for i in range(cfg.num_layers):
+        pre = "lm_block%d_" % i
+        p[pre + "ln1_gamma"] = np.ones((c,), np.float32)
+        p[pre + "ln1_beta"] = np.zeros((c,), np.float32)
+        p[pre + "qkv_weight"] = mk(3 * c, c)
+        p[pre + "qkv_bias"] = np.zeros((3 * c,), np.float32)
+        p[pre + "out_proj_weight"] = mk(c, c)
+        p[pre + "out_proj_bias"] = np.zeros((c,), np.float32)
+        p[pre + "ln2_gamma"] = np.ones((c,), np.float32)
+        p[pre + "ln2_beta"] = np.zeros((c,), np.float32)
+        p[pre + "fc1_weight"] = mk(f, c)
+        p[pre + "fc1_bias"] = np.zeros((f,), np.float32)
+        p[pre + "fc2_weight"] = mk(c, f)
+        p[pre + "fc2_bias"] = np.zeros((c,), np.float32)
+    return p
+
+
+def _engine(**kw):
+    cfg = _cfg()
+    kw.setdefault("slots", 4)
+    kw.setdefault("buckets", BUCKETS)
+    return cfg, DecodeEngine(cfg, _params(cfg), **kw)
+
+
+def test_submit_resolves_generated_continuations():
+    cfg, eng = _engine()
+    try:
+        futs = [eng.submit([1 + (i % 5), 2, 3], max_new_tokens=4,
+                           rid="r%d" % i) for i in range(6)]
+        done, not_done = _wait(futs, timeout=60.0)
+        assert not not_done
+        for i, f in enumerate(futs):
+            out = f.result(0)
+            assert out["rid"] == "r%d" % i
+            assert 1 <= len(out["tokens"]) <= 4
+            assert all(0 <= t < cfg.vocab_size for t in out["tokens"])
+        st = eng.stats()
+        assert st["admitted"] == st["evicted"] == 6
+        assert sorted(st["executed_rids"]) == sorted(
+            "r%d" % i for i in range(6))
+    finally:
+        eng.close(drain=False)
+
+
+def test_ladder_reject_is_failed_future_not_engine_death():
+    cfg, eng = _engine()
+    try:
+        too_long = eng.submit(list(range(1, 12)))   # > largest bucket
+        with pytest.raises(MXNetError):
+            too_long.result(5.0)
+        no_room = eng.submit([1, 2], max_new_tokens=cfg.max_len)
+        with pytest.raises(MXNetError):
+            no_room.result(5.0)
+        assert eng.stats()["rejected"] == 2
+        ok = eng.submit([1, 2, 3], max_new_tokens=2)
+        assert len(ok.result(30.0)["tokens"]) <= 2
+    finally:
+        eng.close(drain=False)
+
+
+def test_priority_classes_order_the_queue():
+    _, eng = _engine(start=False)   # no worker: inspect raw queue order
+    eng.submit([1], 2, priority="best_effort", rid="be")
+    eng.submit([1], 2, priority="batch", rid="b1")
+    eng.submit([1], 2, priority="interactive", rid="i1")
+    eng.submit([1], 2, priority="batch", rid="b2")
+    eng.submit([1], 2, priority=0, rid="i2")   # router-style rank int
+    assert [p.rid for p in eng._queue] == ["i1", "i2", "b1", "b2", "be"]
+
+
+def test_zero_steady_state_recompiles():
+    """Warmup compiles one prefill per bucket + one step; an arbitrary
+    interleaving of prompt lengths afterwards adds ZERO compiles and
+    ZERO recompile-auditor findings."""
+    analysis.recompile.reset()
+    cfg, eng = _engine()
+    try:
+        after_warmup = eng.programs.compile_count()
+        assert eng.programs.program_count() == len(BUCKETS) + 1
+        futs = [eng.submit([1 + (i % 7)] * (1 + (i * 3) % 8),
+                           max_new_tokens=1 + (i % 6))
+                for i in range(10)]
+        done, not_done = _wait(futs, timeout=60.0)
+        assert not not_done
+        assert eng.programs.compile_count() == after_warmup
+        assert eng.programs.program_count() == len(BUCKETS) + 1
+        key = "decode:%s" % eng.name
+        assert not [f for f in analysis.recompile.findings()
+                    if f["key"] == key]
+    finally:
+        eng.close(drain=False)
+
+
+def test_kill_fails_queued_and_inflight_with_replica_lost():
+    _, eng = _engine(slots=2, admit_per_tick=1)
+    futs = [eng.submit([1, 2], max_new_tokens=20, rid="k%d" % i)
+            for i in range(6)]
+    while eng.stats()["slots_active"] == 0:   # wait until decode started
+        time.sleep(0.005)
+    eng.kill()
+    lost = 0
+    for f in futs:
+        try:
+            f.result(10.0)
+        except ReplicaLostError:
+            lost += 1
+    assert lost >= 1          # at least the in-flight slots died loudly
+    assert eng.stats()["dead"]
+    with pytest.raises(ReplicaLostError):
+        eng.submit([1], max_new_tokens=2)
+
+
+def test_replica_swap_is_zero_compile_and_bumps_version():
+    cfg = _cfg()
+    rep = DecodeReplica(cfg, _params(cfg), replica_id="swap0",
+                        slots=2, buckets=BUCKETS)
+    try:
+        before = rep.engine.programs.compile_count()
+        assert rep.probe()["tokens"]
+        assert rep.swap(arg_params=_params(cfg, seed=7)) == 1
+        assert rep.probe()["tokens"]   # serves on the new weights
+        assert rep.engine.programs.compile_count() == before
+        assert rep.stats()["version"] == 1
+    finally:
+        rep.close(drain=False)
+
+
+def test_router_failover_replays_decode_on_survivor():
+    """SIGKILL a decode replica mid-traffic: every admitted sequence is
+    replayed on the survivor (prefill re-derives the lost KV state) and
+    the completed-rid fence suppresses duplicate delivery."""
+    cfg = _cfg()
+    reps = [DecodeReplica(cfg, _params(cfg), replica_id="d%d" % i,
+                          slots=2, buckets=BUCKETS) for i in range(2)]
+    router = ReplicaRouter(reps, name="decode-rt",
+                           health_interval_s=0.05, max_dispatches=4)
+    try:
+        futs = [router.submit({"tokens": [1 + (i % 5), 2],
+                               "max_new_tokens": 6},
+                              request_id="fo%d" % i, timeout_ms=60000)
+                for i in range(12)]
+        while reps[0].engine.stats()["slots_active"] == 0 \
+                and not all(f.done() for f in futs):
+            time.sleep(0.005)
+        reps[0].kill()
+        done, not_done = _wait(futs, timeout=60.0)
+        assert not not_done
+        outs = [f.result(0) for f in futs]
+        assert len(outs) == 12 and all(o["tokens"] for o in outs)
+        st = router.stats()
+        assert st["replicas_lost"] >= 1
+        # zero loss: every rid landed exactly once across the fleet
+        executed = [r for rep in reps
+                    for r in rep.engine.stats()["executed_rids"]]
+        assert set("fo%d" % i for i in range(12)) <= set(executed)
+    finally:
+        router.shutdown(drain=False)
+
+
+def test_load_signals_feed_the_autoscaler_contract():
+    _, eng = _engine(start=False, slots=2)
+    assert eng.outstanding() == 0
+    assert eng.estimated_wait_s() == 0.0
+    eng.submit([1, 2], 2, rid="w0")
+    eng.submit([1, 2], 2, rid="w1")
+    assert eng.outstanding() == 2
+    eng._tick_s_ewma = 0.01    # pretend we have a measured tick rate
+    assert eng.estimated_wait_s() > 0.0

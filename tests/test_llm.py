@@ -1,0 +1,292 @@
+"""Transformer LM (llm/): symbol construction, scan-over-layers dedup,
+megatron sharding coverage, dp×tp fused-step training with guardian and
+h2d ring active, bit-identical checkpoint/resume, and decode-plane
+parity against the training graph."""
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import io, nd
+from incubator_mxnet_tpu.io import DataBatch
+from incubator_mxnet_tpu.llm import (LMConfig, lm_symbol, lm_block_op_count,
+                                     stack_lm_params, init_kv_cache,
+                                     DecodePrograms)
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=40, num_layers=2, num_heads=2, hidden=16,
+                max_len=48, eos_id=0)
+    base.update(kw)
+    return LMConfig(**base)
+
+
+def _lm_data(cfg, n=64, bs=8, t=12, seed=3):
+    """Synthetic periodic token stream: learnable next-token structure
+    so the loss measurably falls within a few epochs."""
+    rng = np.random.default_rng(seed)
+    base = rng.integers(1, cfg.vocab_size, t + 1)
+    x = np.empty((n, t), np.float32)
+    y = np.empty((n, t), np.float32)
+    for i in range(n):
+        roll = np.roll(base, i % (t + 1))
+        x[i] = roll[:t]
+        y[i] = roll[1:]
+    return io.NDArrayIter(x, y, batch_size=bs, shuffle=False,
+                          label_name="softmax_label")
+
+
+def _bind_lm(cfg, bs=8, t=12, ctxs=None):
+    mod = mx.mod.Module(lm_symbol(cfg), context=ctxs or mx.cpu())
+    mod.bind(data_shapes=[io.DataDesc("data", (bs, t))],
+             label_shapes=[io.DataDesc("softmax_label", (bs, t))])
+    mod.init_params(mx.initializer.Xavier())
+    return mod
+
+
+def _loss_on(mod, cfg, X, Y):
+    b = DataBatch(data=[nd.array(X)], label=[nd.array(Y)])
+    mod.forward(b, is_train=False)
+    probs = mod.get_outputs()[0].asnumpy().reshape(-1, cfg.vocab_size)
+    p = probs[np.arange(Y.size), Y.reshape(-1).astype(int)]
+    return float(-np.log(p + 1e-12).mean())
+
+
+# ---------------------------------------------------------------------------
+# graph structure
+# ---------------------------------------------------------------------------
+
+def test_scan_plan_groups_transformer_stack():
+    """Satellite check: `scan_plan` must group the N identical
+    attention+MLP blocks as ONE run with the block's full multi-op
+    period — the deduped-compile path for the LM.  (No rejection to
+    record: the stack is clean-cut groupable.)"""
+    from incubator_mxnet_tpu.analysis.graph_passes import scan_plan
+    cfg = _cfg(num_layers=4)
+    plan = scan_plan(lm_symbol(cfg), min_run=2)
+    assert plan["rejected"] == []
+    assert len(plan["runs"]) == 1
+    run = plan["runs"][0]
+    assert run["length"] == 4
+    assert len(run["segments"][0]) == lm_block_op_count()
+
+
+def test_fused_step_uses_scan_dedup():
+    """The training-side lock on the deduped path: the fused step built
+    from the LM symbol reports the 4-block stack as one scan run."""
+    cfg = _cfg(num_layers=4)
+    mod = _bind_lm(cfg)
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05})
+    it = _lm_data(cfg, n=16)
+    metric = mx.metric.create("acc")
+    for batch in it:
+        mod.fit_step(batch, metric)
+        break
+    fs = mod._fused_step
+    assert fs is not None and not fs.broken
+    assert [l for _, l in fs.scan_runs] == [4], fs.scan_runs
+
+
+def test_megatron_rules_cover_lm_params():
+    """Every weight the LM declares lands on the intended megatron
+    partition purely by name."""
+    from incubator_mxnet_tpu.parallel.tensor_parallel import ShardingRules
+    from jax.sharding import PartitionSpec as P
+    rules = ShardingRules.megatron()
+    cfg = _cfg()
+    args = lm_symbol(cfg).list_arguments()
+    col = [a for a in args if a.endswith(("qkv_weight", "fc1_weight"))]
+    row = [a for a in args if a.endswith(("out_proj_weight", "fc2_weight"))]
+    embed = [a for a in args if a.endswith("embed_weight")]
+    assert col and row and len(embed) == 1
+    for name in col + embed:
+        assert rules.spec_for(name) == P("tp", None), name
+    for name in row:
+        assert rules.spec_for(name) == P(None, "tp"), name
+    for name in args:
+        if name.endswith("_bias"):
+            assert rules.spec_for(name) == P(), name
+
+
+# ---------------------------------------------------------------------------
+# training
+# ---------------------------------------------------------------------------
+
+def test_lm_fit_composed_mesh_guardian_and_ring(monkeypatch):
+    """The flagship train path: `Module.fit` fused steps on a composed
+    dp×tp mesh, fed by the h2d ring, watched by the guardian — and the
+    loss actually falls."""
+    monkeypatch.setenv("MXNET_IO_RING", "1")
+    monkeypatch.setenv("MXNET_GUARDIAN", "1")
+    from incubator_mxnet_tpu import io_plane
+    cfg = _cfg()
+    ctxs = [mx.cpu(i) for i in range(8)]
+    mod = mx.mod.Module(lm_symbol(cfg), context=ctxs)
+    it = _lm_data(cfg, n=64, bs=8)
+    X, Y = np.asarray(it.data[0][1]), np.asarray(it.label[0][1])
+    ring_before = io_plane.stats()["batches"]
+    mod.fit(it, num_epoch=4, kvstore="device", optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05},
+            eval_metric="acc", initializer=mx.initializer.Xavier(),
+            mesh="dp=4,tp=2")
+    fs = mod._fused_step
+    assert fs is not None and not fs.broken
+    assert fs._dp_size == 4
+    assert tuple(fs._mesh.axis_names) == ("dp", "tp")
+    # guardian rode along and observed real steps
+    g = mod._guardian
+    assert g is not None and g.stats()["steps_observed"] > 0
+    # the h2d staging ring fed the fit
+    assert io_plane.stats()["batches"] > ring_before
+    # loss fell vs the untrained init
+    fresh = _bind_lm(cfg, bs=X.shape[0], t=X.shape[1])
+    init_loss = _loss_on(fresh, cfg, X, Y)
+    mod2 = mx.mod.Module(lm_symbol(cfg), context=mx.cpu())
+    mod2.bind(data_shapes=[io.DataDesc("data", X.shape)],
+              label_shapes=[io.DataDesc("softmax_label", Y.shape)],
+              for_training=False, grad_req="null")
+    args, auxs = mod.get_params()
+    mod2.set_params(args, auxs)
+    trained_loss = _loss_on(mod2, cfg, X, Y)
+    assert trained_loss < init_loss * 0.9, (init_loss, trained_loss)
+    for k, v in args.items():
+        assert np.isfinite(v.asnumpy()).all(), k
+
+
+class _Crash(Exception):
+    pass
+
+
+def _fit_lm(cfg, ckpt_dir=None, crash_at=None, resume=False, num_epoch=2):
+    mx.random.seed(11)
+    np.random.seed(11)
+    mod = mx.mod.Module(lm_symbol(cfg), context=mx.cpu())
+    cb = None
+    if crash_at is not None:
+        hits = {"n": 0}
+
+        def cb(param):
+            hits["n"] += 1
+            if hits["n"] == crash_at:
+                raise _Crash()
+    try:
+        mod.fit(_lm_data(cfg), num_epoch=num_epoch, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.05,
+                                  "momentum": 0.9},
+                eval_metric="acc", initializer=mx.initializer.Xavier(),
+                checkpoint_dir=ckpt_dir, checkpoint_period=1,
+                resume=resume, batch_end_callback=cb)
+    except _Crash:
+        pass
+    return mod
+
+
+def test_lm_checkpoint_resume_bit_identical(tmp_path):
+    """Crash the LM fit mid-epoch under the elastic checkpointer,
+    resume, and land bit-identical to the uninterrupted run."""
+    cfg = _cfg()
+    full = _fit_lm(cfg)
+    _fit_lm(cfg, ckpt_dir=str(tmp_path), crash_at=9)
+    resumed = _fit_lm(cfg, ckpt_dir=str(tmp_path), resume=True)
+    fa, _ = full.get_params()
+    ra, _ = resumed.get_params()
+    assert fa.keys() == ra.keys()
+    for k in fa:
+        np.testing.assert_array_equal(fa[k].asnumpy(), ra[k].asnumpy(),
+                                      err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# decode plane
+# ---------------------------------------------------------------------------
+
+def _trained(cfg, steps=10):
+    mod = _bind_lm(cfg)
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05})
+    rng = np.random.default_rng(0)
+    X = rng.integers(1, cfg.vocab_size, (8, 12)).astype(np.float32)
+    Y = np.roll(X, -1, axis=1)
+    b = DataBatch(data=[nd.array(X)], label=[nd.array(Y)])
+    for _ in range(steps):
+        mod.forward_backward(b)
+        mod.update()
+    return mod
+
+
+def test_stack_lm_params_shapes_and_errors():
+    cfg = _cfg()
+    mod = _bind_lm(cfg)
+    args, _ = mod.get_params()
+    sp = stack_lm_params(args, cfg)
+    L, C, H = cfg.num_layers, cfg.hidden, cfg.num_heads
+    assert sp["embed"].shape == (cfg.vocab_size, C)
+    assert sp["layers"]["qkv_weight"].shape == (L, 3 * C, C)
+    assert sp["layers"]["fc2_weight"].shape == (L, C, cfg.ffn_mult * C)
+    broken = dict(args)
+    broken.pop([k for k in broken if k.endswith("block0_qkv_weight")][0])
+    with pytest.raises(mx.MXNetError, match="qkv_weight"):
+        stack_lm_params(broken, cfg)
+
+
+def test_prefill_matches_training_graph():
+    """The serving plane is the SAME function the training graph
+    computes: prefill's next-token logits equal the full-sequence
+    forward at the last position."""
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu import fused
+    cfg = _cfg()
+    mod = _trained(cfg)
+    args, _ = mod.get_params()
+    progs = DecodePrograms(cfg, stack_lm_params(args, cfg), label="t-par")
+    ck, cv = fused.reown_for_donation(init_kv_cache(cfg, 2))
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(1, cfg.vocab_size, (1, 8)).astype(np.int32)
+    ck, cv, tok, logits = progs.prefill(
+        progs.params, ck, cv, jnp.asarray(prompt), jnp.int32(0),
+        jnp.int32(8))
+    ref = mx.mod.Module(lm_symbol(cfg), context=mx.cpu())
+    ref.bind(data_shapes=[io.DataDesc("data", (1, 8))],
+             label_shapes=[io.DataDesc("softmax_label", (1, 8))],
+             for_training=False, grad_req="null")
+    ref.set_params(args, {})
+    ref.forward(DataBatch(data=[nd.array(prompt)],
+                          label=[nd.array(np.zeros((1, 8), np.float32))]),
+                is_train=False)
+    probs = ref.get_outputs()[0].asnumpy().reshape(8, cfg.vocab_size)
+    want = np.log(probs[7] + 1e-30)
+    got = np.asarray(jax.nn.log_softmax(np.asarray(logits)))
+    np.testing.assert_allclose(got - got.mean(), want - want.mean(),
+                               rtol=1e-4, atol=1e-4)
+    assert int(np.asarray(tok)) == int(np.argmax(want))
+
+
+def test_decode_step_matches_prefill():
+    """Incremental decode against the KV cache is exact: stepping one
+    token equals prefilling the extended prompt."""
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu import fused
+    cfg = _cfg()
+    mod = _trained(cfg)
+    args, _ = mod.get_params()
+    progs = DecodePrograms(cfg, stack_lm_params(args, cfg), label="t-inc")
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(1, cfg.vocab_size, (1, 6)).astype(np.int32)
+    ck, cv = fused.reown_for_donation(init_kv_cache(cfg, 3))
+    ck, cv, tok, _ = progs.prefill(progs.params, ck, cv,
+                                   jnp.asarray(np.pad(prompt,
+                                                      ((0, 0), (0, 2)))),
+                                   jnp.int32(1), jnp.int32(6))
+    toks = jnp.zeros((3,), jnp.int32).at[1].set(int(tok))
+    poss = jnp.zeros((3,), jnp.int32).at[1].set(6)
+    ck, cv, _, logits_step = progs.step(progs.params, ck, cv, toks, poss)
+    ext = np.concatenate([prompt, [[int(tok)]]], axis=1)
+    ck2, cv2 = fused.reown_for_donation(init_kv_cache(cfg, 3))
+    ck2, cv2, _, logits_pre = progs.prefill(
+        progs.params, ck2, cv2,
+        jnp.asarray(np.pad(ext, ((0, 0), (0, 1)))), jnp.int32(0),
+        jnp.int32(7))
+    np.testing.assert_allclose(np.asarray(logits_step)[1],
+                               np.asarray(logits_pre), rtol=1e-5,
+                               atol=1e-5)

@@ -1,0 +1,122 @@
+"""`parallel/ring_attention.blockwise_attention` correctness: parity
+against a naive full-score-matrix softmax attention (causal and not),
+invariance to the block size, and the packed `BlockwiseAttention`
+registered op built on it."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.ops.attention import naive_attention
+from incubator_mxnet_tpu.parallel.ring_attention import blockwise_attention
+
+
+def _qkv(b=2, t=16, h=2, d=8, seed=0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    mk = lambda: rng.standard_normal((b, t, h, d)).astype(dtype)  # noqa: E731
+    return mk(), mk(), mk()
+
+
+def _naive_4d(q, k, v, causal):
+    """(B, T, H, D) oracle via the packed naive_attention reference."""
+    b, t, h, d = q.shape
+    pack = lambda x: jnp.asarray(x.reshape(b, t, h * d))  # noqa: E731
+    out = naive_attention(pack(q), pack(k), pack(v), num_heads=h,
+                          causal=causal)
+    return np.asarray(out).reshape(b, t, h, d)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_blockwise_matches_naive(causal):
+    q, k, v = _qkv()
+    got = np.asarray(blockwise_attention(jnp.asarray(q), jnp.asarray(k),
+                                         jnp.asarray(v), causal=causal))
+    want = _naive_4d(q, k, v, causal)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_block_size_invariance(causal):
+    """The online-softmax recurrence is EXACT: every tiling (including
+    degenerate 1-wide blocks and one full-T block) produces the same
+    output."""
+    q, k, v = _qkv(t=12)
+    outs = []
+    for bs in (None, 1, 2, 3, 4, 6, 12):
+        outs.append(np.asarray(blockwise_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            block_size=bs, causal=causal)))
+    for o in outs[1:]:
+        np.testing.assert_allclose(o, outs[0], rtol=1e-6, atol=1e-6)
+
+
+def test_non_divisible_block_size():
+    """T not a multiple of block_size must still be exact (ragged tail
+    block)."""
+    q, k, v = _qkv(t=10)
+    got = np.asarray(blockwise_attention(jnp.asarray(q), jnp.asarray(k),
+                                         jnp.asarray(v), block_size=4,
+                                         causal=True))
+    np.testing.assert_allclose(got, _naive_4d(q, k, v, True),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_registered_op_packed_layout(causal):
+    """The `BlockwiseAttention` OpDef (packed (B, T, C) face) matches
+    the oracle and round-trips through the nd namespace."""
+    q, k, v = _qkv(h=4, d=4)
+    b, t, h, d = q.shape
+    pack = lambda x: x.reshape(b, t, h * d)  # noqa: E731
+    out = nd.BlockwiseAttention(nd.array(pack(q)), nd.array(pack(k)),
+                                nd.array(pack(v)), num_heads=h,
+                                causal=causal)
+    want = _naive_4d(q, k, v, causal).reshape(b, t, h * d)
+    np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-5, atol=1e-5)
+
+
+def test_registered_op_symbolic_and_grad():
+    """Symbol-graph execution of the op (the LM training path) and a
+    finite gradient through it."""
+    from incubator_mxnet_tpu import sym, io
+    q, k, v = _qkv(b=1, t=6, h=2, d=4)
+    b, t, h, d = q.shape
+    c = h * d
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=3 * c, flatten=False,
+                             name="qkv")
+    qs = sym.slice_axis(net, axis=-1, begin=0, end=c)
+    ks = sym.slice_axis(net, axis=-1, begin=c, end=2 * c)
+    vs = sym.slice_axis(net, axis=-1, begin=2 * c, end=3 * c)
+    a = sym.BlockwiseAttention(qs, ks, vs, num_heads=h, causal=True)
+    out = sym.Reshape(a, shape=(b, -1))
+    out = sym.FullyConnected(out, num_hidden=2, name="head")
+    net = sym.SoftmaxOutput(out, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[io.DataDesc("data", (b, t, c))],
+             label_shapes=[io.DataDesc("softmax_label", (b,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    batch = io.DataBatch(
+        data=[nd.array(q.reshape(b, t, c))],
+        label=[nd.array(np.zeros((b,), np.float32))])
+    for _ in range(3):
+        mod.forward_backward(batch)
+        mod.update()
+    for k_, v_ in mod.get_params()[0].items():
+        assert np.isfinite(v_.asnumpy()).all(), k_
+
+
+def test_bfloat16_runs_and_tracks_fp32():
+    """bf16 inputs stay bf16 out and approximate the fp32 result within
+    bf16 tolerance — the mixed-precision serving configuration."""
+    q, k, v = _qkv(t=8)
+    to16 = lambda x: jnp.asarray(x, dtype=jnp.bfloat16)  # noqa: E731
+    got = blockwise_attention(to16(q), to16(k), to16(v), causal=True)
+    assert got.dtype == jnp.bfloat16
+    want = _naive_4d(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(got, dtype=np.float32), want,
+                               rtol=0.1, atol=0.1)

@@ -12,6 +12,10 @@ artifact per run, cheap enough (< 2 min on CPU) to run per PR:
   scan program, T=32) plus its symbolic bound counterpart;
 * **quantization** — an int8-quantized convnet forward next to its fp32
   reference (the serving int8 ladder's kernel mix);
+* **attention** — the blockwise online-softmax causal attention the
+  transformer LM trains and serves with, vs the naive full-score-matrix
+  reference, fp32 and bf16 (plus the registered `BlockwiseAttention`
+  packed op costed through its OpDef cost_meta);
 * **dense reference points** — conv + matmul + softmax, so a regression
   report can say "sparse moved, dense did not".
 
@@ -221,6 +225,64 @@ def _quantization_ops(mx, nd, np):
                                name="quantization.convnet_int8"))}
 
 
+def _attention_ops(mx, nd, np):
+    """Causal self-attention: the blockwise online-softmax kernel the
+    transformer LM trains and serves with, next to the naive
+    full-score-matrix reference, in fp32 and the bf16 serving dtype.
+    The two compute identical math (tests/test_ring_attention.py), so
+    the measured gap is pure kernel shape — and the static column is
+    the SAME flops either way, which is the point: mxcost estimates
+    the op, not the tiling."""
+    import functools
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.ops.attention import naive_attention
+    from incubator_mxnet_tpu.parallel.ring_attention import \
+        blockwise_attention
+    rng = np.random.RandomState(4)
+    B, T, H, D = 2, 128, 4, 32
+    C = H * D
+
+    def lanes(dtype, tag):
+        q4, k4, v4 = (jnp.asarray(rng.randn(B, T, H, D), dtype=dtype)
+                      for _ in range(3))
+        pack = lambda a: a.reshape(B, T, C)  # noqa: E731
+        blockwise = jax.jit(functools.partial(
+            blockwise_attention, block_size=64, causal=True))
+        naive = jax.jit(functools.partial(
+            naive_attention, num_heads=H, causal=True))
+        shape = f"{B}x{T}x{H}x{D} {tag}"
+        aval4 = [jax.ShapeDtypeStruct((B, T, H, D), dtype)] * 3
+        aval3 = [jax.ShapeDtypeStruct((B, T, C), dtype)] * 3
+        return {
+            f"attention.blockwise_{tag}": (
+                lambda: blockwise(q4, k4, v4), shape,
+                _static_callable(blockwise, aval4,
+                                 name=f"attention.blockwise_{tag}")),
+            f"attention.naive_{tag}": (
+                lambda: naive(pack(q4), pack(k4), pack(v4)), shape,
+                _static_callable(naive, aval3,
+                                 name=f"attention.naive_{tag}")),
+        }
+
+    ops = {}
+    ops.update(lanes(jnp.float32, "fp32"))
+    ops.update(lanes(jnp.bfloat16, "bf16"))
+    # the registered packed-face op, costed through its OpDef cost_meta
+    # (the estimate the scheduler sees) rather than a traced callable
+    qp = nd.array(rng.randn(B, T, C).astype("f4"))
+    data = mx.sym.Variable("data")
+    asym = mx.sym.BlockwiseAttention(data, data, data, num_heads=H,
+                                     causal=True)
+    ops["attention.op_blockwise_fp32"] = (
+        lambda: nd.BlockwiseAttention(qp, qp, qp, num_heads=H,
+                                      causal=True)._data,
+        f"{B}x{T}x{C} packed",
+        _static_symbol(asym, {"data": (B, T, C)},
+                       name="attention.op_blockwise_fp32"))
+    return ops
+
+
 def _dense_ops(mx, nd, np):
     """Dense reference points: a regression report should be able to say
     'sparse moved, dense did not'."""
@@ -270,7 +332,7 @@ def run_battery(iters=20):
 
     ops = {}
     for builder in (_sparse_ops, _control_flow_ops, _quantization_ops,
-                    _dense_ops):
+                    _attention_ops, _dense_ops):
         ops.update(builder(mx, nd, np))
 
     results = {}

@@ -44,14 +44,26 @@ schedule additionally certifies ZERO unified-program-cache compiles
 during recovery (the live/in-memory tier serves every rebuilt program).
 The artifact is ``CHAOS_TRAIN.json``.
 
+Decode mode (``--decode``) runs the CONTINUOUS-BATCHING schedules over
+a real `ReplicaRouter` fronting two in-process `DecodeReplica`s (one
+shared cached-jit program space, so the second replica warms with ZERO
+compiles): a steady-state mixed-ladder sweep (zero compiles, zero
+recompile-auditor findings across arbitrary prompt/budget arrival
+orders) and one replica SIGKILLed mid-decode — every admitted sequence
+must be replayed on the survivor (the prefill re-derives the lost KV
+state from the prompt) with zero losses and zero duplicate deliveries.
+The artifact is ``CHAOS_DECODE.json``.
+
 Usage: python tools/run_chaos.py [--quick] [--pod] [--serving] [--train]
-                                 [--json] [--out PATH]
+                                 [--decode] [--json] [--out PATH]
     --quick   bounded test selection (the run_tpu_parity.py stage)
     --pod     run the elastic pod schedules (writes CHAOS_POD.json)
     --serving run the multi-replica router schedules
               (writes CHAOS_SERVING.json)
     --train   run the training-guardian schedules
               (writes CHAOS_TRAIN.json)
+    --decode  run the continuous-batching decode schedules
+              (writes CHAOS_DECODE.json)
     --json    print only the JSON artifact on stdout
     --out     also write the artifact to PATH (default CHAOS_REPORT.json,
               CHAOS_POD.json with --pod, CHAOS_SERVING.json with
@@ -987,6 +999,200 @@ def run_fleet(as_json=False, out_path=None):
     return 0 if artifact["all_passed"] else 1
 
 
+# -- decode schedules: continuous-batching LM serving under sabotage ----------
+# two in-process `DecodeReplica`s (one shared cached-jit program space,
+# so replica 2 must warm with ZERO compiles) behind a real
+# ReplicaRouter; one replica SIGKILLed mid-decode.  The acceptance
+# story: a decode request is REPLAYABLE (prompt + budget re-derive the
+# lost KV state via prefill on a survivor), so zero admitted sequences
+# are lost, none is delivered twice, and the steady state never
+# presents XLA a novel shape.
+
+def _decode_cfg():
+    from incubator_mxnet_tpu.llm import LMConfig
+    return LMConfig(vocab_size=48, num_layers=2, num_heads=2, hidden=16,
+                    ffn_mult=2, max_len=32, eos_id=0)
+
+
+def _decode_params(cfg, seed=0):
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    c, f = cfg.hidden, cfg.hidden * cfg.ffn_mult
+    mk = lambda *s: rng.standard_normal(s).astype(np.float32) * 0.1  # noqa: E731
+    p = {"lm_embed_weight": mk(cfg.vocab_size, c),
+         "lm_final_ln_gamma": np.ones((c,), np.float32),
+         "lm_final_ln_beta": np.zeros((c,), np.float32)}
+    for i in range(cfg.num_layers):
+        pre = "lm_block%d_" % i
+        for suffix, shape in (("ln1_gamma", (c,)), ("ln1_beta", (c,)),
+                              ("qkv_weight", (3 * c, c)),
+                              ("qkv_bias", (3 * c,)),
+                              ("out_proj_weight", (c, c)),
+                              ("out_proj_bias", (c,)),
+                              ("ln2_gamma", (c,)), ("ln2_beta", (c,)),
+                              ("fc1_weight", (f, c)), ("fc1_bias", (f,)),
+                              ("fc2_weight", (c, f)), ("fc2_bias", (c,))):
+            p[pre + suffix] = np.ones(shape, np.float32) \
+                if suffix.endswith("gamma") else (
+                mk(*shape) if "weight" in suffix
+                else np.zeros(shape, np.float32))
+    return p
+
+
+def _drive_decode(router, rng_seed, n_threads=4, per=20, kill_at=None,
+                  kill_fn=None):
+    """Closed-loop mixed-length decode traffic with caller-owned
+    request ids; optionally fire `kill_fn` after `kill_at` admissions.
+    Returns (ok results, errors, submitted rids)."""
+    import numpy as np
+    rng = np.random.default_rng(rng_seed)
+    prompts = [[int(t) for t in rng.integers(1, 40, int(n))]
+               for n in rng.choice([2, 3, 5, 7, 8], n_threads * per)]
+    results, errors, rids = [], [], []
+    accepted = [0]
+    fired = [False]
+    lock = threading.Lock()
+
+    def client(tid):
+        for j in range(per):
+            idx = tid * per + j
+            rid = "dec-%d" % idx
+            try:
+                f = router.submit(
+                    {"tokens": prompts[idx],
+                     "max_new_tokens": 4 + idx % 5},
+                    timeout_ms=60000,
+                    priority=("interactive", "batch",
+                              "best_effort")[idx % 3],
+                    request_id=rid)
+                with lock:
+                    rids.append(rid)
+                    accepted[0] += 1
+                    if kill_at is not None and accepted[0] == kill_at \
+                            and not fired[0]:
+                        fired[0] = True
+                        kill_fn()
+                results.append(f.result(120))
+            except Exception as exc:   # a lost admitted request = FINDING
+                errors.append(repr(exc))
+
+    threads = [threading.Thread(target=client, args=(i,),
+                                name="mx-chaos-decode-client-%d" % i)
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results, errors, rids
+
+
+def run_decode_schedule(name, quiet=False):
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import analysis
+    from incubator_mxnet_tpu.resilience import faults as _f
+    t0 = time.time()
+    checks = {}
+    errs = []
+    _f.configure("seed=51")   # trace/log only; the kill is real
+    analysis.recompile.reset()
+    cfg = _decode_cfg()
+    reps = [mx.serving.DecodeReplica(
+        cfg, _decode_params(cfg), replica_id="dec%d" % i,
+        slots=4, buckets=(4, 8)) for i in range(2)]
+    router = mx.serving.ReplicaRouter(reps, name="chaos-decode",
+                                      health_interval_s=0.1,
+                                      max_dispatches=4)
+    try:
+        # replica 2 warms off replica 1's live programs: same graph
+        # keys through one cached-jit space, so spinup is compile-free
+        checks["spinup_zero_compiles"] = \
+            reps[1].ready_info.get("compiles") == 0
+        base_compiles = [r.engine.programs.compile_count() for r in reps]
+        if name == "decode-replica-kill":
+            results, errors, rids = _drive_decode(
+                router, rng_seed=51, kill_at=30, kill_fn=reps[0].kill)
+            st = router.stats()
+            survivors = [r for r in reps if r.replica_id != "dec0"]
+            executed = [rid for r in survivors
+                        for rid in r.engine.stats()["executed_rids"]]
+            answered = {r["rid"] for r in results if isinstance(r, dict)}
+            checks.update(
+                zero_lost=(len(results) == len(rids) == 80
+                           and not errors),
+                every_sequence_generated=(all(
+                    isinstance(r, dict) and r["tokens"]
+                    for r in results)),
+                zero_duplicate_execution=(
+                    len(executed) == len(set(executed))
+                    and st["duplicates_suppressed"] == 0),
+                replica_declared_dead=(st["replicas_lost"] >= 1),
+                every_rid_delivered_once=(len(answered) == 80),
+                failovers=st["failovers"])
+            errs = errors[:5]
+        elif name == "decode-steady-state":
+            results, errors, rids = _drive_decode(router, rng_seed=52)
+            after = [r.engine.programs.compile_count() for r in reps]
+            churn = [f for f in analysis.recompile.findings()
+                     if str(f.get("key", "")).startswith("decode:")]
+            checks.update(
+                zero_lost=(len(results) == 80 and not errors),
+                zero_steady_state_compiles=(after == base_compiles),
+                zero_recompile_findings=(not churn),
+                programs_stable=(all(
+                    r.engine.programs.program_count() == 3
+                    for r in reps)))
+            errs = errors[:5]
+        else:
+            raise ValueError("unknown decode schedule %r" % name)
+    finally:
+        try:
+            router.shutdown(drain=False)
+        except Exception:
+            pass
+        for r in reps:
+            try:
+                r.kill()
+            except Exception:
+                pass
+        _f.clear()
+    bools = [v for v in checks.values() if isinstance(v, bool)]
+    result = {
+        "schedule": name,
+        "checks": checks,
+        "errors": errs,
+        "duration_s": round(time.time() - t0, 1),
+        "passed": bool(bools) and all(bools),
+    }
+    if not quiet:
+        print("chaos[decode/%s]: passed=%s checks=%s (%.1fs)" %
+              (name, result["passed"], checks, result["duration_s"]),
+              file=sys.stderr)
+    return result
+
+
+def run_decode(as_json=False, out_path=None):
+    runs = []
+    for name in ("decode-steady-state", "decode-replica-kill"):
+        try:
+            runs.append(run_decode_schedule(name, quiet=as_json))
+        except Exception as exc:
+            runs.append({"schedule": name, "passed": False,
+                         "error": repr(exc)})
+    artifact = {
+        "schedules": runs,
+        "all_passed": all(r["passed"] for r in runs),
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(artifact, f, indent=1)
+    if as_json:
+        print(json.dumps(artifact))
+    else:
+        print("chaos decode: %d schedule(s), all_passed=%s -> %s" %
+              (len(runs), artifact["all_passed"], out_path))
+    return 0 if artifact["all_passed"] else 1
+
+
 # -- training-guardian schedules: silent-failure recovery ---------------------
 # in-process seeded schedules over small Module.fit runs; every recovery
 # path is certified with zero unified-program-cache compiles
@@ -1208,9 +1414,16 @@ def main(argv=None):
     ap.add_argument("--serving", action="store_true")
     ap.add_argument("--fleet", action="store_true")
     ap.add_argument("--train", action="store_true")
+    ap.add_argument("--decode", action="store_true")
     ap.add_argument("--json", action="store_true", dest="as_json")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
+    if args.decode:
+        out = args.out if args.out is not None \
+            else os.path.join(REPO, "CHAOS_DECODE.json")
+        sys.path.insert(0, REPO)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        return run_decode(as_json=args.as_json, out_path=out)
     if args.fleet:
         out = args.out if args.out is not None \
             else os.path.join(REPO, "CHAOS_FLEET.json")

@@ -1,0 +1,268 @@
+#!/usr/bin/env python
+"""Continuous-batching LM serving bench: the decode engine's economics
+as one JSON artifact (``BENCH_LM.json``).
+
+Static batching decodes a batch in LOCKSTEP: every slot steps until the
+longest sequence finishes, so a mixed-length trace leaves finished
+slots idle-stepping — aggregate useful-tokens/s collapses to the
+longest request's pace.  The `serving.DecodeEngine` evicts finished
+sequences between ticks and re-admits from the queue (bucketed
+prefill), so the SAME fixed-shape decode-step program stays full of
+useful work.  This bench runs one mixed-length trace through both
+disciplines — the same `llm.decode_core` programs, the same slot
+count — and gates on the ratio.
+
+Lanes and gates:
+
+* **static** — lockstep batches over the trace (useful tokens / wall
+  time; finished slots burn ticks until the batch's longest finishes);
+* **continuous** — the same trace through `DecodeEngine` (admission,
+  eviction, bucketed prefill all inside the measured window);
+  gate: ``continuous >= 2x static`` aggregate tokens/s;
+* **zero steady-state recompiles** — both lanes run entirely on the
+  warmup-compiled ladder (one prefill per bucket + ONE decode step);
+  gate: compile-count delta 0 and no `analysis.recompile` findings;
+* **interactive SLO** — short interactive requests submitted while a
+  batch-priority flood saturates the queue; the priority ladder must
+  keep their p99 inside a band derived from the unloaded baseline
+  (degradation bound, not an absolute number — CI machines vary).
+
+Usage: python tools/run_lm_bench.py [--quick] [--json] [--out PATH]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BUCKETS = (8,)
+SLOTS = 8
+SHORT_NEW, LONG_NEW = 3, 40
+
+
+def _cfg():
+    from incubator_mxnet_tpu.llm import LMConfig
+    # eos outside the vocab: random-weight argmax chains never emit it,
+    # so every sequence generates exactly its budget — the two lanes'
+    # useful-token accounting is identical by construction
+    return LMConfig(vocab_size=64, num_layers=2, num_heads=2, hidden=32,
+                    ffn_mult=2, max_len=64, eos_id=-1)
+
+
+def _params(cfg, seed=9):
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    c, f = cfg.hidden, cfg.hidden * cfg.ffn_mult
+    mk = lambda *s: rng.standard_normal(s).astype(np.float32) * 0.1  # noqa: E731
+    p = {"lm_embed_weight": mk(cfg.vocab_size, c),
+         "lm_final_ln_gamma": np.ones((c,), np.float32),
+         "lm_final_ln_beta": np.zeros((c,), np.float32)}
+    for i in range(cfg.num_layers):
+        pre = "lm_block%d_" % i
+        p[pre + "ln1_gamma"] = np.ones((c,), np.float32)
+        p[pre + "ln1_beta"] = np.zeros((c,), np.float32)
+        p[pre + "qkv_weight"] = mk(3 * c, c)
+        p[pre + "qkv_bias"] = np.zeros((3 * c,), np.float32)
+        p[pre + "out_proj_weight"] = mk(c, c)
+        p[pre + "out_proj_bias"] = np.zeros((c,), np.float32)
+        p[pre + "ln2_gamma"] = np.ones((c,), np.float32)
+        p[pre + "ln2_beta"] = np.zeros((c,), np.float32)
+        p[pre + "fc1_weight"] = mk(f, c)
+        p[pre + "fc1_bias"] = np.zeros((f,), np.float32)
+        p[pre + "fc2_weight"] = mk(c, f)
+        p[pre + "fc2_bias"] = np.zeros((c,), np.float32)
+    return p
+
+
+def _trace(n_batches, seed=17):
+    """Mixed-length trace, arranged so every static batch of SLOTS
+    holds exactly one long request — the production shape (a few long
+    generations among many short ones) and the lockstep worst case."""
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    trace = []
+    for b in range(n_batches):
+        budgets = [SHORT_NEW] * (SLOTS - 1) + [LONG_NEW]
+        for new in budgets:
+            toks = [int(t) for t in rng.integers(1, 60,
+                                                 int(rng.integers(2, 9)))]
+            trace.append((toks, new))
+    return trace
+
+
+def _static_lane(programs, cfg, trace):
+    """Lockstep batches through the SAME warm programs: prefill each
+    slot, then step every slot until the batch's longest budget is
+    spent.  Returns (useful_tokens, wall_s, ticks)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from incubator_mxnet_tpu import fused as _fused
+    from incubator_mxnet_tpu.llm import init_kv_cache
+    useful = ticks = 0
+    t0 = time.monotonic()
+    for at in range(0, len(trace), SLOTS):
+        batch = trace[at:at + SLOTS]
+        ck, cv = _fused.reown_for_donation(init_kv_cache(cfg, SLOTS))
+        tokens = np.zeros((SLOTS,), np.int32)
+        positions = np.zeros((SLOTS,), np.int32)
+        for s, (toks, _new) in enumerate(batch):
+            padded = np.zeros((1, BUCKETS[0]), np.int32)
+            padded[0, :len(toks)] = toks
+            ck, cv, tok, _ = programs.prefill(
+                programs.params, ck, cv, jnp.asarray(padded),
+                jnp.int32(s), jnp.int32(len(toks)))
+            tokens[s] = int(tok)
+            positions[s] = len(toks)
+        # lockstep: EVERY slot steps until the longest budget is spent
+        for _ in range(max(new for _, new in batch) - 1):
+            ck, cv, nxt, _ = programs.step(
+                programs.params, ck, cv, jnp.asarray(tokens),
+                jnp.asarray(positions))
+            tokens = np.asarray(nxt)
+            positions += 1
+            ticks += 1
+        jax.block_until_ready(tokens)
+        del ck, cv
+        useful += sum(new for _, new in batch)
+    return useful, time.monotonic() - t0, ticks
+
+
+def _continuous_lane(engine, trace):
+    """The same trace through the engine's admission/eviction loop."""
+    from concurrent.futures import wait as _wait
+    t0 = time.monotonic()
+    futs = [engine.submit(toks, max_new_tokens=new, rid="lm-%d" % i,
+                          priority="batch")
+            for i, (toks, new) in enumerate(trace)]
+    done, not_done = _wait(futs, timeout=600.0)
+    wall = time.monotonic() - t0
+    if not_done:
+        raise RuntimeError("%d sequences never resolved" % len(not_done))
+    useful = sum(len(f.result(0)["tokens"]) for f in futs)
+    return useful, wall
+
+
+def _interactive_lane(engine, n=20, flood=24):
+    """Interactive p99 under a batch-priority flood, against an
+    unloaded baseline."""
+    import numpy as np
+
+    def one(priority):
+        t1 = time.monotonic()
+        engine.submit([5, 6, 7], max_new_tokens=SHORT_NEW,
+                      priority=priority).result(120.0)
+        return (time.monotonic() - t1) * 1e3
+
+    baseline = sorted(one("interactive") for _ in range(n))
+    flood_futs = [engine.submit([1 + i % 50] * 6, max_new_tokens=LONG_NEW,
+                                priority="batch") for i in range(flood)]
+    loaded = sorted(one("interactive") for _ in range(n))
+    for f in flood_futs:
+        f.result(600.0)
+    p99 = lambda xs: float(np.percentile(xs, 99))  # noqa: E731
+    return {"baseline_p50_ms": round(baseline[len(baseline) // 2], 2),
+            "baseline_p99_ms": round(p99(baseline), 2),
+            "loaded_p50_ms": round(loaded[len(loaded) // 2], 2),
+            "loaded_p99_ms": round(p99(loaded), 2)}
+
+
+def run_bench(quick=False):
+    from incubator_mxnet_tpu import analysis
+    from incubator_mxnet_tpu.serving import DecodeEngine
+    analysis.recompile.reset()
+    cfg = _cfg()
+    engine = DecodeEngine(cfg, _params(cfg), slots=SLOTS, buckets=BUCKETS,
+                          name="lmbench", admit_per_tick=SLOTS)
+    try:
+        warm_compiles = engine.programs.compile_count()
+        warm_programs = engine.programs.program_count()
+        trace = _trace(n_batches=3 if quick else 6)
+
+        s_tokens, s_wall, s_ticks = _static_lane(engine.programs, cfg,
+                                                 trace)
+        c_tokens, c_wall = _continuous_lane(engine, trace)
+        inter = _interactive_lane(engine, n=10 if quick else 20,
+                                  flood=12 if quick else 24)
+
+        static_tps = s_tokens / s_wall
+        cont_tps = c_tokens / c_wall
+        churn = [f for f in analysis.recompile.findings()
+                 if str(f.get("key", "")).startswith("decode:")]
+        compile_delta = engine.programs.compile_count() - warm_compiles
+        # the SLO is a degradation bound off THIS machine's unloaded
+        # baseline (the fleet chaos gate's pattern): the priority
+        # ladder must keep interactive tail latency within 6x of
+        # unloaded even while a 40-token batch flood owns the slots
+        slo_ms = max(6.0 * inter["baseline_p99_ms"], 250.0)
+        stats = engine.stats()
+        gates = {
+            "continuous_2x_static": cont_tps >= 2.0 * static_tps,
+            "zero_steady_recompiles": (compile_delta == 0 and not churn),
+            "interactive_slo_held": inter["loaded_p99_ms"] <= slo_ms,
+        }
+        return {
+            "config": cfg.to_dict(),
+            "slots": SLOTS,
+            "buckets": list(BUCKETS),
+            "trace_sequences": len(trace),
+            "static": {"useful_tokens": s_tokens,
+                       "wall_s": round(s_wall, 3),
+                       "lockstep_ticks": s_ticks,
+                       "tokens_per_s": round(static_tps, 1)},
+            "continuous": {"useful_tokens": c_tokens,
+                           "wall_s": round(c_wall, 3),
+                           "engine_ticks": stats["ticks"],
+                           "tokens_per_s": round(cont_tps, 1)},
+            "speedup": round(cont_tps / static_tps, 2),
+            "interactive": dict(inter, slo_ms=round(slo_ms, 1)),
+            "programs": {"warmup_compiles": warm_compiles,
+                         "programs": warm_programs,
+                         "post_warmup_compiles": compile_delta,
+                         "recompile_findings": len(churn)},
+            "gates": gates,
+            "all_passed": all(gates.values()),
+        }
+    finally:
+        engine.close(drain=False)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="run_lm_bench", description=__doc__)
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--out", default=os.path.join(REPO, "BENCH_LM.json"),
+                    help="artifact path ('' skips writing)")
+    args = ap.parse_args(argv)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    t0 = time.time()
+    artifact = run_bench(quick=args.quick)
+    artifact["quick"] = args.quick
+    artifact["duration_s"] = round(time.time() - t0, 1)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=1)
+    if args.as_json:
+        print(json.dumps(artifact))
+    else:
+        print("lm_bench: static %.1f tok/s, continuous %.1f tok/s "
+              "(%.2fx), interactive p99 %.1fms (slo %.1fms), "
+              "post-warmup compiles %d, all_passed=%s%s" %
+              (artifact["static"]["tokens_per_s"],
+               artifact["continuous"]["tokens_per_s"],
+               artifact["speedup"],
+               artifact["interactive"]["loaded_p99_ms"],
+               artifact["interactive"]["slo_ms"],
+               artifact["programs"]["post_warmup_compiles"],
+               artifact["all_passed"],
+               (" -> " + args.out) if args.out else ""))
+    return 0 if artifact["all_passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

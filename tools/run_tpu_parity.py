@@ -383,6 +383,48 @@ def scaling_stage():
         return {"error": f"scaling stage failed: {exc!r}"}
 
 
+def llm_stage():
+    """Transformer-LM serving stage: run tools/run_lm_bench.py --quick
+    in a throwaway process — one mixed-length trace decoded lockstep
+    (static batching) and through the continuous-batching
+    `DecodeEngine` on the SAME warm programs — and attach its BENCH_LM
+    artifact (gates: continuous >= 2x static aggregate tokens/s, zero
+    steady-state recompiles, interactive p99 inside the degradation
+    SLO under a batch flood) to the round.  The flagship-model serving
+    claims become checkable evidence next to the parity outcomes."""
+    cmd = [sys.executable, os.path.join(REPO, "tools", "run_lm_bench.py"),
+           "--quick", "--json",
+           "--out", os.path.join(REPO, "BENCH_LM.json")]
+    try:
+        out = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                             timeout=1800,
+                             env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        summary = json.loads(out.stdout)
+        summary["rc"] = out.returncode
+        return summary
+    except Exception as exc:
+        return {"error": f"llm stage failed: {exc!r}"}
+
+
+def chaos_decode_stage():
+    """Continuous-batching chaos stage: run tools/run_chaos.py --decode
+    in a throwaway process — steady-state mixed-ladder traffic (zero
+    compiles, zero recompile findings) and one `DecodeReplica`
+    SIGKILLed mid-decode (zero admitted sequences lost, zero duplicate
+    deliveries, replay on the survivor) — and attach its CHAOS_DECODE
+    artifact to the round."""
+    cmd = [sys.executable, os.path.join(REPO, "tools", "run_chaos.py"),
+           "--decode", "--json", "--out", ""]
+    try:
+        out = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                             timeout=1800)
+        summary = json.loads(out.stdout)
+        summary["rc"] = out.returncode
+        return summary
+    except Exception as exc:
+        return {"error": f"chaos decode stage failed: {exc!r}"}
+
+
 def coldstart_stage():
     """Cold-start stage: the warmup CLI's built-in probe, run cold then
     warm in fresh subprocesses (tools/warmup.py coldstart_probe) — the
@@ -449,6 +491,8 @@ def main():
         "chaos_serving": chaos_serving_stage(),
         "chaos_fleet": chaos_fleet_stage(),
         "chaos_train": chaos_train_stage(),
+        "chaos_decode": chaos_decode_stage(),
+        "llm": llm_stage(),
         "coldstart": coldstart_stage(),
         "scaling": scaling_stage(),
         "tsan": tsan_stage(),
