@@ -43,9 +43,9 @@ path.
 from __future__ import annotations
 
 __all__ = ["check", "check_json", "check_source", "check_source_file",
-           "check_cost", "enable", "disable", "enabled",
-           "runtime_report", "reset_runtime", "Finding", "Report",
-           "CODE_TABLE", "registered_codes"]
+           "check_cost", "check_sharding", "enable", "disable",
+           "enabled", "runtime_report", "reset_runtime", "Finding",
+           "Report", "CODE_TABLE", "registered_codes"]
 
 from .findings import (Finding, Report, ERROR, WARN, HINT,  # noqa: F401
                        CODE_TABLE, registered_codes)
@@ -109,6 +109,18 @@ def check_cost(symbol, shapes=None, dtypes=None, profile=None,
     from . import cost
     return cost.analyze_symbol(symbol, shapes=shapes, dtypes=dtypes,
                                profile=profile, target=target)
+
+
+def check_sharding(symbol, shapes=None, mesh="dp=8", rules=None,
+                   dtypes=None, target=None):
+    """Run the mxshard static SPMD sharding analyzer over a Symbol ->
+    ShardReport (its ``.findings`` is an ordinary findings Report; see
+    sharding.py for the collective-plan / budget / measured-cross-check
+    entry points)."""
+    from . import sharding
+    return sharding.analyze_sharding(symbol, shapes=shapes, mesh=mesh,
+                                     rules=rules, dtypes=dtypes,
+                                     name=target)
 
 
 def runtime_report():

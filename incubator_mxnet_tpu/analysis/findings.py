@@ -188,6 +188,30 @@ CODE_TABLE = _build_code_table([
      "program/plan has no baseline entry; snapshot it"),
     ("budget-slack", HINT, ("cost.budget",),
      "metric is well under budget; re-snapshot to tighten the gate"),
+    # -- mxshard static SPMD sharding analyzer (sharding.py) -----------------
+    ("implicit-replication", WARN, ("shard.memory",),
+     "param/activation >= MXNET_SHARD_MIN_MB fully replicated while "
+     "the mesh has a >1-device non-batch axis (per-device HBM blowup)"),
+    ("hidden-reshard", WARN, ("shard.propagate",),
+     "edge whose producer/consumer PartitionSpecs differ; GSPMD "
+     "inserts an all-gather/all-to-all/slice the cost model must "
+     "account for"),
+    ("rule-coverage", ERROR, ("shard.rules",),
+     "param matches zero or >=2 sharding rules of a rule set that "
+     "applies to the model; it silently replicates or is ambiguous"),
+    ("dp-axis-leak", WARN, ("shard.propagate",),
+     "batch-led activation lost its dim-0 dp sharding past the input; "
+     "every device computes the full batch downstream"),
+    ("shard-fallback", HINT, ("shard.propagate",),
+     "op has no propagation rule; outputs assumed replicated (costs "
+     "become upper bounds there)"),
+    ("shard-summary", HINT, ("shard.summary",),
+     "per-program sharding summary: per-device peak HBM, tp/GSPMD "
+     "collectives, reshard edges, fallback ops"),
+    ("unsharded-device-put", WARN, ("source.sharding",),
+     "device_put/as_in_context of a multi-MB array inside a mesh-"
+     "configured scope without a sharding argument replicates it on "
+     "every device"),
 ])
 
 

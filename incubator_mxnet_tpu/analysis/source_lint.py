@@ -176,7 +176,13 @@ _PASS_BY_CODE = {"host-sync-in-loop": "source.hostsync",
                  "unjoined-thread-in-init": "source.thread",
                  "untracked-stats": "source.obs",
                  "blocking-h2d-in-loop": "source.io",
-                 "kv-cache-recompile": "source.decode"}
+                 "kv-cache-recompile": "source.decode",
+                 "unsharded-device-put": "source.sharding"}
+
+# calls that mark a script as mesh-configured (SPMD placement is in
+# play, so bare device placement deserves a look)
+_MESH_CALLS = {"make_mesh", "mesh_from_spec", "local_mesh", "Mesh",
+               "rebuild"}
 
 # identifiers that mark a concatenation target as a decode KV cache
 # (token substrings of the assignment target)
@@ -224,6 +230,9 @@ class _Visitor(ast.NodeVisitor):
         self.stats_defs = []       # (lineno, class name) of `def stats`
         self.registers_producer = False   # file calls register_producer
         self._h2d_seen = set()     # node ids already flagged (nested loops)
+        self.mesh_configured = False      # file builds/passes a mesh
+        self.unsharded_put_sites = []     # (lineno, call name) — emitted
+                                          # only when a mesh is configured
 
     # -- loops ---------------------------------------------------------------
     def _check_blocking_h2d(self, node):
@@ -568,6 +577,23 @@ class _Visitor(ast.NodeVisitor):
             self.uses_tpu = True
         if name == "register_producer":
             self.registers_producer = True
+        # -- sharding-aware placement (mxshard's AST half) -------------------
+        if name in _MESH_CALLS or \
+                any(kw.arg == "mesh" and
+                    not (isinstance(kw.value, ast.Constant) and
+                         kw.value.value is None)
+                    for kw in node.keywords):
+            self.mesh_configured = True
+        if name == "device_put":
+            sharded = len(node.args) >= 2 or \
+                any(kw.arg in ("sharding", "device", "devices", "dst")
+                    for kw in node.keywords)
+            if not sharded:
+                self.unsharded_put_sites.append((node.lineno,
+                                                 "device_put"))
+        elif name == "as_in_context":
+            self.unsharded_put_sites.append((node.lineno,
+                                             "as_in_context"))
         if self.loop_depth > 0 and isinstance(func, ast.Attribute) and \
                 name in _SYNC_METHODS:
             self._add("host-sync-in-loop", node.lineno,
@@ -741,6 +767,19 @@ def scan_source(text, filename="<string>"):
                 "the 'metrics' transport frame, FleetManager.scrape, "
                 "mxtop — cannot see these numbers; register the "
                 "producer under a stable dotted namespace",
+                location=f"{filename}:{lineno}"))
+    if v.mesh_configured:
+        for lineno, call in v.unsharded_put_sites:
+            if _suppressed(lines, lineno, "unsharded-device-put"):
+                continue
+            report.add(Finding(
+                "source.sharding", "unsharded-device-put", WARN,
+                f"{call}() without a sharding argument in a script that "
+                "configures a device mesh: the array lands replicated "
+                "(or pinned to one device) instead of sharded — pass a "
+                "NamedSharding (parallel.shard_params applies the rule "
+                "set) so a multi-MB array costs HBM on one shard, not "
+                "every device",
                 location=f"{filename}:{lineno}"))
     if v.uses_tpu:
         for lineno, sink in v.kv_local_sites:

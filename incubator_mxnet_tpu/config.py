@@ -199,6 +199,11 @@ KNOBS = {
                                  "minimum buffer size for a donation-"
                                  "opportunity finding (step-boundary "
                                  "buffers that die undonated)"),
+    "MXNET_SHARD_MIN_MB": (float, 1.0, "honored",
+                           "mxshard (analysis/sharding.py) finding "
+                           "floor: implicit-replication and "
+                           "hidden-reshard fire only for tensors at "
+                           "least this many MB"),
     # -- resilience (this framework's own knobs) -----------------------------
     "MXNET_FAULTS": (str, "", "honored",
                      "resilience/faults.py: deterministic fault-injection "

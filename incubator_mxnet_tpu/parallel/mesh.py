@@ -44,11 +44,20 @@ def make_mesh(shape=None, axis_names=None, devices=None):
     return Mesh(dev_array, axis_names)
 
 
+# the accepted spec grammar, quoted by every parse error so a bad
+# MXNET_MESH / Module.fit(mesh=) value is self-explaining
+_SPEC_GRAMMAR = ("mesh spec grammar: comma-separated 'axis=size' "
+                 "tokens, each axis a nonempty name and each size a "
+                 "positive integer, e.g. 'dp=8' or 'dp=4,tp=2'")
+
+
 def parse_spec(spec):
     """Parse a mesh spec string — ``'dp=8'``, ``'dp=4,tp=2'`` — into an
     ordered axis->size dict (the `MXNET_MESH` / ``Module.fit(mesh=)``
     currency).  Axis order is placement order: outermost axes land on
-    DCN, innermost on ICI (scaling-book recipe)."""
+    DCN, innermost on ICI (scaling-book recipe).  A malformed spec
+    raises `MXNetError` naming the offending token and the accepted
+    grammar."""
     out = {}
     for part in str(spec).split(","):
         part = part.strip()
@@ -56,13 +65,29 @@ def parse_spec(spec):
             continue
         if "=" not in part:
             raise MXNetError(
-                f"bad mesh spec part {part!r} (want axis=size, e.g. "
-                "'dp=4,tp=2')")
+                f"bad token {part!r} in mesh spec {spec!r}: missing "
+                f"'='; {_SPEC_GRAMMAR}")
         k, v = part.split("=", 1)
+        k, v = k.strip(), v.strip()
+        if not k:
+            raise MXNetError(
+                f"bad token {part!r} in mesh spec {spec!r}: empty axis "
+                f"name; {_SPEC_GRAMMAR}")
         try:
-            out[k.strip()] = int(v)
+            size = int(v)
         except ValueError:
-            raise MXNetError(f"bad mesh axis size {v!r} in spec {spec!r}")
+            raise MXNetError(
+                f"bad token {part!r} in mesh spec {spec!r}: size {v!r} "
+                f"is not an integer; {_SPEC_GRAMMAR}")
+        if size <= 0:
+            raise MXNetError(
+                f"bad token {part!r} in mesh spec {spec!r}: size must "
+                f"be a positive integer; {_SPEC_GRAMMAR}")
+        if k in out:
+            raise MXNetError(
+                f"bad token {part!r} in mesh spec {spec!r}: axis {k!r} "
+                f"appears twice; {_SPEC_GRAMMAR}")
+        out[k] = size
     return out
 
 

@@ -643,7 +643,7 @@ def test_code_table_no_duplicates_and_no_orphans():
     from incubator_mxnet_tpu.analysis import findings as F
     from incubator_mxnet_tpu.analysis import (budgets, cost, graph_passes,
                                               hostsync, recompile,
-                                              source_lint, tsan)
+                                              sharding, source_lint, tsan)
 
     # duplicate registration is rejected at table build time
     with pytest.raises(ValueError, match="registered twice"):
@@ -661,6 +661,7 @@ def test_code_table_no_duplicates_and_no_orphans():
     declared.update(hostsync.CODES)
     declared.update(cost.CODES)
     declared.update(budgets.CODES)
+    declared.update(sharding.CODES)
     missing = declared - table
     assert not missing, f"codes emitted but unregistered: {missing}"
 
@@ -765,6 +766,11 @@ _SUPPRESSION_FIXTURES = {
         "for t in range(max_new):\n"
         "    kv_cache = jnp.concatenate([kv_cache, new_kv], axis=1)\n"
         "    tok = decode_step(params, kv_cache, tok)\n", 3),
+    "unsharded-device-put": (
+        "import jax\n"
+        "from incubator_mxnet_tpu.parallel.mesh import make_mesh\n"
+        "mesh = make_mesh({'dp': 4, 'tp': 2})\n"
+        "w = jax.device_put(big_weights)\n", 4),
 }
 
 

@@ -44,6 +44,18 @@ Usage:
                        --write-budgets FILE re-snapshots the baseline;
                        --profile/--dp/--bucket-mb pick the device
                        profile and plan geometry.
+    --shard-report     mxshard static SPMD sharding analysis
+                       (analysis/sharding.py): PartitionSpec
+                       propagation over the bench program set (and any
+                       symbol-JSON PATHS) under --mesh — hidden
+                       reshards, implicit replication, rule-coverage
+                       gaps, dp-axis leaks, per-device peak HBM, and
+                       the per-step ICI byte bill.  --budgets FILE
+                       gates against the COST_BUDGETS "sharding"
+                       section; --write-budgets FILE re-snapshots it;
+                       --measured pushes the bench convnet's sharded
+                       gradients through a real KVStore and fails on
+                       >10% static-vs-measured disagreement.
 
 Exit status (the CI contract): 0 — no finding at/above --fail-on
 survived --suppress; 1 — at least one did; 2 — usage error (argparse).
@@ -254,6 +266,131 @@ def cost_report(paths, as_json=False, budgets_path=None,
     return 1 if failing else 0
 
 
+def shard_report(paths, as_json=False, budgets_path=None,
+                 write_budgets=None, mesh="dp=2,tp=2", measured=False,
+                 bucket_mb=None, suppress=(), fail_on="warn",
+                 shapes=None):
+    """mxshard stage: propagate PartitionSpecs through the committed
+    bench program set (plus any symbol-JSON PATHS) under --mesh with
+    analysis/sharding.py, optionally gate per-device peak HBM and
+    per-step ICI bytes against the COST_BUDGETS "sharding" section,
+    and (with --measured) cross-check the static dp plan against a
+    real KVStore push.  This is what `run_tpu_parity.py`'s sharding
+    stage runs: a new hidden reshard, a silently-replicated matrix
+    param, a rule-coverage gap, or +ICI/+HBM beyond budget exits 1."""
+    from incubator_mxnet_tpu.analysis import Report
+    from incubator_mxnet_tpu.analysis import sharding as mxshard
+    from incubator_mxnet_tpu.analysis import budgets as mxbudgets
+    from incubator_mxnet_tpu.analysis.findings import Finding, severity_rank
+    from incubator_mxnet_tpu.parallel.tensor_parallel import ShardingRules
+    from incubator_mxnet_tpu.symbol.symbol import load_json
+
+    cap = int(bucket_mb * (1 << 20)) if bucket_mb else None
+    results = mxshard.analyze_shard_bench_set(mesh=mesh, cap_bytes=cap)
+
+    axes = mxshard._mesh_axes(mesh)
+    rules = (ShardingRules.megatron(tp_axis="tp")
+             if mxshard._axis_size("tp", axes) > 1 else None)
+    _py, json_files = _collect(paths)
+    for path in json_files:
+        with open(path, encoding="utf-8", errors="replace") as f:
+            text = f.read()
+        if not _looks_like_symbol_json(text):
+            continue
+        name = os.path.basename(path)
+        if name in results:
+            name = path
+        try:
+            sym = load_json(text)
+        except Exception as e:
+            print(f"mxlint: cannot load {path} ({str(e)[:120]})",
+                  file=sys.stderr)
+            continue
+        stats = mxshard.shard_collectives(
+            sym, shapes=shapes or None, mesh=mesh, rules=rules,
+            cap_bytes=cap, name=name)
+        rep = stats.pop("report")
+        entry = rep.as_dict()
+        entry["collectives"] = stats
+        entry["ici_bytes_per_step"] = stats["ici_bytes_per_step"]
+        results[name] = entry
+
+    if write_budgets:
+        try:
+            budgets = mxbudgets.load(write_budgets)
+        except (OSError, ValueError):
+            budgets = {"version": 1, "programs": {}}
+        budgets["sharding"] = mxshard.snapshot_shard_budgets(results,
+                                                            mesh=mesh)
+        mxbudgets.save(write_budgets, budgets)
+        print(f"mxlint: sharding budgets for {len(results)} program(s) "
+              f"written to {write_budgets}")
+        return 0
+
+    report = Report(target="sharding")
+    for name, entry in sorted(results.items()):
+        for d in entry.get("findings", ()):
+            f = Finding(d["pass"], d["code"], d["severity"],
+                        d["message"], node=d.get("node"),
+                        location=d.get("location"))
+            f.count = d.get("count", 1)
+            report.add(f)
+    deltas = {}
+    if budgets_path:
+        brep, deltas = mxshard.check_shard_budgets(
+            results, mxbudgets.load(budgets_path))
+        report.extend(brep.findings)
+    report = report.suppress(set(suppress))
+    thr = severity_rank(fail_on)
+    failing = [f for f in report
+               if severity_rank(f.severity) <= thr]
+
+    meas = None
+    if measured:
+        meas = mxshard.measured_ici_check(mesh=mesh, cap_bytes=cap)
+
+    summary = {
+        "mesh": mesh if isinstance(mesh, str) else dict(axes),
+        "programs": results,
+        "budgets": budgets_path,
+        "budget_deltas": deltas,
+        "measured": meas,
+        "findings": len(report),
+        "failing": len(failing),
+        "fail_on": fail_on,
+    }
+    if as_json:
+        print(json.dumps(summary, indent=1))
+    else:
+        for name, entry in sorted(results.items()):
+            print("%-24s %8.2f MB/device (replicated %8.2f MB)  "
+                  "%2d tp collective(s)  %9d ICI B/step  %d reshard(s)"
+                  % (name,
+                     (entry.get("per_device_peak_hbm_bytes") or 0)
+                     / (1 << 20),
+                     (entry.get("replicated_peak_hbm_bytes") or 0)
+                     / (1 << 20),
+                     entry.get("tp_collectives_per_step") or 0,
+                     entry.get("ici_bytes_per_step") or 0,
+                     entry.get("reshard_edges") or 0))
+        for f in report:
+            print(f.format())
+        if meas is not None:
+            print("measured dp cross-check (dp=%d): static %d B/step vs "
+                  "measured %d B/step, agreement %.3f%%, %s"
+                  % (meas["dp"], meas["static_bytes_per_step"],
+                     meas["measured_bytes_per_step"],
+                     meas["agreement_pct"],
+                     "OK" if meas["ok"] else "MISMATCH"))
+        print("mxlint --shard-report: %d program(s) under mesh '%s', "
+              "%d finding(s), %d failing at --fail-on=%s%s"
+              % (len(results), mesh, len(report), len(failing), fail_on,
+                 " (vs %s)" % budgets_path if budgets_path else ""))
+    if meas is not None and not meas["ok"]:
+        return 1
+    return 1 if failing else 0
+
+
 def tsan_report(paths, as_json=False):
     """Concurrency report: the mxtsan AST lint subset (unnamed-thread,
     bare-acquire, sleep-under-lock, unjoined-thread-in-init) over the
@@ -370,6 +507,23 @@ def main(argv=None):
                     help="mxcost static cost analysis of the bench "
                          "program set + symbol-JSON PATHS; gate with "
                          "--budgets / re-baseline with --write-budgets")
+    ap.add_argument("--shard-report", action="store_true",
+                    help="mxshard static SPMD sharding analysis of the "
+                         "bench program set + symbol-JSON PATHS under "
+                         "--mesh: spec propagation, hidden reshards, "
+                         "implicit replication, rule coverage, per-"
+                         "device peak HBM and per-step ICI bytes; gate "
+                         "with --budgets / re-baseline with "
+                         "--write-budgets; --measured cross-checks the "
+                         "static dp plan against a real KVStore push")
+    ap.add_argument("--mesh", default="dp=2,tp=2", metavar="SPEC",
+                    help="mesh spec for --shard-report, e.g. 'dp=8' or "
+                         "'dp=2,tp=2' (default dp=2,tp=2)")
+    ap.add_argument("--measured", action="store_true",
+                    help="with --shard-report: also push the bench "
+                         "convnet's sharded gradients through a device "
+                         "KVStore and fail on >10%% static-vs-measured "
+                         "ICI disagreement")
     ap.add_argument("--budgets", metavar="JSON",
                     help="COST_BUDGETS baseline to gate --cost-report "
                          "against (regressions become errors)")
@@ -395,6 +549,14 @@ def main(argv=None):
         return cache_report(args.cache_report, as_json=args.as_json)
     if args.tsan_report:
         return tsan_report(args.paths, as_json=args.as_json)
+    if args.shard_report:
+        return shard_report(
+            args.paths, as_json=args.as_json, budgets_path=args.budgets,
+            write_budgets=args.write_budgets, mesh=args.mesh,
+            measured=args.measured, bucket_mb=args.bucket_mb,
+            suppress={c.strip() for c in args.suppress.split(",")
+                      if c.strip()},
+            fail_on=args.fail_on, shapes=_parse_shapes(args.shape))
     if args.cost_report:
         return cost_report(
             args.paths, as_json=args.as_json, budgets_path=args.budgets,

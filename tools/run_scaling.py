@@ -224,6 +224,36 @@ def _kvstore_economy(ndev, quick):
     return st
 
 
+def _shard_static(ndev):
+    """mxshard's static prediction for this point's workloads — per-
+    device peak HBM and the per-step dp ICI byte bill — recorded NEXT
+    TO the measured pod/kvstore counters, so the artifact itself shows
+    whether the static model tracks the machine (the parity sharding
+    stage gates the agreement at 10%)."""
+    from incubator_mxnet_tpu.analysis import sharding as mxshard
+    out = {}
+    for name, net, feat, batch in (
+            ("img", _build_image_net(), IMG_FEATURES,
+             IMG_BATCH_PER_DEV * ndev),
+            ("tok", _build_token_net(), TOK_FEATURES,
+             TOK_BATCH_PER_DEV * ndev)):
+        stats = mxshard.shard_collectives(
+            net, shapes={"data": (batch, feat),
+                         "softmax_label": (batch,)},
+            mesh={"dp": ndev}, name="scaling.%s" % name)
+        rep = stats.pop("report")
+        dp_plan = stats.get("dp") or {}
+        out[name] = {
+            "per_device_peak_hbm_bytes": rep.per_device_peak_hbm_bytes,
+            "replicated_peak_hbm_bytes": rep.replicated_peak_hbm_bytes,
+            "dp_ici_bytes_per_step":
+                int(dp_plan.get("bytes_per_step") or 0),
+            "dp_collectives_per_step":
+                int(dp_plan.get("collectives_per_step") or 0),
+        }
+    return out
+
+
 def run_point(ndev, quick):
     img_sps, img_steady, pod = _timed_fit(
         _build_image_net(), ndev, IMG_BATCH_PER_DEV * ndev, IMG_FEATURES,
@@ -238,7 +268,17 @@ def run_point(ndev, quick):
         "steady_compiles": img_steady + tok_steady,
         "pod": pod,
         "kvstore": _kvstore_economy(ndev, quick),
+        "shard_static": _shard_static(ndev),
     }
+    pt_pod = point["pod"] or {}
+    img_static = point["shard_static"]["img"]
+    if pt_pod.get("bytes_per_step") and img_static["dp_ici_bytes_per_step"]:
+        # measured pod exchange vs mxshard's static plan for the SAME
+        # image net: the in-artifact agreement the parity stage gates
+        meas = int(pt_pod["bytes_per_step"])
+        stat = int(img_static["dp_ici_bytes_per_step"])
+        point["shard_static"]["img_agreement_pct"] = round(
+            abs(stat - meas) * 100.0 / max(1, meas), 3)
     from incubator_mxnet_tpu import analysis as _analysis
     point["runtime_findings"] = [
         f.message for f in _analysis.runtime_report()

@@ -118,6 +118,42 @@ def cost_stage():
         return {"error": f"cost stage failed: {exc!r}"}
 
 
+def sharding_stage():
+    """Static-sharding stage: `mxlint --shard-report` over the bench
+    program set under the dp=2,tp=2 mesh, gated against the committed
+    COST_BUDGETS.json "sharding" section AND cross-checked against a
+    real KVStore push (--measured) in a throwaway process.  The
+    artifact records per-program per-device peak HBM, the per-step ICI
+    byte bill, the budget deltas, and the static-vs-measured agreement,
+    so a new hidden reshard, a silently-replicated matrix param, a
+    rule-coverage gap, or a static plan that drifts >10% from the
+    measured collective counters is a hard stage failure (rc=1)."""
+    cmd = [sys.executable, os.path.join(REPO, "tools", "mxlint.py"),
+           "--shard-report", "--json", "--fail-on=warn", "--measured",
+           "--budgets", os.path.join(REPO, "COST_BUDGETS.json")]
+    env = dict(os.environ)
+    if "XLA_FLAGS" not in env:
+        # the measured cross-check needs >1 device; on a CPU host that
+        # means the forced-host-platform census the test suite uses
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    try:
+        out = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                             timeout=900, env=env)
+        summary = json.loads(out.stdout)
+        for prog in summary.get("programs", {}).values():
+            prog.pop("findings", None)    # findings live in the lint
+            prog.pop("fallback_ops", None)  # run, not the artifact
+        summary["rc"] = out.returncode
+        meas = summary.get("measured") or {}
+        summary["clean"] = (out.returncode == 0 and
+                            bool(meas.get("ok", False)) and
+                            float(meas.get("agreement_pct") or 0.0)
+                            <= 10.0)
+        return summary
+    except Exception as exc:
+        return {"error": f"sharding stage failed: {exc!r}"}
+
+
 def serving_stage():
     """Serving-bench stage: run tools/run_serving_bench.py --quick in a
     throwaway process and attach its JSON artifact (QPS, p50/p99, batch
@@ -485,6 +521,7 @@ def main():
         "jax": probe_backend(),
         "mxlint": mxlint_stage(),
         "cost": cost_stage(),
+        "sharding": sharding_stage(),
         "serving": serving_stage(),
         "chaos": chaos_stage(),
         "chaos_pod": chaos_pod_stage(),
