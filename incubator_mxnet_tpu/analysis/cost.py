@@ -1016,3 +1016,66 @@ def analyze_bench_set(profile=None, dp=8, cap_bytes=None):
                                   name="dp%d_bucketed_convnet" % dp)
     out["__collectives__"] = stats
     return out
+
+
+# ---------------------------------------------------------------------------
+# sparse embedding cost model (mxembed)
+# ---------------------------------------------------------------------------
+
+# flops per touched element for the lazy row-sparse update paths
+# (optimizer.py _lazy_*_jit): rescale + clip + wd fold, then the
+# update math; adam adds two moment EMAs, a square, a sqrt and a divide
+_EMBED_UPDATE_FLOPS = {"lookup": 0, "scatter": 0, "sgd": 4,
+                       "sgd_momentum": 7, "adam": 14}
+
+# optimizer state rows moved per touched row (read + write each):
+# momentum keeps one slot, adam two
+_EMBED_STATE_ROWS = {"lookup": 0, "scatter": 0, "sgd": 0,
+                     "sgd_momentum": 1, "adam": 2}
+
+
+def analyze_embedding(num_rows, dim, rows_touched, dtype="float32",
+                      kind="lookup", profile=None, name=None):
+    """Static cost of one sparse-embedding op: the rows-touched x
+    row-bytes model.
+
+    The sparse path is host/wire-resident (ndarray/sparse.py design
+    note), so there is no traced program to walk — but its cost is
+    exactly determined by how many rows move: a ``lookup`` gathers
+    ``rows_touched`` rows of ``dim * itemsize`` bytes (plus the int64
+    id vector) and writes them back out; a ``scatter`` writes them; the
+    optimizer kinds (``sgd``/``sgd_momentum``/``adam``) additionally
+    read-modify-write the touched weight rows, the gradient rows, and
+    the optimizer's state rows, at the lazy kernels' per-element flop
+    counts.  Everything off the touched rows is free — that is the whole
+    point of the lazy contract."""
+    if kind not in _EMBED_UPDATE_FLOPS:
+        raise ValueError(f"analyze_embedding: unknown kind {kind!r} "
+                         f"(one of {sorted(_EMBED_UPDATE_FLOPS)})")
+    profile = get_profile(profile)
+    prog = ProgramCost(name or f"embedding.{kind}", profile)
+    k = int(rows_touched)
+    d = int(dim)
+    isize = _np.dtype(dtype).itemsize
+    row_bytes = d * isize
+    idx_bytes = k * 8
+    flops = _EMBED_UPDATE_FLOPS[kind] * k * d
+    if kind == "lookup":
+        bytes_in, bytes_out = k * row_bytes + idx_bytes, k * row_bytes
+    elif kind == "scatter":
+        bytes_in, bytes_out = k * row_bytes + idx_bytes, k * row_bytes
+    else:
+        state = _EMBED_STATE_ROWS[kind]
+        # read: weight rows + grad rows + state rows + ids;
+        # write: weight rows + state rows
+        bytes_in = (2 + state) * k * row_bytes + idx_bytes
+        bytes_out = (1 + state) * k * row_bytes
+    dt = _dtype_key(dtype)
+    bound = _classify(f"embedding.{kind}", flops, bytes_in + bytes_out,
+                      dt, profile)
+    prog.per_op.append(OpCost(
+        node=f"embedding.{kind}", op=f"embedding.{kind}", flops=flops,
+        bytes_in=bytes_in, bytes_out=bytes_out, compute_dtype=dt,
+        ai=flops / max(1, bytes_in + bytes_out), bound=bound))
+    prog.param_bytes = int(num_rows) * row_bytes
+    return prog

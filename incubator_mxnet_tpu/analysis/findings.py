@@ -113,6 +113,9 @@ CODE_TABLE = _build_code_table([
     ("untracked-stats", WARN, ("source.obs",),
      "public stats() dict not registered with the obs MetricsRegistry; "
      "invisible to the scrape plane"),
+    ("dense-grad-for-embedding", WARN, ("source.embedding",),
+     "training loop pushes the full dense gradient of an embedding-"
+     "shaped parameter; push row_sparse so only touched rows move"),
     ("blocking-h2d-in-loop", WARN, ("source.io",),
      "blocking device_put/as_in_context feed inside a training loop; "
      "the h2d staging ring (MXNET_IO_RING) overlaps the transfer"),

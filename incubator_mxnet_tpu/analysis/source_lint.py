@@ -116,6 +116,16 @@ Observability lint (the telemetry-plane registration contract):
   namespace, or suppress inline for protocol stubs / remote fetches
   whose numbers are registered elsewhere.
 
+Sparse-embedding lint (the mxembed wire contract):
+
+* ``dense-grad-for-embedding`` — a training loop calling ``kv.push``
+  with the full dense gradient of an embedding-named parameter: one
+  batch touches a handful of rows, but the push ships — and the
+  server's updater applies — the whole ``(rows, dim)`` table every
+  step.  Push row_sparse (``grad.tostype('row_sparse')``) or host the
+  table on a `embedding.ShardedEmbedding`, whose ``push_grad`` moves
+  only the touched rows to their owning shards.
+
 Suppression: append ``# mxlint: disable`` (everything on the line) or
 ``# mxlint: disable=<code>[,<code>...]`` to the offending line.
 """
@@ -175,6 +185,7 @@ _PASS_BY_CODE = {"host-sync-in-loop": "source.hostsync",
                  "sleep-under-lock": "source.locks",
                  "unjoined-thread-in-init": "source.thread",
                  "untracked-stats": "source.obs",
+                 "dense-grad-for-embedding": "source.embedding",
                  "blocking-h2d-in-loop": "source.io",
                  "kv-cache-recompile": "source.decode",
                  "unsharded-device-put": "source.sharding"}
@@ -645,6 +656,33 @@ class _Visitor(ast.NodeVisitor):
                     "per parameter; hoist the loop into kv."
                     f"{name}(names, arrays) (or stream with "
                     "begin_push/push_part/end_push)")
+        # -- dense grad pushed for an embedding-shaped parameter -------------
+        if name == "push" and self.loop_depth > 0 and \
+                isinstance(func, ast.Attribute) and len(node.args) >= 2 and \
+                any("kv" in ident.lower()
+                    for ident in self._idents(func.value)):
+            key = node.args[0]
+            key_names = {i.lower() for i in self._idents(key)}
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                key_names.add(key.value.lower())
+            if any("embed" in k for k in key_names):
+                val = node.args[1]
+                sparse_ok = any(
+                    (isinstance(sub, ast.Constant) and
+                     sub.value == "row_sparse") or
+                    (isinstance(sub, ast.Name) and sub.id in
+                     ("RowSparseNDArray", "row_sparse_array"))
+                    for sub in ast.walk(val))
+                if not sparse_ok:
+                    self._add(
+                        "dense-grad-for-embedding", node.lineno,
+                        "a training loop pushes the FULL dense gradient "
+                        "of an embedding-shaped parameter: a batch "
+                        "touches a handful of rows but every push ships "
+                        "(and the server updates) the whole table — "
+                        "push row_sparse instead (grad.tostype("
+                        "'row_sparse'), or a ShardedEmbedding table "
+                        "whose push_grad moves only the touched rows)")
         # -- concurrency lints (the mxtsan static half) ----------------------
         if name == "Thread" and \
                 not any(kw.arg == "name" for kw in node.keywords):

@@ -519,6 +519,41 @@ KNOBS = {
                                   "exhausting host memory; drops are "
                                   "counted and surfaced as the "
                                   "'profiler.dropped_events' metric"),
+    # -- sharded sparse embeddings (embedding/) ------------------------------
+    "MXNET_EMBED_PARTITION": (str, "range", "honored",
+                              "embedding/sharded.py row-partition rule: "
+                              "'range' gives each shard one contiguous "
+                              "row interval (reference ps-lite value "
+                              "ranges), 'hash' spreads rows by a stable "
+                              "integer mix of the row id (skew-resistant "
+                              "for power-law id traffic)"),
+    "MXNET_EMBED_CACHE_ROWS": (int, 4096, "honored",
+                               "device-resident hot-row cache capacity "
+                               "in rows per ShardedEmbedding (LRU over "
+                               "row ids; 0 disables the cache and every "
+                               "lookup pulls from its shard)"),
+    "MXNET_EMBED_HBM_BUDGET_MB": (int, 64, "honored",
+                                  "modeled single-device HBM budget for "
+                                  "the embedding tier: ShardedEmbedding "
+                                  "refuses to densify a table over it, "
+                                  "and run_embed_bench certifies a "
+                                  "table >= 4x this budget trains and "
+                                  "serves sharded"),
+    "MXNET_EMBED_PULL_CHUNK": (int, 65536, "honored",
+                               "rows per embed_pull request when "
+                               "streaming a whole shard back (checkpoint "
+                               "capture / serving warm-up) so one reply "
+                               "never materializes a table-sized frame"),
+    "MXNET_EMBED_BREAKER_THRESHOLD": (int, 2, "honored",
+                                      "consecutive exhausted-retry "
+                                      "failures before an embedding "
+                                      "shard is declared lost "
+                                      "(ServerLostError naming the "
+                                      "shard and its row range)"),
+    "MXNET_EMBED_BREAKER_RESET_S": (float, 30.0, "honored",
+                                    "open->half-open window of the "
+                                    "per-shard embedding circuit "
+                                    "breaker"),
 }
 
 _warned = set()

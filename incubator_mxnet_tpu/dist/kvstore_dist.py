@@ -192,6 +192,20 @@ class KVStoreDist(KVStoreTPU):
         from ..resilience.supervisor import supervised
         return supervised(name, fn, axis="workers")
 
+    def server_addresses(self):
+        """Every parameter server's (host, port), root first — the
+        shard-server set a `ShardedEmbedding` table partitions over."""
+        return [(c.host, c.port) for c in self._chans]
+
+    def embedding(self, name, num_rows, dim, **kwargs):
+        """A `ShardedEmbedding` row-sharded over THIS store's servers:
+        each server hosts one row shard next to the dense key ranges it
+        already owns, so `set_optimizer` / checkpoint state capture
+        cover both planes in one place."""
+        from ..embedding import ShardedEmbedding
+        return ShardedEmbedding(name, num_rows, dim,
+                                self.server_addresses(), **kwargs)
+
     def stats(self):
         """PR 5 retry/failover counters, one dict — exported through
         `JobSupervisor.stats()` into the chaos / run_tpu_parity

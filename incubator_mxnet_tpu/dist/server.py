@@ -39,7 +39,7 @@ from ..resilience import faults as _faults
 # "members" are idempotent too — a re-executed heartbeat just refreshes
 # the same liveness timestamp)
 _READ_CMDS = frozenset({"pull", "server_list", "get_optimizer_states",
-                        "hb", "members", "metrics"})
+                        "hb", "members", "metrics", "embed_pull"})
 
 
 class _State:
@@ -76,6 +76,13 @@ class _State:
         # table's epoch for cheap fencing inside kvstore waits.
         self.membership = None
         self.epoch = 0
+        # sharded sparse-embedding tier (embedding/sharded.py): this
+        # server hosts one ROW SHARD per table — only its own rows, the
+        # table never materializes densely anywhere.
+        # table -> {"rows": np [local_rows, dim], "ids": global row ids
+        # this shard owns (sorted), "id_pos": id -> local position,
+        # "version": applied pushes, "pushed"/"pulled": row counters}
+        self.embed = {}
 
 
 class ParameterServer:
@@ -488,6 +495,113 @@ class ParameterServer:
                 # the handler thread with no reply and stall the worker
                 return {"error": f"server profiler {action} failed: {e!r}"}
             return {"ok": True, "state": _profiler.state()}
+
+        if cmd == "embed_init":
+            # one ROW SHARD of a sharded embedding table lands here: the
+            # worker ships the global row ids this server owns plus either
+            # the initial values or a (seed, scale) recipe — the table as
+            # a whole never exists densely in any single process
+            table = msg["table"]
+            with st.cond:
+                if table in st.embed:
+                    ent = st.embed[table]
+                    return {"ok": True, "rows": len(ent["rows"]),
+                            "version": ent["version"]}
+                dim = int(msg["dim"])
+                dtype = np.dtype(msg.get("dtype", "float32"))
+                if msg.get("ids") is not None:
+                    # hash partition: an explicit (sorted) id set
+                    ids = np.asarray(msg["ids"], dtype=np.int64)
+                    ent = {"mode": "set", "ids": ids,
+                           "id_pos": {int(i): p
+                                      for p, i in enumerate(ids)}}
+                    n, seed_salt = len(ids), int(ids[0]) if len(ids) else 0
+                else:
+                    # range partition: one contiguous interval — local
+                    # position is id - row_start, no per-id index needed
+                    lo, hi = int(msg["row_start"]), int(msg["row_end"])
+                    ent = {"mode": "range", "row_start": lo, "row_end": hi}
+                    n, seed_salt = hi - lo, lo
+                if msg.get("values") is not None:
+                    rows = np.asarray(msg["values"], dtype=dtype)
+                else:
+                    rng = np.random.default_rng(
+                        [int(msg.get("seed", 0)), seed_salt])
+                    rows = (rng.standard_normal((n, dim))
+                            * float(msg.get("scale", 0.01))).astype(dtype)
+                ent.update(rows=rows, version=0, pushed=0, pulled=0)
+                st.embed[table] = ent
+                st.cond.notify_all()
+            return {"ok": True, "rows": n, "version": 0}
+
+        if cmd in ("embed_push", "embed_pull"):
+            table = msg["table"]
+            with st.cond:
+                ent = st.embed.get(table)
+                if ent is None:
+                    return {"error": f"embedding table {table!r} has not "
+                                     "been initialized on this server"}
+                ids = np.asarray(msg["ids"], dtype=np.int64)
+                if ent["mode"] == "range":
+                    local = ids - ent["row_start"]
+                    bad = (local < 0) | (local >= len(ent["rows"]))
+                    if bad.any():
+                        return {"error": f"embedding table {table!r}: row "
+                                         f"{int(ids[bad][0])} is outside "
+                                         "this shard's range "
+                                         f"[{ent['row_start']}, "
+                                         f"{ent['row_end']}) (worker/"
+                                         "server partition rules "
+                                         "disagree)"}
+                else:
+                    pos = ent["id_pos"]
+                    try:
+                        local = np.fromiter((pos[int(i)] for i in ids),
+                                            dtype=np.int64, count=len(ids))
+                    except KeyError as e:
+                        return {"error": f"embedding table {table!r}: row "
+                                         f"{e.args[0]} is not owned by "
+                                         "this shard (worker/server "
+                                         "partition rules disagree)"}
+                if cmd == "embed_pull":
+                    ent["pulled"] += len(local)
+                    return {"values": ent["rows"][local],
+                            "version": ent["version"]}
+                vals = np.asarray(msg["values"],
+                                  dtype=ent["rows"].dtype)
+                if msg.get("op") == "assign":
+                    # checkpoint restore / weight swap: overwrite rows
+                    # (a prior lazy update left rows as a read-only
+                    # device-array view — rematerialize writable first)
+                    if not ent["rows"].flags.writeable:
+                        ent["rows"] = np.array(ent["rows"])
+                    ent["rows"][local] = vals
+                elif st.updater is None:
+                    return {"error": f"embed_push({table!r}): no "
+                                     "optimizer installed on this server "
+                                     "(send set_optimizer first, or push "
+                                     "with op='assign')"}
+                else:
+                    # lazy row-sparse optimizer step over the LOCAL slice:
+                    # the grad travels as (rows, values) and optimizer.py's
+                    # lazy SGD/Adam paths gather/update/scatter only the
+                    # touched rows — identical math to a worker-side
+                    # row_sparse update
+                    from ..ndarray.ndarray import array
+                    from ..ndarray.sparse import RowSparseNDArray
+                    weight = array(ent["rows"])
+                    grad = RowSparseNDArray(vals, local, ent["rows"].shape)
+                    st.updater(f"embed:{table}", grad, weight)
+                    ent["rows"] = weight.asnumpy()
+                ent["pushed"] += len(local)
+                ent["version"] += 1
+                st.cond.notify_all()
+                # the post-update rows ride the reply so the worker's
+                # hot-row cache refreshes in place instead of
+                # invalidating — steady-state training lookups then
+                # never leave HBM
+                return {"ok": True, "version": ent["version"],
+                        "values": ent["rows"][local]}
 
         if cmd == "stop":
             with st.cond:

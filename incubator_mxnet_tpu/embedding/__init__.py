@@ -1,0 +1,28 @@
+"""mxembed: sharded sparse embeddings for recommender workloads.
+
+The workload the source framework was famous for (PAPERS.md: the MXNet
+paper's `row_sparse` + ps-lite push/pull design; the TensorFlow paper's
+sparse embedding layers for production recommenders): embedding tables
+too big for one device's HBM, range/hash-partitioned into row shards
+hosted on the `dist_async` parameter servers, trained with lazy
+row-sparse optimizer updates applied shard-side so only touched rows
+ever move, and served through a device-resident hot-row LRU cache so the
+steady-state lookup for hot ids never leaves HBM.
+
+- `ShardedEmbedding`  — the sharded table client (push/pull, breakers,
+  `ServerLostError` failover diagnosis, checkpoint capture/restore)
+- `HotRowCache`       — device-resident LRU row cache (unified program
+  cache, donation discipline, hit/miss/eviction stats)
+- `EmbeddingFitAdapter` — trains a table through `Module.fit` by feeding
+  looked-up vectors as a data input and pushing the input gradient back
+  as row_sparse at each batch end
+- `EmbeddingServingPath` — fans a request's id-set out to the embedding
+  shards, then submits the dense tower through a `ReplicaRouter`
+"""
+from .cache import HotRowCache
+from .sharded import ShardedEmbedding, shard_of_ids
+from .fit import EmbeddingFitAdapter
+from .serving import EmbeddingServingPath
+
+__all__ = ["HotRowCache", "ShardedEmbedding", "shard_of_ids",
+           "EmbeddingFitAdapter", "EmbeddingServingPath"]

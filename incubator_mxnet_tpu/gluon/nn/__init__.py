@@ -2,4 +2,5 @@
 from .activations import *
 from .basic_layers import *
 from .conv_layers import *
+from .sparse import *
 from .activations import Activation

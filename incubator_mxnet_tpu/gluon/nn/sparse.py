@@ -1,0 +1,73 @@
+"""Gluon block over the sharded sparse-embedding tier (mxembed).
+
+`nn.Embedding` holds its table as a dense Parameter — fine until the
+table outgrows one device's HBM.  `SparseEmbedding` instead wraps a
+`embedding.ShardedEmbedding`: the forward pass looks rows up through
+the device-resident hot-row cache (a data-plane fetch, not a Parameter
+read), the looked-up block is an autograd LEAF, and after ``backward()``
+the leaf's gradient is pushed row-sparse to the owning parameter-server
+shards where the lazy optimizer applies it.  The dense parameters of
+the surrounding net keep training through `Trainer` untouched.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..block import Block
+from ...ndarray.ndarray import NDArray
+
+__all__ = ["SparseEmbedding"]
+
+
+class SparseEmbedding(Block):
+    """Embedding lookup backed by a `ShardedEmbedding` table.
+
+    ::
+
+        table = embedding.ShardedEmbedding("user", rows, dim, servers,
+                                           optimizer=opt)
+        emb = nn.SparseEmbedding(table)
+        with autograd.record():
+            y = net(emb(ids), dense_x)
+            L = loss(y, label)
+        L.backward()
+        emb.push_grads()        # row-sparse push, shard-side update
+        trainer.step(batch)     # dense params as usual
+    """
+
+    def __init__(self, table, **kwargs):
+        super().__init__(**kwargs)
+        self._table = table
+        self._pending = []      # (ids, leaf) since the last push
+
+    @property
+    def table(self):
+        return self._table
+
+    def forward(self, x):
+        ids = np.asarray(
+            x.asnumpy() if hasattr(x, "asnumpy") else x).astype(np.int64)
+        flat = self._table.lookup(ids)      # device array, cache-hot
+        out = NDArray(flat.reshape(ids.shape + (self._table.dim,)))
+        # the lookup result is a leaf: backward leaves d(loss)/d(rows)
+        # in out.grad, which push_grads ships row-sparse to the shards
+        out.attach_grad()
+        self._pending.append((ids, out))
+        return out
+
+    def push_grads(self):
+        """Push every recorded lookup's gradient to the owning shards
+        (duplicate ids pre-summed; lazy update applied server-side)."""
+        pending, self._pending = self._pending, []
+        for ids, leaf in pending:
+            g = leaf.grad
+            if g is None:
+                continue
+            self._table.push_grad(
+                ids.ravel(),
+                g.asnumpy().reshape(ids.size, self._table.dim))
+
+    def __repr__(self):
+        t = self._table
+        return f"SparseEmbedding({t.num_rows} -> {t.dim}, " \
+               f"{t.num_shards} shards, {t.partition})"

@@ -232,6 +232,17 @@ class KVStore:
                     full[rows] = vals
                     tgt._set_data(tgt._data * 0 + full)
 
+    def embedding(self, name, num_rows, dim, **kwargs):
+        """A `embedding.ShardedEmbedding` table hosted on this store's
+        parameter servers (dist stores only: the table's row shards live
+        in the server processes, never densely on a worker).  Local
+        stores have no server plane to shard onto."""
+        raise MXNetError(
+            f"kvstore type {self.type!r} has no parameter-server plane "
+            "to host a sharded embedding — create the table against a "
+            "'dist_async'/'dist_sync' store, or pass explicit server "
+            "addresses to embedding.ShardedEmbedding")
+
     def pushpull(self, key, value, out=None, priority=0):
         self.push(key, value, priority)
         if out is not None:

@@ -22,6 +22,27 @@ class BaseSparseNDArray(NDArray):
     pass
 
 
+def aggregate_row_sparse(indices, values):
+    """Sum duplicate row ids into one (sorted-unique ids, summed values)
+    pair.
+
+    A minibatch touching the same embedding row twice produces duplicate
+    ids; the lazy optimizer paths gather/scatter per id, so duplicates
+    must be pre-summed or momentum/Adam state rows are scattered
+    last-write-wins.  The embedding push path and `_row_sparse_grad`
+    both normalize through here."""
+    indices = np.asarray(indices, dtype=np.int64)
+    values = np.asarray(values)
+    if len(indices) <= 1:
+        return indices, values
+    uniq, inv = np.unique(indices, return_inverse=True)
+    if len(uniq) == len(indices) and np.array_equal(uniq, indices):
+        return indices, values   # already sorted-unique: no copy
+    out = np.zeros((len(uniq),) + values.shape[1:], dtype=values.dtype)
+    np.add.at(out, inv, values)
+    return uniq, out
+
+
 class RowSparseNDArray(BaseSparseNDArray):
     """row_sparse: (indices, values) over axis 0 (reference sparse.py:RowSparseNDArray)."""
 

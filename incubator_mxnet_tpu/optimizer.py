@@ -257,11 +257,14 @@ def _row_sparse_grad(grad):
     """(indices, values) of a row-sparse grad, `_EMPTY_ROWS` when it has no
     touched rows (the lazy contract: a no-op step, NOT a dense decay), or
     None for dense grads."""
-    from .ndarray.sparse import RowSparseNDArray
+    from .ndarray.sparse import RowSparseNDArray, aggregate_row_sparse
     if isinstance(grad, RowSparseNDArray):
         if len(grad._np_indices) == 0:
             return _EMPTY_ROWS
-        return grad._np_indices, grad._np_data
+        # duplicate ids (one batch touching a row twice) must pre-sum:
+        # the lazy kernels scatter state rows with .at[idx].set, which is
+        # last-write-wins under duplicates
+        return aggregate_row_sparse(grad._np_indices, grad._np_data)
     return None
 
 

@@ -756,6 +756,9 @@ _SUPPRESSION_FIXTURES = {
         "class KV:\n"
         "    def stats(self):\n"
         "        return {'pushes': 1}\n", 2),
+    "dense-grad-for-embedding": (
+        "for batch in it:\n"
+        "    kv.push('embed_weight', embed_grad)\n", 2),
     "blocking-h2d-in-loop": (
         "import jax\n"
         "for batch in it:\n"

@@ -114,16 +114,44 @@ def _sparse_ops(mx, nd, np):
         return run
 
     # the lazy row-sparse update runs through the host-resident sparse
-    # path (see ndarray/sparse.py) — no traced program to cost statically
+    # path (see ndarray/sparse.py) — no traced program to walk, but the
+    # rows-touched x row-bytes model (cost.analyze_embedding) gives the
+    # static column exactly: cost scales with touched rows, not table size
+    from incubator_mxnet_tpu.analysis import cost as _mxcost
+
+    def _embed_static(kind):
+        try:
+            return _static_of(_mxcost.analyze_embedding(
+                V, D, K, kind=kind, name=f"sparse.{kind}_lazy"))
+        except Exception:
+            return None
+
+    # embedding-lookup lane: the serving/fit hot path — a batched device
+    # gather from a hot-row cache buffer through the unified program cache
+    from incubator_mxnet_tpu.embedding import HotRowCache
+    cache = HotRowCache(D, capacity=max(256, K), name="bench")
+    cache.insert(rows, rng.randn(K, D).astype("f4"))
+    lookup_ids = rng.choice(rows, 256, replace=True).astype(np.int64)
+
+    def run_lookup():
+        out, _h, _m = cache.lookup(lookup_ids, pull_fn=None)
+        return out
+
     return {
         "sparse.sgd_momentum_lazy": (
             bench("sgd", mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
                                           lazy_update=True)),
-            f"({V},{D}) table, {K} rows", None),
+            f"({V},{D}) table, {K} rows", _embed_static("sgd_momentum")),
         "sparse.adam_lazy": (
             bench("adam", mx.optimizer.Adam(learning_rate=0.001,
                                             lazy_update=True)),
-            f"({V},{D}) table, {K} rows", None),
+            f"({V},{D}) table, {K} rows", _embed_static("adam")),
+        "sparse.embedding_lookup": (
+            run_lookup,
+            f"({V},{D}) table, 256 hot ids",
+            _static_of(_mxcost.analyze_embedding(
+                V, D, 256, kind="lookup",
+                name="sparse.embedding_lookup"))),
     }
 
 

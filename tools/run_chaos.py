@@ -1407,6 +1407,304 @@ def run_train(as_json=False, out_path=None):
     return 0 if artifact["all_passed"] else 1
 
 
+# -- sharded-embedding chaos: SIGKILL a row shard mid-traffic -----------------
+#
+# The mxembed failure matrix (embedding/sharded.py): a shard server dying
+# becomes a structured ServerLostError naming the shard and its rows;
+# training recovers by restoring the checkpointed table and replaying
+# from the checkpoint (bit-identical, since the lazy updates are
+# deterministic); serving recovers through the on_shard_lost hook
+# (respawn + replace_shard) with ZERO lost admitted requests.
+
+def _spawn_shard_proc(port):
+    """One embedding row-shard server as a real subprocess, so the
+    schedule can SIGKILL it (not a polite in-process shutdown)."""
+    env = dict(os.environ,
+               DMLC_PS_ROOT_URI="127.0.0.1", DMLC_PS_ROOT_PORT=str(port),
+               DMLC_NUM_WORKER="1", JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                             ""))
+    env.pop("MXNET_FAULTS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "incubator_mxnet_tpu.dist.server"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        cwd=REPO)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), 0.5).close()
+            return proc
+        except OSError:
+            if proc.poll() is not None:
+                break
+            time.sleep(0.05)
+    proc.kill()
+    raise RuntimeError("embedding shard server on port %d never came up"
+                       % port)
+
+
+def _table_sha(table):
+    import hashlib
+    return hashlib.sha256(table.checkpoint_rows().tobytes()).hexdigest()
+
+
+def _embed_fit_model(rows, dim, table, n=96, bs=16, seed=0):
+    """The wide-and-deep fixture: deterministic id stream + tower,
+    bound with inputs_need_grad so fit's classic loop exposes the
+    embedding gradient (examples/recommender/wide_deep.py)."""
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import embedding as mxembed, io, sym
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, rows, size=(n, 2)).astype("int64")
+    dense = rng.standard_normal((n, 4)).astype("float32")
+    label = ((ids[:, 0] + ids[:, 1]) % 2).astype("float32")
+    base = io.NDArrayIter({"emb": ids.astype("float32"), "dense": dense},
+                          {"softmax_label": label}, batch_size=bs)
+    adapter = mxembed.EmbeddingFitAdapter(table, base, id_field=0)
+    emb = sym.Variable("emb")
+    den = sym.Variable("dense")
+    deep = sym.FullyConnected(emb, num_hidden=8, name="deep1")
+    deep = sym.Activation(deep, act_type="relu")
+    wide = sym.FullyConnected(den, num_hidden=8, name="wide1")
+    out = sym.FullyConnected(deep + wide, num_hidden=2, name="head")
+    net = sym.SoftmaxOutput(out, name="softmax")
+    np.random.seed(seed)
+    mx.random.seed(seed)
+    mod = mx.mod.Module(net, data_names=("emb", "dense"),
+                        label_names=("softmax_label",), context=mx.cpu())
+    mod.bind(data_shapes=adapter.provide_data,
+             label_shapes=adapter.provide_label,
+             for_training=True, inputs_need_grad=True)
+    mod.init_params(mx.initializer.Xavier(rnd_type="gaussian"))
+    return mod, adapter
+
+
+def _embed_fit_epoch(mod, adapter):
+    import incubator_mxnet_tpu as mx
+    mod.fit(adapter, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            batch_end_callback=adapter.make_callback(mod),
+            eval_metric="acc")
+
+
+def run_embedding_schedule(name, quiet=False):
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import embedding as mxembed
+    from incubator_mxnet_tpu.resilience import ServerLostError
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # fast shard-death diagnosis (prod defaults wait seconds/reconnect)
+    os.environ["MXNET_PS_RECONNECT_WAIT"] = "0.1"
+    os.environ["MXNET_PS_MAX_RETRIES"] = "2"
+    os.environ["MXNET_EMBED_BREAKER_THRESHOLD"] = "2"
+    t0 = time.time()
+    checks = {}
+    rows, dim = 64, 4
+    seed = 23
+
+    if name == "train-shard-kill":
+        # clean reference: epoch 1, checkpoint, epoch 2 -> final shas
+        def fresh(ports):
+            table = mxembed.ShardedEmbedding(
+                "chaos_wd", rows, dim,
+                [("127.0.0.1", p) for p in ports], seed=seed,
+                cache_rows=32,
+                optimizer=mx.optimizer.SGD(learning_rate=0.1,
+                                           momentum=0.0))
+            mod, adapter = _embed_fit_model(rows, dim, table, seed=seed)
+            return table, mod, adapter
+
+        ports = [_free_port(), _free_port()]
+        procs = [_spawn_shard_proc(p) for p in ports]
+        try:
+            table, mod, adapter = fresh(ports)
+            _embed_fit_epoch(mod, adapter)
+            ck_table = table.checkpoint_rows()
+            ck_args, ck_auxs = mod.get_params()
+            ck_args = {k: v.asnumpy().copy() for k, v in ck_args.items()}
+            _embed_fit_epoch(mod, adapter)
+            ref_table_sha, ref_dense_sha = _table_sha(table), \
+                _params_sha(mod)
+
+            # chaos lane: restore epoch-1 state, then SIGKILL shard 1
+            # at a seeded batch boundary inside the replayed epoch 2
+            table.restore_rows(ck_table)
+            mod.set_params({k: mx.nd.array(v)
+                            for k, v in ck_args.items()}, ck_auxs,
+                           allow_missing=False, force_init=True)
+            kill_at = int(np.random.RandomState(seed).randint(1, 4))
+            state = {"batches": 0, "err": None}
+            push_cb = adapter.make_callback(mod)
+
+            def chaos_cb(param):
+                push_cb(param)
+                state["batches"] += 1
+                if state["batches"] == kill_at:
+                    procs[1].kill()          # SIGKILL, mid-traffic
+                    procs[1].wait()
+            try:
+                mod.fit(adapter, num_epoch=1, optimizer="sgd",
+                        optimizer_params={"learning_rate": 0.1},
+                        batch_end_callback=chaos_cb, eval_metric="acc")
+            except ServerLostError as e:
+                state["err"] = e
+            err = state["err"]
+            checks["server_lost_structured"] = (
+                err is not None and err.server == 1
+                and any("chaos_wd" in k for k in err.keys))
+            checks["killed_sigkill"] = procs[1].returncode == -9
+            # auto-resume: respawn the shard, restore the checkpointed
+            # table and dense params, replay the epoch from the
+            # checkpoint — bit-identical to the clean reference
+            ports[1] = _free_port()
+            procs[1] = _spawn_shard_proc(ports[1])
+            table.replace_shard(1, "127.0.0.1", ports[1],
+                                restore=ck_table)
+            table.restore_rows(ck_table)
+            mod.set_params({k: mx.nd.array(v)
+                            for k, v in ck_args.items()}, ck_auxs,
+                           allow_missing=False, force_init=True)
+            adapter.reset()      # the aborted epoch left it mid-stream
+            _embed_fit_epoch(mod, adapter)
+            checks["resumed_table_bit_identical"] = (
+                _table_sha(table) == ref_table_sha)
+            checks["resumed_dense_bit_identical"] = (
+                _params_sha(mod) == ref_dense_sha)
+            checks["failover_counted"] = table.stats()["failovers"] == 1
+            table.close()
+        finally:
+            for p in procs:
+                p.kill()
+                p.communicate()
+
+    elif name == "serve-shard-kill":
+        from incubator_mxnet_tpu import io, sym
+        from incubator_mxnet_tpu.serving import LocalReplica, ReplicaRouter
+        ports = [_free_port(), _free_port()]
+        procs = [_spawn_shard_proc(p) for p in ports]
+        try:
+            table = mxembed.ShardedEmbedding(
+                "chaos_serve", rows, dim,
+                [("127.0.0.1", p) for p in ports], seed=seed,
+                cache_rows=0)        # every lookup exercises the wire
+            ck = table.checkpoint_rows()
+            np.random.seed(seed)
+            mx.random.seed(seed)
+            net = sym.FullyConnected(sym.Variable("emb"), num_hidden=3,
+                                     name="head")
+            net = sym.SoftmaxOutput(net, name="softmax")
+            mod = mx.mod.Module(net, data_names=("emb",),
+                                label_names=("softmax_label",),
+                                context=mx.cpu())
+            mod.bind(data_shapes=[io.DataDesc("emb", (2, 2 * dim))],
+                     label_shapes=[io.DataDesc("softmax_label", (2,))],
+                     for_training=False, grad_req="null")
+            mod.init_params(mx.initializer.Xavier())
+            args, auxs = mod.get_params()
+            reps = [LocalReplica(
+                mx.serving.ServedModel(
+                    net, args, auxs, data_shapes=[("emb", (1, 2 * dim))],
+                    buckets=(1, 2, 4), ctx=mx.cpu(), name="tower"),
+                replica_id="r%d" % i) for i in range(2)]
+            lock = threading.Lock()
+            state = {"done": 0, "ok": 0, "killed": False, "gen": 0}
+
+            def on_shard_lost(err):
+                # thread-safe respawn: first caller replaces the shard,
+                # racers see the bumped generation and just retry
+                with lock:
+                    gen = state["gen"]
+                    if gen == table.failovers:
+                        port = _free_port()
+                        procs.append(_spawn_shard_proc(port))
+                        table.replace_shard(err.server, "127.0.0.1",
+                                            port, restore=ck)
+                        state["gen"] = table.failovers
+                return True
+
+            rng = np.random.RandomState(seed)
+            reqs = rng.randint(0, rows, size=(60, 2, 2))
+            kill_after = int(rng.randint(8, 16))
+            with ReplicaRouter(reps, health_interval_s=0.2) as router:
+                path = mxembed.EmbeddingServingPath(
+                    table, router, embed_input="emb",
+                    on_shard_lost=on_shard_lost)
+                baseline = {}
+                for i, ids in enumerate(reqs):
+                    baseline[i] = path.predict(
+                        ids, timeout_ms=10000)[0].asnumpy()
+                n_before = path.requests
+
+                def worker(idx0):
+                    for i in range(idx0, len(reqs), 4):
+                        got = path.predict(reqs[i],
+                                           timeout_ms=10000)[0].asnumpy()
+                        with lock:
+                            state["done"] += 1
+                            if np.allclose(got, baseline[i]):
+                                state["ok"] += 1
+                        if not state["killed"] and \
+                                state["done"] >= kill_after:
+                            with lock:
+                                if not state["killed"]:
+                                    state["killed"] = True
+                                    procs[0].kill()   # SIGKILL shard 0
+                                    procs[0].wait()
+                threads = [threading.Thread(target=worker, args=(k,))
+                           for k in range(4)]
+                for th in threads:
+                    th.start()
+                for th in threads:
+                    th.join()
+            st = path.stats()
+            checks["killed_sigkill"] = procs[0].returncode == -9
+            checks["zero_lost_admitted"] = (
+                state["done"] == len(reqs)
+                and st["completed"] == n_before + len(reqs))
+            checks["results_match_baseline"] = state["ok"] == len(reqs)
+            checks["failover_fired"] = (st["shard_failovers"] >= 1
+                                        and table.stats()["failovers"] >= 1)
+            table.close()
+        finally:
+            for p in procs:
+                p.kill()
+                p.communicate()
+    else:
+        raise ValueError("unknown embedding schedule %r" % name)
+
+    bools = [v for v in checks.values() if isinstance(v, bool)]
+    result = {"schedule": name, "seed": seed, "checks": checks,
+              "duration_s": round(time.time() - t0, 1),
+              "passed": bool(bools) and all(bools)}
+    if not quiet:
+        print("chaos[embed/%s]: passed=%s checks=%s (%.1fs)" %
+              (name, result["passed"], result["checks"],
+               result["duration_s"]), file=sys.stderr)
+    return result
+
+
+def run_embedding(as_json=False, out_path=None):
+    runs = []
+    for name in ("train-shard-kill", "serve-shard-kill"):
+        try:
+            runs.append(run_embedding_schedule(name, quiet=as_json))
+        except Exception as exc:
+            runs.append({"schedule": name, "passed": False,
+                         "error": repr(exc)})
+    artifact = {"schedules": runs,
+                "all_passed": all(r["passed"] for r in runs)}
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(artifact, f, indent=1)
+    if as_json:
+        print(json.dumps(artifact))
+    else:
+        print("chaos embedding: %d schedule(s), all_passed=%s -> %s" %
+              (len(runs), artifact["all_passed"], out_path))
+    return 0 if artifact["all_passed"] else 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="run_chaos", description=__doc__)
     ap.add_argument("--quick", action="store_true")
@@ -1415,9 +1713,16 @@ def main(argv=None):
     ap.add_argument("--fleet", action="store_true")
     ap.add_argument("--train", action="store_true")
     ap.add_argument("--decode", action="store_true")
+    ap.add_argument("--embedding", action="store_true")
     ap.add_argument("--json", action="store_true", dest="as_json")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
+    if args.embedding:
+        out = args.out if args.out is not None \
+            else os.path.join(REPO, "CHAOS_EMBED.json")
+        sys.path.insert(0, REPO)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        return run_embedding(as_json=args.as_json, out_path=out)
     if args.decode:
         out = args.out if args.out is not None \
             else os.path.join(REPO, "CHAOS_DECODE.json")

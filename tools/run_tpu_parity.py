@@ -461,6 +461,26 @@ def chaos_decode_stage():
         return {"error": f"chaos decode stage failed: {exc!r}"}
 
 
+def chaos_embed_stage():
+    """Sharded-embedding chaos stage: run tools/run_chaos.py --embedding
+    in a throwaway process — an embedding row-shard server SIGKILLed
+    mid-traffic, once during Module.fit training (structured
+    ServerLostError naming the shard + rows; resume from the table
+    checkpoint bit-identical to a clean reference) and once under
+    router serving load (on_shard_lost respawn + replace_shard, zero
+    lost admitted requests) — and attach its CHAOS_EMBED artifact."""
+    cmd = [sys.executable, os.path.join(REPO, "tools", "run_chaos.py"),
+           "--embedding", "--json", "--out", ""]
+    try:
+        out = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                             timeout=1800)
+        summary = json.loads(out.stdout)
+        summary["rc"] = out.returncode
+        return summary
+    except Exception as exc:
+        return {"error": f"chaos embedding stage failed: {exc!r}"}
+
+
 def coldstart_stage():
     """Cold-start stage: the warmup CLI's built-in probe, run cold then
     warm in fresh subprocesses (tools/warmup.py coldstart_probe) — the
@@ -529,6 +549,7 @@ def main():
         "chaos_fleet": chaos_fleet_stage(),
         "chaos_train": chaos_train_stage(),
         "chaos_decode": chaos_decode_stage(),
+        "chaos_embed": chaos_embed_stage(),
         "llm": llm_stage(),
         "coldstart": coldstart_stage(),
         "scaling": scaling_stage(),
