@@ -503,10 +503,6 @@ class ParameterServer:
             # a whole never exists densely in any single process
             table = msg["table"]
             with st.cond:
-                if table in st.embed:
-                    ent = st.embed[table]
-                    return {"ok": True, "rows": len(ent["rows"]),
-                            "version": ent["version"]}
                 dim = int(msg["dim"])
                 dtype = np.dtype(msg.get("dtype", "float32"))
                 if msg.get("ids") is not None:
@@ -522,6 +518,41 @@ class ParameterServer:
                     lo, hi = int(msg["row_start"]), int(msg["row_end"])
                     ent = {"mode": "range", "row_start": lo, "row_end": hi}
                     n, seed_salt = hi - lo, lo
+                old = st.embed.get(table)
+                if old is not None:
+                    if (old["mode"] != ent["mode"]
+                            or old["rows"].shape != (n, dim)
+                            or (ent["mode"] == "range"
+                                and (old["row_start"], old["row_end"])
+                                != (ent["row_start"], ent["row_end"]))
+                            or (ent["mode"] == "set"
+                                and not np.array_equal(old["ids"],
+                                                       ent["ids"]))):
+                        # the worker and this server disagree about
+                        # shard ownership — a silent ack would leave the
+                        # old rows serving under the new partition rules
+                        return {"error": f"embed_init: table {table!r} "
+                                         "already exists on this server "
+                                         "with a different shard spec — "
+                                         "refusing to keep stale rows "
+                                         f"(have {old['rows'].shape}, "
+                                         f"init asked for {(n, dim)})"}
+                    if msg.get("values") is None:
+                        # same spec, no payload: idempotent re-init
+                        # (transport retry) — the rows already live here
+                        return {"ok": True, "rows": len(old["rows"]),
+                                "version": old["version"]}
+                    # explicit values on an existing table: a checkpoint
+                    # restore through replace_shard landed on a standby/
+                    # previously-initialized server — overwrite, a silent
+                    # no-op ack would defeat the recovery path
+                    old["rows"] = np.asarray(
+                        msg["values"],
+                        dtype=old["rows"].dtype).reshape(n, dim)
+                    old["version"] += 1
+                    st.cond.notify_all()
+                    return {"ok": True, "rows": n,
+                            "version": old["version"]}
                 if msg.get("values") is not None:
                     rows = np.asarray(msg["values"], dtype=dtype)
                 else:

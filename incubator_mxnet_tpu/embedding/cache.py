@@ -103,22 +103,30 @@ class HotRowCache:
         Hits gather straight from the device buffer; the unique missing
         ids go through ``pull_fn(miss_ids) -> np [k, dim]`` (the sharded
         pull), are scattered into LRU slots, and the full request then
-        gathers.  Returns (device_rows, n_hits, n_misses)."""
+        gathers.  Returns (device_rows, n_hits, n_misses).  Under heavy
+        cross-thread eviction churn the retry is bounded: after a few
+        rounds the batch is served uncached (host rows straight from
+        ``pull_fn``) rather than hammering the parameter servers."""
         ids = np.asarray(ids, dtype=np.int64).ravel()
         id_list = ids.tolist()
+        uniq = list(dict.fromkeys(id_list))
+        # guard on the WHOLE batch's distinct ids, not just the misses:
+        # when the batch itself cannot fit, the insert would evict the
+        # batch's own resident rows, the post-insert check would fail,
+        # and the re-pull loop would never converge
+        if len(uniq) > self.capacity:
+            raise ValueError(
+                f"hot-row cache capacity {self.capacity} cannot "
+                f"hold the {len(uniq)} distinct rows of one "
+                "lookup — raise MXNET_EMBED_CACHE_ROWS past the "
+                "per-batch distinct id count")
         self._ensure_buf()
-        while True:
+        for _attempt in range(8):
             with self._lock:
                 miss_occ = [i for i in id_list if i not in self._slot]
                 miss = list(dict.fromkeys(miss_occ))
                 n_miss = len(miss_occ)
                 n_hit = len(ids) - n_miss
-                if len(miss) > self.capacity:
-                    raise ValueError(
-                        f"hot-row cache capacity {self.capacity} cannot "
-                        f"hold the {len(miss)} distinct rows of one "
-                        "lookup — raise MXNET_EMBED_CACHE_ROWS past the "
-                        "per-batch distinct id count")
                 # pin this batch's resident rows at the MRU end BEFORE
                 # the miss insert: its evictions then only ever take
                 # rows outside this batch (capacity >= batch distinct)
@@ -139,7 +147,20 @@ class HotRowCache:
                                     dtype=np.int32, count=len(ids))
                 for i in id_list:
                     self._slot.move_to_end(i)
-            return self._gathered(slots, len(ids)), n_hit, n_miss
+                # dispatch the gather UNDER the lock: a concurrent
+                # insert donates self._buf away, so the validated slots
+                # and the buffer they index must be captured atomically
+                # or the gather can read re-scattered rows
+                return self._gathered(slots, len(ids)), n_hit, n_miss
+        # eviction churn won this batch every round: serve it uncached
+        # (one last pull, no pinning) instead of retrying unboundedly
+        rows = np.asarray(pull_fn(np.asarray(uniq, dtype=np.int64)),
+                          dtype=self.dtype).reshape(len(uniq), self.dim)
+        pos = {i: j for j, i in enumerate(uniq)}
+        with self._lock:
+            self.hits += n_hit
+            self.misses += n_miss
+        return rows[[pos[i] for i in id_list]], n_hit, n_miss
 
     def _gathered(self, slots, n):
         padded = _pad_pow2(n)

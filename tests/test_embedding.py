@@ -16,6 +16,8 @@ with mid-traffic shard failover and zero lost admitted requests, the
 kvstore factory surfaces, the embedding cost model, and the
 `embedding.*` obs namespace + `embedding.lookup` trace spans.
 """
+import threading
+
 import numpy as np
 import pytest
 
@@ -249,6 +251,60 @@ def test_cache_capacity_overflow_is_explicit():
                  lambda ids: np.zeros((len(ids), 2), np.float32))
 
 
+def test_cache_overflow_with_resident_rows_raises_instead_of_looping():
+    """Batch distinct > capacity while the MISSES alone fit used to
+    livelock: the insert evicted the batch's own pinned rows, the
+    post-insert check failed, and the re-pull looped forever hammering
+    the shards.  The guard is on the whole batch, and pull_fn must not
+    run at all."""
+    pulls = []
+
+    def pull(ids):
+        pulls.append(list(ids))
+        return np.repeat(np.asarray(ids, np.float32)[:, None], 2, axis=1)
+
+    c = HotRowCache(dim=2, capacity=4, name="t")
+    c.lookup(np.array([0, 1, 2]), pull)      # warm: [0,1,2] resident
+    pulls.clear()
+    with pytest.raises(ValueError, match="MXNET_EMBED_CACHE_ROWS"):
+        c.lookup(np.arange(6), pull)         # 6 distinct, 3 misses
+    assert pulls == []                       # no PS traffic, no retry
+
+
+def test_cache_concurrent_lookups_return_correct_rows():
+    """Disjoint hot sets churning a too-small cache from three threads:
+    every lookup must still return exactly its own rows (the gather is
+    dispatched under the lock so a racing insert can't swap the buffer
+    between slot validation and the gather), and the bounded retry
+    falls back to an uncached pull rather than spinning."""
+    c = HotRowCache(dim=1, capacity=8, name="t")
+
+    def pull(ids):
+        return np.asarray(ids, np.float32)[:, None]
+
+    errs = []
+
+    def worker(base):
+        try:
+            rng = np.random.RandomState(base)
+            for _ in range(60):
+                ids = rng.randint(base, base + 100, size=6)
+                rows, _, _ = c.lookup(ids, pull)
+                got = np.asarray(rows)[:, 0]
+                assert np.array_equal(got, ids.astype(np.float32)), \
+                    f"lookup({ids}) returned rows for {got}"
+        except Exception as e:               # pragma: no cover - failure
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(b,))
+               for b in (0, 1000, 2000)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs[:1]
+
+
 def test_cache_steady_state_has_zero_recompiles():
     """Fixed batch shape in steady state replays ONE executable: the
     padded gather/scatter signature set stops growing (the
@@ -328,6 +384,36 @@ def test_replace_shard_restores_rows_and_serving():
     table.push_grad(np.array([15]), np.ones((1, 2), dtype=np.float32))
     assert np.allclose(table.pull_rows([15]), ckpt[15] - 0.1)
     _teardown(table, servers + respawn)
+
+
+def test_replace_shard_restore_overwrites_standby_server_rows():
+    """replace_shard(restore=...) pointed at a STANDBY server that was
+    already initialized must overwrite the stale rows — an idempotent
+    no-op ack would silently defeat the checkpoint-restore recovery
+    path.  Retried inits with no payload stay idempotent, and a
+    conflicting shard spec is a structured error, never a silent keep."""
+    servers = _spawn(2)
+    init = np.arange(20, dtype=np.float32).reshape(10, 2)
+    table = ShardedEmbedding("standby", 10, 2, _addrs(servers),
+                             cache_rows=0, init_values=init)
+    # re-point shard 0 at the SAME still-initialized server with a
+    # restore payload: its rows must become the checkpoint's, not stay
+    # at the stale init
+    ckpt = init + 100.0
+    table.replace_shard(0, "127.0.0.1", servers[0].port, restore=ckpt)
+    out = table.pull_rows(np.arange(10))
+    assert np.array_equal(out[:5], ckpt[:5])    # shard 0 owns [0,5)
+    assert np.array_equal(out[5:], init[5:])    # shard 1 untouched
+    # same spec, no payload: idempotent (a transport retry keeps rows)
+    reply = table._request(0, {"cmd": "embed_init", "table": "standby",
+                               "dim": 2, "row_start": 0, "row_end": 5})
+    assert reply["ok"] and reply["rows"] == 5
+    assert np.array_equal(table.pull_rows(np.arange(5)), ckpt[:5])
+    # a different row range over existing state is a protocol bug
+    with pytest.raises(MXNetError, match="different shard spec"):
+        table._request(0, {"cmd": "embed_init", "table": "standby",
+                           "dim": 2, "row_start": 0, "row_end": 7})
+    _teardown(table, servers)
 
 
 def test_checkpoint_restore_chunked_roundtrip(monkeypatch):
