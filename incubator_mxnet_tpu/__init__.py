@@ -48,7 +48,7 @@ for _mod_name, _aliases in [
     ("subgraph", ()), ("storage", ()), ("libinfo", ()),
     ("checkpoint", ()), ("serving", ()), ("resilience", ()),
     ("kvstore_server", ()), ("native", ()), ("compile", ()),
-    ("obs", ()), ("embedding", ()),
+    ("obs", ()), ("embedding", ()), ("loop", ()),
 ]:
     try:
         _m = _importlib.import_module("." + _mod_name, __name__)

@@ -97,6 +97,9 @@ CODE_TABLE = _build_code_table([
      "host-level collective outside a supervisor/watchdog scope"),
     ("router-bypass", WARN, ("source.router",),
      "direct ServedModel/ModelServer use bypasses the configured router"),
+    ("unguarded-model-swap", WARN, ("source.loop",),
+     "direct swap_weights/replica.swap in a LoopController script "
+     "bypasses the canary gate; publish to the ModelRegistry instead"),
     ("fixed-fleet", WARN, ("source.fleet",),
      "hand-pinned replica list in an autoscaler-configured script"),
     ("host-transfer-in-graph", WARN, ("source.hostsync",),

@@ -31,6 +31,13 @@ walking a script's AST:
   death or one overload burst takes exactly that traffic down.  Route
   requests through ``router.submit()/predict()`` (or keep the script
   router-less on purpose and say so with a suppression).
+* ``unguarded-model-swap`` — a direct `swap_weights()` /
+  `replica.swap()` call in a script that also constructs a
+  `LoopController`: pushing weights straight onto the fleet bypasses
+  the canary gate the script itself set up — one bad checkpoint goes
+  straight to 100% of traffic with no holdout score and no rejected
+  stamp.  Publish the checkpoint to the `ModelRegistry` and let
+  `LoopController.poll_once()` canary it before the rolling swap.
 * ``fixed-fleet`` — a `ReplicaRouter` constructed with a hand-rolled
   FIXED replica list (a list/tuple literal or a comprehension of
   replica constructors) in a script that also configures the fleet
@@ -179,6 +186,7 @@ _PASS_BY_CODE = {"host-sync-in-loop": "source.hostsync",
                  "nan-swallow": "source.guardian",
                  "unsupervised-collective": "source.supervisor",
                  "router-bypass": "source.router",
+                 "unguarded-model-swap": "source.loop",
                  "fixed-fleet": "source.fleet",
                  "unnamed-thread": "source.thread",
                  "bare-acquire": "source.locks",
@@ -235,6 +243,10 @@ class _Visitor(ast.NodeVisitor):
         self.fleet_configured = False
         self.fixed_router_sites = []  # (lineno, what) — emitted only
                                       # when a fleet/autoscaler is too
+        self.loop_configured = False  # script constructs a LoopController
+        self.swap_sites = []          # (lineno, what) — direct swap
+                                      # calls, emitted only when a
+                                      # LoopController is configured
         self.supervised_depth = 0  # inside a supervisor/watchdog `with`
         self.device_depth = 0      # inside a jit/pjit/shard_map function
         self.lock_with_depth = 0   # inside a `with <lock-ish>:` block
@@ -737,6 +749,17 @@ class _Visitor(ast.NodeVisitor):
                         and "ServedModel" in self._idents(recv))):
                 self.bypass_sites.append(
                     (node.lineno, "direct ServedModel.infer() call"))
+        # -- unguarded model swap (canary-gate bypass) -----------------------
+        if name == "LoopController":
+            self.loop_configured = True
+        elif name == "swap_weights" or name == "swap_one":
+            self.swap_sites.append(
+                (node.lineno, f"direct {name}() call"))
+        elif name == "swap" and isinstance(func, ast.Attribute) and \
+                any("replica" in i.lower()
+                    for i in self._idents(func.value)):
+            self.swap_sites.append(
+                (node.lineno, "direct replica.swap() call"))
         if name in _COLLECTIVE_CALLS and isinstance(func, ast.Attribute) \
                 and self.supervised_depth == 0 and self.device_depth == 0:
             self._add("unsupervised-collective", node.lineno,
@@ -779,6 +802,19 @@ def scan_source(text, filename="<string>"):
                 "this traffic bypasses the router's failover, health "
                 "checks, and priority-class shedding — route it through "
                 "router.submit()/predict()",
+                location=f"{filename}:{lineno}"))
+    if v.loop_configured:
+        for lineno, what in v.swap_sites:
+            if _suppressed(lines, lineno, "unguarded-model-swap"):
+                continue
+            report.add(Finding(
+                "source.loop", "unguarded-model-swap", WARN,
+                f"{what} in a script that constructs a LoopController: "
+                "pushing weights straight onto the fleet bypasses the "
+                "canary gate the script itself set up — publish the "
+                "checkpoint to the ModelRegistry and let "
+                "LoopController.poll_once() canary-score it before the "
+                "rolling swap promotes it",
                 location=f"{filename}:{lineno}"))
     if v.fleet_configured:
         for lineno, what in v.fixed_router_sites:

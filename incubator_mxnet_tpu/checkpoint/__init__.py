@@ -24,11 +24,12 @@ from __future__ import annotations
 from . import manifest
 from . import snapshot
 from . import state
-from .manifest import latest_healthy
+from .manifest import latest_healthy, stamp_rejected, rejection, is_rejected
 from .manager import (CheckpointManager, CheckpointData, latest, load,
                       install_preemption_hook)
 from .handler import ElasticCheckpointHandler
 
 __all__ = ["CheckpointManager", "CheckpointData", "latest", "load",
-           "latest_healthy", "install_preemption_hook",
+           "latest_healthy", "stamp_rejected", "rejection", "is_rejected",
+           "install_preemption_hook",
            "ElasticCheckpointHandler", "manifest", "snapshot", "state"]

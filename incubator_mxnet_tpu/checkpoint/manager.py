@@ -55,10 +55,12 @@ class CheckpointData:
         return pickle.loads(blob)
 
 
-def latest(root, deep=True):
+def latest(root, deep=True, include_rejected=False):
     """Newest VALID checkpoint directory under `root`, or None (torn
-    checkpoints never selected — see `manifest.validate`)."""
-    return _manifest.latest(root, deep=deep)
+    checkpoints never selected — see `manifest.validate`; canary-
+    rejected ones skipped unless `include_rejected`)."""
+    return _manifest.latest(root, deep=deep,
+                            include_rejected=include_rejected)
 
 
 def load(path):

@@ -27,6 +27,7 @@ import zlib
 from ..base import MXNetError
 
 MANIFEST_NAME = "manifest.json"
+REJECTED_STAMP_NAME = "rejected.json"
 CHECKPOINT_FORMAT = "incubator_mxnet_tpu.checkpoint/1"
 _CKPT_RE = re.compile(r"^ckpt-(\d+)$")
 _TMP_PREFIX = ".tmp-ckpt-"
@@ -116,7 +117,53 @@ def validate(ckpt_dir, deep=True):
     return True
 
 
-def list_checkpoints(root, valid_only=True, deep=True):
+def stamp_rejected(ckpt_dir, reason="", **info):
+    """Stamp a checkpoint rejected — a sidecar file, not a manifest edit.
+
+    Written by the serving-side canary gate (loop/controller.py) when a
+    published version fails its holdout canary: the checkpoint stays on
+    disk (forensics, gc retention) but `latest()`/`latest_healthy()`
+    skip it from then on, so neither trainer resume nor a freshly booted
+    replica can ever select it again.  Idempotent: the FIRST stamp wins
+    and later calls return it unchanged — the original rejection
+    evidence (scores, reason) is never overwritten.  Being a plain file,
+    the stamp survives process restart.
+    """
+    path = os.path.join(ckpt_dir, REJECTED_STAMP_NAME)
+    existing = rejection(ckpt_dir)
+    if existing is not None:
+        return existing
+    rec = {"rejected": True, "reason": str(reason)}
+    rec.update(info)
+    atomic_write_json(path, rec)
+    return rec
+
+
+def rejection(ckpt_dir):
+    """The rejection stamp of `ckpt_dir`, or None if not stamped."""
+    try:
+        with open(os.path.join(ckpt_dir, REJECTED_STAMP_NAME)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def is_rejected(ckpt_dir):
+    return rejection(ckpt_dir) is not None
+
+
+def _excluded(step, path, exclude):
+    """Whether `exclude` — a callable(step)->bool or a collection of
+    steps and/or paths — blocks this checkpoint."""
+    if exclude is None:
+        return False
+    if callable(exclude):
+        return bool(exclude(step))
+    return step in exclude or path in exclude
+
+
+def list_checkpoints(root, valid_only=True, deep=True,
+                     include_rejected=True):
     """Sorted [(step, path)] of checkpoints under `root` (oldest first)."""
     out = []
     try:
@@ -132,23 +179,28 @@ def list_checkpoints(root, valid_only=True, deep=True):
             continue
         if valid_only and not validate(path, deep=deep):
             continue
+        if not include_rejected and is_rejected(path):
+            continue
         out.append((int(m.group(1)), path))
     out.sort()
     return out
 
 
-def latest(root, deep=True):
+def latest(root, deep=True, include_rejected=False):
     """Path of the newest VALID checkpoint under `root`, or None.
 
     Torn checkpoints — missing/corrupt manifest, truncated shard, bad
     checksum — are skipped, so resume always lands on the last write that
-    fully committed.
+    fully committed.  Canary-rejected checkpoints (see `stamp_rejected`)
+    are skipped by default: a version the serving fleet refused must not
+    come back through resume or replica boot.
     """
-    ckpts = list_checkpoints(root, valid_only=True, deep=deep)
+    ckpts = list_checkpoints(root, valid_only=True, deep=deep,
+                             include_rejected=include_rejected)
     return ckpts[-1][1] if ckpts else None
 
 
-def latest_healthy(root, max_step=None, deep=True):
+def latest_healthy(root, max_step=None, deep=True, exclude=None):
     """Path of the newest VALID checkpoint stamped healthy, or None.
 
     The training guardian (resilience/guardian.py) stamps every
@@ -158,10 +210,18 @@ def latest_healthy(root, max_step=None, deep=True):
     before the last known-good step — the newest checkpoint may already
     carry a loss spike's damage.  Manifests without a stamp (pre-
     guardian, foreign writers) count as healthy.
+
+    Canary-rejected checkpoints are always skipped.  ``exclude`` narrows
+    further: a callable(step)->bool, or a collection of steps/paths —
+    the train-to-serve publisher passes the registry's fence windows
+    here so a guardian-fenced step is never re-published.
     """
     for step, path in reversed(list_checkpoints(root, valid_only=True,
-                                                deep=deep)):
+                                                deep=deep,
+                                                include_rejected=False)):
         if max_step is not None and step > int(max_step):
+            continue
+        if _excluded(step, path, exclude):
             continue
         try:
             manifest = read_manifest(path)

@@ -318,6 +318,29 @@ KNOBS = {
                                     "marked dead at once, in-flight "
                                     "requests fail over, and the fleet "
                                     "backfills on surviving hosts"),
+    # -- continuous train-to-serve loop (loop/) ------------------------------
+    "MXNET_LOOP_PUBLISH_STEPS": (int, 100, "honored",
+                                 "trained steps between registry "
+                                 "publishes of the newest guardian-"
+                                 "healthy checkpoint (0 disables the "
+                                 "step cadence)"),
+    "MXNET_LOOP_PUBLISH_SECS": (float, 0.0, "honored",
+                                "wall-clock publish cadence in seconds "
+                                "(0 disables; combines with the step "
+                                "cadence — whichever fires first)"),
+    "MXNET_LOOP_CANARY_TOL": (float, 0.02, "honored",
+                              "canary gate tolerance: a candidate may "
+                              "score up to this much BELOW the "
+                              "incumbent on the pinned holdout and "
+                              "still promote; anything worse is "
+                              "rejected and stamped, never retried"),
+    "MXNET_LOOP_POLL_S": (float, 2.0, "honored",
+                          "LoopController registry poll interval"),
+    "MXNET_LOOP_FRESHNESS_SLO_S": (float, 600.0, "honored",
+                                   "freshness SLO: max acceptable "
+                                   "loop.freshness_lag_s (data-seen "
+                                   "watermark -> version live on the "
+                                   "fleet), gated in LOOP_REPORT.json"),
     # -- training guardian (resilience/guardian.py) --------------------------
     "MXNET_GUARDIAN": (_BOOL, True, "honored",
                        "training health guardian in Module.fit: in-graph "

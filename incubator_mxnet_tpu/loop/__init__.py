@@ -1,0 +1,33 @@
+"""Continuous train-to-serve loop (the closed production loop).
+
+Composes the subsystems that already exist — elastic checkpoints with
+guardian health stamps, the replica router's zero-compile rolling swap,
+the obs plane — into the loop production actually runs:
+
+* `ModelRegistry` (registry.py) — the versioned, atomic hand-off
+  directory between trainer and fleet; torn manifests invisible,
+  ``rejected`` stamps and guardian ``fence`` windows hide versions
+  permanently;
+* `CheckpointPublisher` (publisher.py) — rides `Module.fit`, publishes
+  guardian-healthy checkpoints on a cadence with a data-shard watermark
+  and fences rollback/divergence windows out of the registry;
+* `LoopController` (controller.py) — serving-side watcher: every new
+  version is canaried on ONE replica against a pinned holdout before
+  the rolling swap promotes it; failed canaries are swapped back,
+  stamped rejected, and surfaced as `CanaryRejectedError`.
+
+Freshness is measured end-to-end as ``loop.freshness_lag_s`` (data-seen
+watermark → serving-live) and gated in LOOP_REPORT.json
+(tools/run_loop_gate.py); the adversarial composition — poisoned shard,
+torn publish, failed canary, vanished registry — is certified by
+``tools/run_chaos.py --loop`` (CHAOS_LOOP.json).
+"""
+from __future__ import annotations
+
+from .registry import (ModelRegistry, RegistryUnavailableError,
+                       REGISTRY_FORMAT)
+from .publisher import CheckpointPublisher
+from .controller import CanaryRejectedError, LoopController
+
+__all__ = ["ModelRegistry", "RegistryUnavailableError", "REGISTRY_FORMAT",
+           "CheckpointPublisher", "LoopController", "CanaryRejectedError"]

@@ -413,7 +413,10 @@ class BaseModule:
                 else:
                     path = _ckpt.latest(checkpoint_dir)
                 ckpt_resume = _ckpt.load(path) if path is not None else None
-            elif _ckpt.latest(checkpoint_dir, deep=False) is not None:
+            elif _ckpt.latest(checkpoint_dir, deep=False,
+                              include_rejected=True) is not None:
+                # include_rejected: even a directory holding ONLY
+                # canary-rejected checkpoints belongs to some other run
                 # a fresh run must not share a directory with an old run's
                 # checkpoints: the old run's higher step numbers would win
                 # `latest()` after this run's first crash and resume would

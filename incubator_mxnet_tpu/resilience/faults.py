@@ -19,6 +19,7 @@ programmatic `inject()` API.  Spec grammar (clauses joined with ``;``)::
                  | heartbeat.send | collective.dispatch | host.step
                  | router.dispatch | replica.health | replica.swap
                  | grad.nonfinite | loss.spike | io.corrupt_record
+                 | publish.commit | canary.eval
     kind         = refuse | drop | slow | crash | torn | error | hang | kill
                  | corrupt
 
@@ -59,6 +60,16 @@ trigger); and ``io.corrupt_record`` fires per record read through the
 `mutate()` payload hook — a ``corrupt`` clause there bit-flips the
 record's bytes deterministically, so record-level corruption is
 injectable without hand-built fixture files.
+
+The train-to-serve loop sites (loop/): ``publish.commit`` fires once
+per registry publish — a ``torn`` clause there leaves a TRUNCATED
+version manifest under the final name (the publisher "died" mid-
+rename), which every registry reader must treat as invisible, and a
+``slow`` clause delays the publish (freshness-lag pressure);
+``canary.eval`` fires before each canary holdout evaluation — an
+``error`` there is a broken scoring path the controller must fail
+CLOSED (an unscorable candidate is a rejected one, never a promoted
+one), and ``slow`` models a canary that eats into the freshness SLO.
 
 The ``corrupt`` kind only fires through `mutate(site, payload)` (it
 needs bytes to damage); `fire()` ignores corrupt clauses entirely, so a

@@ -288,6 +288,10 @@ class TrainingGuardian:
         self._consecutive_failures = 0
         self._rollbacks = 0
         self.pending_rollback_step = None   # armed between request+restore
+        # (lo, hi) gstep window the newest rollback disowned — consumed
+        # by CheckpointPublisher to fence those versions out of the
+        # model registry (loop/publisher.py)
+        self.last_rollback_window = None
         self._shard_info = None  # last batch attribution (source, lo, hi)
         self._iterator = None
         self._allreduce = None   # kvstore reduction (multi-worker)
@@ -613,6 +617,8 @@ class TrainingGuardian:
                         reason=f"{self._rollbacks - 1} rollback(s) already "
                                "spent (MXNET_GUARDIAN_MAX_ROLLBACKS)")
                 self.pending_rollback_step = self._last_good_step
+                self.last_rollback_window = (
+                    self._last_good_step + 1, int(spike_step or gstep))
                 _record_event("rollback", step=spike_step or gstep,
                               last_good_step=self._last_good_step)
                 # the EWMA may be unset when a PEER diagnosed the spike

@@ -72,14 +72,15 @@ from .server import ModelServer
 from .metrics import ServingMetrics, LatencyReservoir
 from .replica import (Replica, LocalReplica, RemoteReplica,
                       ReplicaLostError)
-from .router import ReplicaRouter, PRIORITIES
+from .router import ReplicaRouter, SwapInProgressError, PRIORITIES
 from .fleet import (FleetManager, Autoscaler, ReplicaSpec, FleetHost,
                     InProcessHost, AgentHost)
 from .decode import DecodeEngine, DecodeReplica
 
 __all__ = ["ServedModel", "MicroBatcher", "ModelServer", "ServingMetrics",
            "LatencyReservoir", "Replica", "LocalReplica", "RemoteReplica",
-           "ReplicaLostError", "ReplicaRouter", "PRIORITIES",
+           "ReplicaLostError", "ReplicaRouter", "SwapInProgressError",
+           "PRIORITIES",
            "DEFAULT_BUCKETS", "FleetManager", "Autoscaler", "ReplicaSpec",
            "FleetHost", "InProcessHost", "AgentHost", "DecodeEngine",
            "DecodeReplica"]

@@ -61,7 +61,7 @@ from ..resilience import CircuitBreaker, faults as _faults
 from .metrics import ServingMetrics
 from .replica import ReplicaLostError
 
-__all__ = ["ReplicaRouter", "PRIORITIES"]
+__all__ = ["ReplicaRouter", "SwapInProgressError", "PRIORITIES"]
 
 PRIORITIES = ("interactive", "batch", "best_effort")
 # dispatch rank inside replica queues: interactive is served first even
@@ -69,6 +69,24 @@ PRIORITIES = ("interactive", "batch", "best_effort")
 PRIORITY_RANK = {"interactive": 0, "batch": 1, "best_effort": 2}
 
 HEALTHY, SUSPECT, SWAPPING, DEAD = "healthy", "suspect", "swapping", "dead"
+
+
+class SwapInProgressError(MXNetError):
+    """A weight swap is already rolling through this fleet.
+
+    Carries ``version`` — whatever label the in-flight swap was issued
+    under (the registry version for loop-driven swaps, the checkpoint
+    dir or ``"<params>"`` otherwise) — so a watcher like the
+    LoopController can log WHAT it is waiting behind and back off to its
+    next poll instead of treating the collision as a failed canary.
+    """
+
+    def __init__(self, router, version):
+        self.router = router
+        self.version = version
+        super().__init__(
+            f"router '{router}': a weight swap is already in progress "
+            f"(in-flight: {version!r})")
 
 
 class _Slot:
@@ -154,6 +172,7 @@ class ReplicaRouter:
         import uuid
         self._rid_ns = uuid.uuid4().hex[:8]
         self._swap_lock = _locks.make_lock("serving.router.swap")
+        self._swap_inflight = None   # label of the swap holding the lock
         self._closed = threading.Event()
         _tsan.instrument(self, f"serving.router[{self.name}]")
         # fleet counters
@@ -198,6 +217,17 @@ class ReplicaRouter:
     def replicas(self):
         with self._lock:
             return sorted(self._slots)
+
+    def replica(self, replica_id):
+        """The live `Replica` handle for `replica_id` — the loop
+        controller scores its canary through this, on the same
+        submit path real traffic uses."""
+        with self._lock:
+            slot = self._slots.get(replica_id)
+            if slot is None or slot.state == DEAD:
+                raise MXNetError(f"router '{self.name}': no live replica "
+                                 f"{replica_id!r}")
+            return slot.replica
 
     # -- dispatch -------------------------------------------------------------
     def _eligible_locked(self):
@@ -509,8 +539,70 @@ class ReplicaRouter:
                     self._on_replica_lost(slot)
 
     # -- hot weight swap ------------------------------------------------------
+    def _acquire_swap(self, version):
+        """Take the fleet-wide swap lock (non-blocking) and record what
+        is rolling, so a collision can name the in-flight swap."""
+        if not self._swap_lock.acquire(blocking=False):
+            with self._lock:
+                inflight = self._swap_inflight
+            raise SwapInProgressError(self.name, inflight)
+        with self._lock:
+            self._swap_inflight = version
+
+    def _release_swap(self):
+        with self._lock:
+            self._swap_inflight = None
+        self._swap_lock.release()
+
+    def _swap_slot(self, slot, arg_params, aux_params, checkpoint_dir,
+                   drain_timeout_s):
+        """Drain + swap + deepcheck ONE slot (caller holds the swap
+        lock).  Returns None on success, else the failure exception —
+        with the slot's state already restored (or the slot declared
+        lost on `ReplicaLostError`)."""
+        replica = slot.replica
+        with self._lock:
+            if slot.state == DEAD:
+                return ReplicaLostError(replica.replica_id, None,
+                                        "replica died before its swap")
+            slot.state = SWAPPING
+        try:
+            deadline = self._clock() + float(drain_timeout_s)
+            # drain BOTH the replica's queue and any dispatch
+            # already claimed before the state flipped to
+            # SWAPPING (the fence `_dispatch` increments under
+            # the lock) — nothing may start executing while
+            # parameters are being replaced
+            while (replica.outstanding() or slot.dispatching) \
+                    and self._clock() < deadline:
+                time.sleep(0.002)
+            if replica.outstanding() or slot.dispatching:
+                raise MXNetError(
+                    f"replica '{replica.replica_id}' did not "
+                    f"drain within {drain_timeout_s:g}s")
+            _faults.fire("replica.swap",
+                         replica=replica.replica_id,
+                         version=replica.version + 1)
+            replica.swap(arg_params=arg_params,
+                         aux_params=aux_params,
+                         checkpoint_dir=checkpoint_dir)
+            replica.probe()   # deepcheck before rejoining
+        except ReplicaLostError as exc:
+            self._on_replica_lost(slot)
+            return exc
+        except Exception as exc:
+            with self._lock:
+                if slot.state == SWAPPING:
+                    slot.state = HEALTHY
+            return exc
+        with self._lock:
+            if slot.state == SWAPPING:
+                slot.state = HEALTHY
+            slot.last_ok = self._clock()
+        return None
+
     def swap_weights(self, checkpoint_dir=None, arg_params=None,
-                     aux_params=None, drain_timeout_s=60.0):
+                     aux_params=None, drain_timeout_s=60.0, version=None):
         """Roll new weights through the fleet, one replica at a time.
 
         Each replica: out of rotation -> drain in-flight -> swap (zero
@@ -521,57 +613,24 @@ class ReplicaRouter:
         structured error naming swapped vs unswapped replicas — the
         fleet keeps serving (briefly mixed-version across REPLICAS,
         never within a request); re-issue to finish the roll.
+
+        ``version`` is an optional label for this roll (the registry
+        version when the loop controller drives it); a concurrent swap
+        attempt fails with `SwapInProgressError` naming it.
         """
-        if not self._swap_lock.acquire(blocking=False):
-            raise MXNetError(
-                f"router '{self.name}': a weight swap is already in "
-                "progress")
+        self._acquire_swap(version if version is not None
+                           else (checkpoint_dir or "<params>"))
         try:
             with self._lock:
                 order = [s for s in self._slots.values() if s.state != DEAD]
             swapped, failed = [], None
             for slot in order:
-                replica = slot.replica
-                with self._lock:
-                    if slot.state == DEAD:
-                        continue
-                    slot.state = SWAPPING
-                try:
-                    deadline = self._clock() + float(drain_timeout_s)
-                    # drain BOTH the replica's queue and any dispatch
-                    # already claimed before the state flipped to
-                    # SWAPPING (the fence `_dispatch` increments under
-                    # the lock) — nothing may start executing while
-                    # parameters are being replaced
-                    while (replica.outstanding() or slot.dispatching) \
-                            and self._clock() < deadline:
-                        time.sleep(0.002)
-                    if replica.outstanding() or slot.dispatching:
-                        raise MXNetError(
-                            f"replica '{replica.replica_id}' did not "
-                            f"drain within {drain_timeout_s:g}s")
-                    _faults.fire("replica.swap",
-                                 replica=replica.replica_id,
-                                 version=replica.version + 1)
-                    replica.swap(arg_params=arg_params,
-                                 aux_params=aux_params,
-                                 checkpoint_dir=checkpoint_dir)
-                    replica.probe()   # deepcheck before rejoining
-                except ReplicaLostError as exc:
-                    self._on_replica_lost(slot)
-                    failed = (replica.replica_id, exc)
+                exc = self._swap_slot(slot, arg_params, aux_params,
+                                      checkpoint_dir, drain_timeout_s)
+                if exc is not None:
+                    failed = (slot.replica.replica_id, exc)
                     break
-                except Exception as exc:
-                    with self._lock:
-                        if slot.state == SWAPPING:
-                            slot.state = HEALTHY
-                    failed = (replica.replica_id, exc)
-                    break
-                with self._lock:
-                    if slot.state == SWAPPING:
-                        slot.state = HEALTHY
-                    slot.last_ok = self._clock()
-                swapped.append(replica.replica_id)
+                swapped.append(slot.replica.replica_id)
             if failed is not None:
                 rid, exc = failed
                 remaining = [s.replica.replica_id for s in order
@@ -591,7 +650,48 @@ class ReplicaRouter:
                     "versions": {s.replica.replica_id: s.replica.version
                                  for s in order}}
         finally:
-            self._swap_lock.release()
+            self._release_swap()
+
+    def swap_one(self, replica_id=None, checkpoint_dir=None,
+                 arg_params=None, aux_params=None, drain_timeout_s=60.0,
+                 version=None):
+        """Swap exactly ONE replica — the canary leg of the loop gate.
+
+        Same drain/swap/deepcheck discipline as `swap_weights`, scoped
+        to a single replica (`replica_id`, or the first live one); the
+        rest of the fleet serves the incumbent throughout.  Holds the
+        same fleet-wide swap lock, so a canary and a rolling swap can
+        never interleave; a collision raises `SwapInProgressError`.
+        """
+        self._acquire_swap(version if version is not None
+                           else (checkpoint_dir or "<params>"))
+        try:
+            with self._lock:
+                if replica_id is not None:
+                    slot = self._slots.get(replica_id)
+                    if slot is None or slot.state == DEAD:
+                        raise MXNetError(
+                            f"router '{self.name}': no live replica "
+                            f"{replica_id!r} to swap")
+                else:
+                    slot = next((s for s in self._slots.values()
+                                 if s.state == HEALTHY), None)
+                    if slot is None:
+                        raise MXNetError(
+                            f"router '{self.name}': no healthy replica "
+                            "to swap")
+            exc = self._swap_slot(slot, arg_params, aux_params,
+                                  checkpoint_dir, drain_timeout_s)
+            if exc is not None:
+                raise MXNetError(
+                    f"router '{self.name}': swap of replica "
+                    f"'{slot.replica.replica_id}' failed: {exc} — the "
+                    "rest of the fleet keeps serving the incumbent") \
+                    from exc
+            return {"swapped": [slot.replica.replica_id],
+                    "version": slot.replica.version}
+        finally:
+            self._release_swap()
 
     # -- observability / lifecycle -------------------------------------------
     def stats(self):

@@ -738,6 +738,9 @@ _SUPPRESSION_FIXTURES = {
     "fixed-fleet": (
         "r = ReplicaRouter([LocalReplica(), LocalReplica()])\n"
         "m = FleetManager(r)\n", 1),
+    "unguarded-model-swap": (
+        "c = LoopController(router, registry, holdout)\n"
+        "router.swap_weights(checkpoint_dir=ck)\n", 2),
     "unnamed-thread": (
         "import threading\n"
         "t = threading.Thread(target=f)\n", 2),

@@ -1,0 +1,613 @@
+"""Continuous train-to-serve loop (the ISSUE-20 acceptance gates).
+
+Covers: registry semantics (atomic versioned publishes, torn manifests
+invisible to watchers, ordering under concurrent publishes, rejected-
+stamp idempotence, structured error when the registry directory
+disappears mid-poll), the publisher's cadence / suspect filter /
+guardian-rollback fencing / torn-publish retry, the checkpoint-level
+rejected stamp surviving a process restart, the router's structured
+`SwapInProgressError` + single-replica `swap_one`, the LoopController's
+canary gate (promote on match, reject + swap-back + stamp on a poisoned
+candidate, fail-closed on an unscorable canary, back-off on a busy
+swap, keep-serving on a vanished registry), the `publish.commit` /
+`canary.eval` fault sites' seeded determinism, freshness-lag metrics in
+the obs plane, and the `unguarded-model-swap` source lint.
+"""
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import analysis, checkpoint as ckpt, sym
+from incubator_mxnet_tpu.base import MXNetError
+from incubator_mxnet_tpu.loop import (CanaryRejectedError,
+                                      CheckpointPublisher, LoopController,
+                                      ModelRegistry,
+                                      RegistryUnavailableError)
+from incubator_mxnet_tpu.obs import metrics as obs_metrics
+from incubator_mxnet_tpu.resilience import faults
+from incubator_mxnet_tpu.serving import (LocalReplica, ReplicaRouter,
+                                         SwapInProgressError)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# fixtures: a 4-class model whose holdout score is fully deterministic —
+# identity weights classify one-hot rows perfectly (accuracy 1.0), the
+# "poisoned" negated weights misclassify every row (accuracy 0.0)
+# ---------------------------------------------------------------------------
+
+IDENT = np.eye(4, dtype=np.float32)
+HOLDOUT = ({"data": IDENT}, np.arange(4))
+
+
+def _net():
+    net = sym.Variable("data")
+    net = sym.FullyConnected(net, num_hidden=4, no_bias=True, name="fc")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _served(weight, name="m", buckets=(1, 2, 4)):
+    args = {"fc_weight": mx.nd.array(np.asarray(weight, np.float32))}
+    return mx.serving.ServedModel(_net(), args, {},
+                                  data_shapes=[("data", (1, 4))],
+                                  buckets=buckets, ctx=mx.cpu(), name=name)
+
+
+def _fleet(n=2, weight=IDENT):
+    reps = [LocalReplica(_served(weight, name=f"m{i}"), replica_id=f"r{i}")
+            for i in range(n)]
+    return ReplicaRouter(reps, name="loop-test", health_interval_s=5.0)
+
+
+def _write_ckpt(root, weight, step, health="healthy"):
+    """One elastic checkpoint holding `weight`, guardian-stamped."""
+    mgr = ckpt.CheckpointManager(str(root), keep_last=64)
+    mgr.snapshot(arrays={"arg:fc_weight": np.asarray(weight, np.float32)},
+                 step=step, epoch=0, nbatch=step,
+                 meta={"health": {"status": health}}, sync=True)
+    mgr.close()
+    return os.path.join(str(root), "ckpt-%010d" % step)
+
+
+def _publish(registry, path, step, score=None):
+    return registry.publish(path, step=step,
+                            health={"status": "healthy"},
+                            watermark={"step": step, "time": time.time()},
+                            score=score)
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+def test_registry_publish_and_latest(tmp_path):
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    _publish(reg, "/ck/a", 3, score=0.9)
+    _publish(reg, "/ck/b", 7)
+    recs = reg.versions()
+    assert [r["version"] for r in recs] == [3, 7]
+    top = reg.latest()
+    assert top["version"] == 7 and top["checkpoint"] == "/ck/b"
+    assert top["health"]["status"] == "healthy"
+    assert "time" in top["watermark"]
+    assert reg.get(3)["score"] == 0.9
+    assert reg.stats()["latest_version"] == 7
+
+
+def test_registry_pin_survives_trainer_retention(tmp_path):
+    """publish(pin=True) hardlinks the checkpoint into the registry's
+    own blobs/ tier, so the published version stays loadable after the
+    trainer's keep_last retention prunes the source ckpt directory."""
+    import shutil
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    src = _write_ckpt(tmp_path / "ck", IDENT * 3.0, 5)
+    rec = reg.publish(src, step=5, health={"status": "healthy"}, pin=True)
+    pinned = rec["checkpoint"]
+    assert pinned == os.path.join(str(tmp_path / "reg"), "blobs",
+                                  "v-0000000005")
+    assert reg.latest()["checkpoint"] == pinned
+    # idempotent: re-publishing the same step reuses the existing pin
+    assert reg.publish(src, step=5, pin=True)["checkpoint"] == pinned
+    shutil.rmtree(src)                    # trainer retention prunes it
+    data = ckpt.load(pinned)
+    assert np.allclose(np.asarray(data.arrays["arg:fc_weight"]),
+                       IDENT * 3.0)
+
+
+def test_registry_torn_manifest_invisible(tmp_path):
+    """A torn/unstamped version manifest is counted, never surfaced."""
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    _publish(reg, "/ck/a", 1)
+    # torn: truncated JSON under the final name
+    with open(os.path.join(reg.root, "v-0000000002.json"), "w") as f:
+        f.write('{"format": "incubator_mxnet_tpu.registry/1", "vers')
+    # unstamped: parses, but carries no format stamp
+    with open(os.path.join(reg.root, "v-0000000003.json"), "w") as f:
+        f.write('{"version": 3, "checkpoint": "/ck/evil"}')
+    assert [r["version"] for r in reg.versions()] == [1]
+    assert reg.latest()["version"] == 1
+    assert reg.stats()["torn_manifests"] == 2
+
+
+def test_registry_ordering_under_concurrent_publishes(tmp_path):
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    steps = list(range(1, 9))
+    threads = [threading.Thread(target=_publish, name=f"mx-test-pub-{s}",
+                                args=(reg, f"/ck/{s}", s))
+               for s in steps]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert [r["version"] for r in reg.versions()] == steps
+    assert reg.latest()["version"] == 8
+
+
+def test_registry_reject_idempotent(tmp_path):
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    _publish(reg, "/ck/a", 1)
+    _publish(reg, "/ck/b", 2)
+    first = reg.reject(2, reason="canary", canary_score=0.1)
+    again = reg.reject(2, reason="something-else", canary_score=0.99)
+    assert again["reason"] == "canary" and again["canary_score"] == 0.1
+    assert first["rejected_unix"] == again["rejected_unix"]
+    assert reg.latest()["version"] == 1
+    rec = reg.versions(include_rejected=True)[-1]
+    assert rec["version"] == 2 and rec["rejected"]
+    # a second registry handle (restart) still sees the stamp
+    assert ModelRegistry(reg.root).rejected(2)["reason"] == "canary"
+
+
+def test_registry_fence_hides_window(tmp_path):
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    for s in (2, 6, 11):
+        _publish(reg, f"/ck/{s}", s)
+    reg.fence(5, 10, reason="guardian-rollback")
+    assert [r["version"] for r in reg.versions()] == [2, 11]
+    assert reg.fenced(6) and not reg.fenced(11)
+    assert reg.get(6)["fenced"]
+    # fences persist across a new handle (restart)
+    assert ModelRegistry(reg.root).fences() == [(5, 10)]
+
+
+def test_registry_dir_disappears_structured_error(tmp_path):
+    import shutil
+    root = str(tmp_path / "reg")
+    reg = ModelRegistry(root)
+    _publish(reg, "/ck/a", 1)
+    shutil.rmtree(root)
+    with pytest.raises(RegistryUnavailableError) as ei:
+        reg.versions()
+    assert ei.value.root == root
+    with pytest.raises(RegistryUnavailableError):
+        _publish(reg, "/ck/b", 2)
+
+
+# ---------------------------------------------------------------------------
+# fault sites: publish.commit / canary.eval (seeded determinism)
+# ---------------------------------------------------------------------------
+
+def test_publish_commit_torn_fault_and_retry(tmp_path):
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    faults.configure("seed=3;publish.commit:torn(at=2)")
+    committed = []
+    for step in (1, 2, 3):
+        try:
+            _publish(reg, f"/ck/{step}", step)
+            committed.append(step)
+        except faults.TornWrite:
+            pass
+    assert committed == [1, 3]
+    # the torn manifest sits on disk under the FINAL name yet is invisible
+    assert os.path.exists(os.path.join(reg.root, "v-0000000002.json"))
+    assert [r["version"] for r in reg.versions()] == [1, 3]
+    assert reg.stats()["torn_manifests"] == 1
+    # a clean re-publish atomically replaces the torn garbage
+    faults.clear()
+    _publish(reg, "/ck/2", 2)
+    assert [r["version"] for r in reg.versions()] == [1, 2, 3]
+
+
+def test_publish_commit_seeded_schedule_is_deterministic(tmp_path):
+    def run():
+        reg = ModelRegistry(str(tmp_path / f"reg-{time.monotonic_ns()}"))
+        faults.configure("seed=11;publish.commit:error(p=0.4)")
+        pattern = []
+        for step in range(1, 21):
+            try:
+                _publish(reg, f"/ck/{step}", step)
+                pattern.append(True)
+            except MXNetError:
+                pattern.append(False)
+        faults.clear()
+        return pattern
+    first, second = run(), run()
+    assert first == second
+    assert False in first and True in first
+
+
+def test_canary_eval_seeded_schedule_is_deterministic():
+    def run():
+        faults.configure("seed=17;canary.eval:error(p=0.5)")
+        pattern = []
+        for i in range(20):
+            try:
+                faults.fire("canary.eval", version=i, phase="canary")
+                pattern.append(True)
+            except MXNetError:
+                pattern.append(False)
+        faults.clear()
+        return pattern
+    first, second = run(), run()
+    assert first == second
+    assert False in first and True in first
+
+
+# ---------------------------------------------------------------------------
+# checkpoint satellite: rejected stamps + exclude=
+# ---------------------------------------------------------------------------
+
+def test_latest_healthy_exclude_filters(tmp_path):
+    paths = {s: _write_ckpt(tmp_path, IDENT * s, s) for s in (1, 2, 3)}
+    man = ckpt.manifest
+    assert man.latest_healthy(str(tmp_path)) == paths[3]
+    assert man.latest_healthy(str(tmp_path), exclude={3}) == paths[2]
+    assert man.latest_healthy(str(tmp_path), exclude={paths[3]}) == paths[2]
+    assert man.latest_healthy(str(tmp_path),
+                              exclude=lambda s: s >= 2) == paths[1]
+
+
+def test_rejected_stamp_never_selected_and_survives_restart(tmp_path):
+    good = _write_ckpt(tmp_path, IDENT, 1)
+    bad = _write_ckpt(tmp_path, -IDENT, 2)
+    stamp = ckpt.stamp_rejected(bad, reason="canary", canary_score=0.0)
+    assert stamp["reason"] == "canary"
+    # idempotent: a re-stamp keeps the original evidence
+    assert ckpt.stamp_rejected(bad, reason="other")["reason"] == "canary"
+    assert ckpt.is_rejected(bad) and not ckpt.is_rejected(good)
+    assert ckpt.latest(str(tmp_path)) == good
+    assert ckpt.manifest.latest_healthy(str(tmp_path)) == good
+    assert ckpt.latest(str(tmp_path), include_rejected=True) == bad
+    # the fence holds in a FRESH process: resume/serving there must make
+    # the same choice from nothing but the on-disk state
+    code = ("import incubator_mxnet_tpu as mx\n"
+            "print(mx.checkpoint.latest(%r))\n"
+            "print(mx.checkpoint.latest_healthy(%r))\n"
+            % (str(tmp_path), str(tmp_path)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=180)
+    assert out.returncode == 0, out.stderr
+    lines = out.stdout.strip().splitlines()
+    assert lines == [good, good]
+
+
+# ---------------------------------------------------------------------------
+# router satellite: SwapInProgressError + swap_one
+# ---------------------------------------------------------------------------
+
+def test_swap_busy_raises_structured_error(tmp_path):
+    router = _fleet(1)
+    try:
+        router._acquire_swap(42)
+        with pytest.raises(SwapInProgressError) as ei:
+            router.swap_weights(checkpoint_dir="/nowhere")
+        assert ei.value.version == 42 and "42" in str(ei.value)
+        with pytest.raises(SwapInProgressError) as ei:
+            router.swap_one(checkpoint_dir="/nowhere")
+        assert ei.value.version == 42
+        router._release_swap()
+        assert isinstance(ei.value, MXNetError)
+    finally:
+        router.shutdown()
+
+
+def test_swap_one_touches_exactly_one_replica(tmp_path):
+    router = _fleet(2)
+    try:
+        ck = _write_ckpt(tmp_path, IDENT * 2.0, 1)
+        out = router.swap_one("r1", checkpoint_dir=ck, version=1)
+        assert out == {"swapped": ["r1"], "version": 1}
+        versions = {rid: s["version"]
+                    for rid, s in router.stats()["replicas"].items()}
+        assert versions == {"r0": 0, "r1": 1}
+        assert router._swap_inflight is None   # lock released
+    finally:
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# publisher
+# ---------------------------------------------------------------------------
+
+def test_publisher_cadence_and_watermark(tmp_path):
+    ck_root = tmp_path / "ck"
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    _write_ckpt(ck_root, IDENT, 2)
+    pub = CheckpointPublisher(reg, str(ck_root), publish_steps=4,
+                              publish_secs=0)
+    for step in range(3):
+        pub.poll(step)
+    assert reg.latest() is None           # cadence not reached
+    pub.poll(3)                           # 4 steps seen -> publish
+    rec = reg.latest()
+    assert rec["version"] == 2
+    wm = rec["watermark"]
+    assert wm["step"] == 2 and wm["nbatch"] == 2 and wm["time"] > 0
+    for step in range(4, 7):
+        pub.poll(step)                    # nothing new to publish
+    assert pub.stats()["published"] == 1
+    _write_ckpt(ck_root, IDENT, 6)
+    pub.poll(7)                           # next cadence tick
+    assert reg.latest()["version"] == 6
+    assert pub.stats()["published"] == 2
+
+
+def test_publisher_never_publishes_suspect_checkpoints(tmp_path):
+    ck_root = tmp_path / "ck"
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    _write_ckpt(ck_root, IDENT, 2, health="healthy")
+    _write_ckpt(ck_root, -IDENT, 4, health="suspect")
+    pub = CheckpointPublisher(reg, str(ck_root), publish_steps=1,
+                              publish_secs=0)
+    pub.poll(5)
+    assert reg.latest()["version"] == 2   # the suspect step 4 passed over
+
+
+def test_publisher_fences_rollback_window(tmp_path):
+    """A step regression across callbacks == a guardian rollback: the
+    disowned window is fenced, and a fenced checkpoint can never be
+    re-published afterwards."""
+    ck_root = tmp_path / "ck"
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    pub = CheckpointPublisher(reg, str(ck_root), publish_steps=100,
+                              publish_secs=0)
+    pub.poll(10)
+    pub.poll(4)                           # regression -> fence (5..10)
+    assert reg.fences() == [(5, 10)]
+    assert pub.stats()["fences"] == 1
+    # step 7 lands INSIDE the fenced window: healthy stamp or not, the
+    # publisher must never hand it to the fleet
+    _write_ckpt(ck_root, -IDENT, 7)
+    pub2 = CheckpointPublisher(reg, str(ck_root), publish_steps=1,
+                               publish_secs=0)
+    pub2.poll(20)
+    assert reg.latest() is None
+    _write_ckpt(ck_root, IDENT, 20)
+    pub2.poll(21)
+    assert reg.latest()["version"] == 20  # clean step sails through
+
+
+def test_publisher_retries_after_torn_publish(tmp_path):
+    ck_root = tmp_path / "ck"
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    _write_ckpt(ck_root, IDENT, 2)
+    pub = CheckpointPublisher(reg, str(ck_root), publish_steps=2,
+                              publish_secs=0)
+    faults.configure("seed=5;publish.commit:torn(at=1)")
+    pub.poll(1)                           # cadence fires, publish torn
+    assert pub.stats()["torn_publishes"] == 1
+    assert reg.latest() is None           # torn manifest invisible
+    pub.poll(2)                           # fault exhausted -> clean retry
+    assert reg.latest()["version"] == 2
+
+
+# ---------------------------------------------------------------------------
+# controller: the canary gate
+# ---------------------------------------------------------------------------
+
+def _loop_rig(tmp_path, n=2):
+    ck_root = tmp_path / "ck"
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    boot = _write_ckpt(ck_root, IDENT, 1)
+    router = _fleet(n)
+    ctrl = LoopController(router, reg, HOLDOUT, canary_tol=0.25,
+                          poll_interval_s=0.05, freshness_slo_s=120.0,
+                          incumbent_checkpoint=boot)
+    return ck_root, reg, router, ctrl, boot
+
+
+def test_canary_promotes_matching_version_and_measures_freshness(tmp_path):
+    ck_root, reg, router, ctrl, boot = _loop_rig(tmp_path)
+    try:
+        assert ctrl.poll_once()["status"] == "idle"
+        ck2 = _write_ckpt(ck_root, IDENT, 2)   # same weights: must match
+        _publish(reg, ck2, 2)
+        res = ctrl.poll_once()
+        assert res["status"] == "promoted" and res["version"] == 2
+        assert res["canary_score"] == pytest.approx(1.0)
+        assert res["incumbent_score"] == pytest.approx(1.0)
+        assert 0.0 <= res["freshness_lag_s"] < 60.0
+        versions = {rid: s["version"]
+                    for rid, s in router.stats()["replicas"].items()}
+        assert all(v >= 1 for v in versions.values())   # whole fleet rolled
+        # the loop namespace reaches the scrape plane
+        snap = obs_metrics.registry().collect()
+        assert snap.get("loop.freshness_lag_s") == \
+            pytest.approx(res["freshness_lag_s"])
+        assert snap.get("loop.promotions") == 1
+        assert snap.get("loop.freshness_slo_met") == 1
+        # re-poll: same version is not re-canaried
+        assert ctrl.poll_once()["status"] == "idle"
+    finally:
+        router.shutdown()
+
+
+def test_canary_rejects_poisoned_version(tmp_path):
+    ck_root, reg, router, ctrl, boot = _loop_rig(tmp_path)
+    try:
+        _publish(reg, _write_ckpt(ck_root, IDENT, 2), 2)
+        assert ctrl.poll_once()["status"] == "promoted"
+        poisoned = _write_ckpt(ck_root, -IDENT, 3)   # accuracy 0.0
+        _publish(reg, poisoned, 3)
+        with pytest.raises(CanaryRejectedError) as ei:
+            ctrl.poll_once()
+        err = ei.value
+        assert err.version == 3
+        assert err.canary_score == pytest.approx(0.0)
+        assert err.incumbent_score == pytest.approx(1.0)
+        # the registry stamp is durable and the version disappears
+        assert reg.rejected(3)["canary_score"] == pytest.approx(0.0)
+        assert reg.latest()["version"] == 2
+        # the checkpoint itself is fenced for resume/boot too
+        assert ckpt.is_rejected(poisoned)
+        # the canary replica is BACK on the incumbent: the fleet still
+        # classifies perfectly through the real request path
+        out = router.predict({"data": IDENT}, timeout_ms=10000)
+        first = out[0] if isinstance(out, (list, tuple)) else out
+        first = np.asarray(first.asnumpy() if hasattr(first, "asnumpy")
+                           else first)
+        assert (first.argmax(axis=-1) == np.arange(4)).all()
+        # never retried: the rejected version is invisible from now on
+        assert ctrl.poll_once()["status"] == "idle"
+        assert ctrl.stats()["canary_rejections"] == 1
+    finally:
+        router.shutdown()
+
+
+def test_canary_eval_failure_fails_closed(tmp_path):
+    """`canary.eval:error` on the CANDIDATE eval: a model that cannot be
+    scored is rejected, never promoted."""
+    ck_root, reg, router, ctrl, boot = _loop_rig(tmp_path)
+    try:
+        ck2 = _write_ckpt(ck_root, IDENT, 2)     # a GOOD candidate
+        _publish(reg, ck2, 2)
+        # hit 1 = incumbent eval (passes), hit 2 = candidate eval (fails)
+        faults.configure("seed=7;canary.eval:error(at=2)")
+        with pytest.raises(CanaryRejectedError) as ei:
+            ctrl.poll_once()
+        assert ei.value.canary_score == float("-inf")
+        assert reg.rejected(2) is not None
+        assert ctrl.stats()["eval_failures"] == 1
+    finally:
+        router.shutdown()
+
+
+def test_controller_survives_replica_lost_mid_swap(tmp_path):
+    """A replica dying mid-canary must not crash the watch loop: the
+    router's swap contract keeps the fleet serving, the controller
+    returns a structured ``swap-failed``, and the SAME candidate is
+    retried — and promoted — on the next poll."""
+    ck_root, reg, router, ctrl, boot = _loop_rig(tmp_path)
+    try:
+        _publish(reg, _write_ckpt(ck_root, IDENT, 2), 2)
+        canary_rid = ctrl._pick_canary()[0]
+        rep = router.replica(canary_rid)
+        real_swap, hits = rep.swap, []
+
+        def dying_swap(*a, **kw):
+            if not hits:
+                hits.append(1)
+                from incubator_mxnet_tpu.serving import ReplicaLostError
+                raise ReplicaLostError(canary_rid,
+                                       reason="killed mid-swap")
+            return real_swap(*a, **kw)
+
+        rep.swap = dying_swap
+        res = ctrl.poll_once()
+        assert res["status"] == "swap-failed" and res["candidate"] == 2
+        assert "lost" in res["error"]
+        assert ctrl.stats()["swap_failures"] == 1
+        assert ctrl.stats()["live_version"] == -1   # never advanced
+        # the incumbent kept serving through the failed swap
+        out = router.predict({"data": IDENT}, timeout_ms=10000)
+        first = out[0] if isinstance(out, (list, tuple)) else out
+        first = np.asarray(first.asnumpy() if hasattr(first, "asnumpy")
+                           else first)
+        assert (first.argmax(axis=-1) == np.arange(4)).all()
+        # candidate still eligible: the retry promotes it
+        assert ctrl.poll_once()["status"] == "promoted"
+        assert router._swap_inflight is None        # lock released
+    finally:
+        router.shutdown()
+
+
+def test_controller_backs_off_while_swap_in_progress(tmp_path):
+    ck_root, reg, router, ctrl, boot = _loop_rig(tmp_path)
+    try:
+        _publish(reg, _write_ckpt(ck_root, IDENT, 2), 2)
+        router._acquire_swap("operator-roll")
+        res = ctrl.poll_once()
+        assert res["status"] == "swap-busy"
+        assert res["in_flight"] == "operator-roll"
+        assert reg.rejected(2) is None           # NOT a failed canary
+        router._release_swap()
+        assert ctrl.poll_once()["status"] == "promoted"
+        assert ctrl.stats()["swap_busy"] == 1
+    finally:
+        router.shutdown()
+
+
+def test_controller_keeps_serving_when_registry_vanishes(tmp_path):
+    import shutil
+    ck_root, reg, router, ctrl, boot = _loop_rig(tmp_path)
+    try:
+        _publish(reg, _write_ckpt(ck_root, IDENT, 2), 2)
+        assert ctrl.poll_once()["status"] == "promoted"
+        shutil.rmtree(reg.root)
+        res = ctrl.poll_once()
+        assert res["status"] == "registry-unavailable"
+        assert ctrl.stats()["registry_errors"] == 1
+        assert ctrl.stats()["live_version"] == 2   # incumbent stays live
+        out = router.predict({"data": IDENT[:2]}, timeout_ms=10000)
+        first = out[0] if isinstance(out, (list, tuple)) else out
+        first = np.asarray(first.asnumpy() if hasattr(first, "asnumpy")
+                           else first)
+        assert (first.argmax(axis=-1) == np.arange(2)).all()
+    finally:
+        router.shutdown()
+
+
+def test_controller_background_thread_promotes(tmp_path):
+    ck_root, reg, router, ctrl, boot = _loop_rig(tmp_path)
+    try:
+        ctrl.start()
+        _publish(reg, _write_ckpt(ck_root, IDENT, 2), 2)
+        deadline = time.monotonic() + 30.0
+        while ctrl.stats()["live_version"] != 2 and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert ctrl.stats()["live_version"] == 2
+    finally:
+        ctrl.stop()
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# knobs + lint
+# ---------------------------------------------------------------------------
+
+def test_loop_knobs_registered():
+    from incubator_mxnet_tpu.config import KNOBS
+    for name in ("MXNET_LOOP_PUBLISH_STEPS", "MXNET_LOOP_PUBLISH_SECS",
+                 "MXNET_LOOP_CANARY_TOL", "MXNET_LOOP_POLL_S",
+                 "MXNET_LOOP_FRESHNESS_SLO_S"):
+        assert name in KNOBS
+        assert KNOBS[name][2] == "honored"
+        assert mx.config.get(name) == KNOBS[name][1]
+
+
+def test_unguarded_model_swap_lint():
+    guarded = ("ctrl = LoopController(router, registry, holdout)\n"
+               "router.swap_weights(checkpoint_dir=ck)\n"
+               "replica.swap(checkpoint_dir=ck)\n")
+    report = analysis.check_source(guarded, filename="s.py")
+    hits = [f for f in report if f.code == "unguarded-model-swap"]
+    assert sorted(f.location for f in hits) == ["s.py:2", "s.py:3"]
+    # no LoopController in the script -> swapping directly is the
+    # caller's explicit choice, not a bypass: no finding
+    bare = "router.swap_weights(checkpoint_dir=ck)\n"
+    assert not [f for f in analysis.check_source(bare)
+                if f.code == "unguarded-model-swap"]

@@ -1,0 +1,272 @@
+#!/usr/bin/env python
+"""Real-process trainer for the train-to-serve loop chaos schedules.
+
+Trains the same tiny MLP the serving fleet boots with (fc0 64-tanh ->
+head 8 -> softmax over 16 features), reading its shard through
+`MXRecordIO` — so a configured ``io.corrupt_record`` fault clause
+damages REAL record bytes in flight, exactly like a flaky disk — and
+publishes guardian-healthy elastic checkpoints into a shared
+`ModelRegistry` via `CheckpointPublisher`.  The chaos driver
+(run_chaos.py --loop) SIGKILLs, sabotages, and watches this process
+from the serving side; the exit report JSON carries the trainer-side
+half of the certification (corrupt records detected, guardian
+rollbacks, registry fences).
+
+Record format: recordio.pack(IRHeader(0, label, id, 0),
+16 float32 features + crc32(features || label || id)).  The crc makes
+seeded payload corruption (faults.mutate bit-flips) detectable even
+when the recordio framing survives: a damaged record is counted,
+skipped, and training continues — the io tier's substitute-and-count
+contract.
+
+Usage::
+
+    python tools/loop_trainer.py --registry DIR --ckpt DIR \
+        --rec shard.rec --report out.json [--num-epoch 3] \
+        [--publish-steps 4] [--checkpoint-period 2]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import struct
+import sys
+import zlib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+N_FEAT = 16
+N_CLASS = 8
+_PAYLOAD = struct.Struct("<%df" % N_FEAT)
+_CRC = struct.Struct("<I")
+
+
+def _crc(features_bytes, label, rec_id):
+    return zlib.crc32(features_bytes + struct.pack("<fI", float(label),
+                                                   int(rec_id)))
+
+
+def write_shard(path, n=96, seed=11):
+    """A learnable shard: class k spikes feature 2k, so a small MLP
+    separates the 8 classes in a couple of epochs."""
+    import numpy as np
+    from incubator_mxnet_tpu import recordio
+    rng = np.random.RandomState(seed)
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(n):
+        label = i % N_CLASS
+        x = (rng.standard_normal(N_FEAT) * 0.1).astype(np.float32)
+        x[label * 2] += 2.0
+        body = _PAYLOAD.pack(*x.tolist())
+        w.write(recordio.pack(
+            recordio.IRHeader(0, float(label), i, 0),
+            body + _CRC.pack(_crc(body, label, i))))
+    w.close()
+    return n
+
+
+def holdout_batch(k=4, seed=12):
+    """(inputs dict, labels) drawn from the same distribution as the
+    shard — the serving-side pinned canary slice."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    x = (rng.standard_normal((k, N_FEAT)) * 0.1).astype(np.float32)
+    labels = np.arange(k) % N_CLASS
+    for r, lbl in enumerate(labels):
+        x[r, lbl * 2] += 2.0
+    return {"data": x}, labels.astype(np.float32)
+
+
+class RecordFloatIter:
+    """Streaming DataIter over the float shard: every epoch re-reads the
+    record file through MXRecordIO (the ``io.corrupt_record`` payload
+    site), crc-verifies each record, and skips-and-counts damaged ones.
+    """
+
+    def __init__(self, path, batch_size=4):
+        import numpy as np
+        from incubator_mxnet_tpu import io, recordio
+        self._np, self._io, self._recordio = np, io, recordio
+        self.path = path
+        self.batch_size = int(batch_size)
+        self.corrupt_records = 0
+        self._reader = None
+        self._windows = []   # per-batch (lo, hi) record-ordinal windows
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [self._io.DataDesc("data", (self.batch_size, N_FEAT),
+                                  self._np.float32)]
+
+    @property
+    def provide_label(self):
+        return [self._io.DataDesc("softmax_label", (self.batch_size,),
+                                  self._np.float32)]
+
+    def reset(self):
+        if self._reader is not None:
+            self._reader.close()
+        self._reader = self._recordio.MXRecordIO(self.path, "r")
+        self._pos = 0          # record ordinal within this epoch
+        self._nbatch = 0
+        self._windows = []
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def _read_sample(self):
+        """(features, label) or None at EOF; damaged records are counted
+        and skipped, never delivered."""
+        while True:
+            raw = self._reader.read()
+            if raw is None:
+                return None
+            self._pos += 1
+            try:
+                header, blob = self._recordio.unpack(raw)
+                body, crc = blob[:_PAYLOAD.size], blob[_PAYLOAD.size:]
+                if (len(body) != _PAYLOAD.size or len(crc) != _CRC.size
+                        or _CRC.unpack(crc)[0]
+                        != _crc(body, header.label, header.id)):
+                    raise ValueError("crc mismatch")
+            except Exception:
+                self.corrupt_records += 1
+                continue
+            x = self._np.asarray(_PAYLOAD.unpack(body),
+                                 dtype=self._np.float32)
+            return x, float(header.label)
+
+    def next(self):
+        lo = self._pos
+        xs, ys = [], []
+        while len(xs) < self.batch_size:
+            sample = self._read_sample()
+            if sample is None:
+                break
+            xs.append(sample[0])
+            ys.append(sample[1])
+        if not xs:
+            raise StopIteration
+        pad = self.batch_size - len(xs)
+        while len(xs) < self.batch_size:
+            xs.append(xs[-1])
+            ys.append(ys[-1])
+        self._windows.append((lo, self._pos))
+        self._nbatch += 1
+        from incubator_mxnet_tpu import nd
+        np = self._np
+        return self._io.DataBatch(
+            data=[nd.array(np.stack(xs))],
+            label=[nd.array(np.asarray(ys, np.float32))],
+            pad=pad, index=None,
+            provide_data=self.provide_data,
+            provide_label=self.provide_label)
+
+    def seek(self, nbatch):
+        """Rollback-resume positioning: re-walk from the epoch start (the
+        corrupt-skip offsets must replay identically)."""
+        self.reset()
+        for _ in range(int(nbatch)):
+            try:
+                self.next()
+            except StopIteration:
+                break
+
+    def checkpoint_state(self):
+        return {}
+
+    def set_checkpoint_state(self, state, nbatch=0):
+        self.seek(nbatch)
+
+    def record_range(self, nbatch):
+        """Guardian/publisher shard attribution: the record-ordinal
+        window batch `nbatch` of this epoch consumed."""
+        n = int(nbatch)
+        if 0 <= n < len(self._windows):
+            lo, hi = self._windows[n]
+        else:
+            lo = n * self.batch_size
+            hi = lo + self.batch_size
+        return (os.path.basename(self.path), lo, hi)
+
+    def close(self):
+        if self._reader is not None:
+            self._reader.close()
+            self._reader = None
+
+
+def _build_module():
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import sym
+    net = sym.Variable("data")
+    net = sym.FullyConnected(net, num_hidden=64, name="fc0")
+    net = sym.Activation(net, act_type="tanh")
+    net = sym.FullyConnected(net, num_hidden=N_CLASS, name="head")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    return mx.mod.Module(net, context=mx.cpu())
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="loop_trainer", description=__doc__)
+    ap.add_argument("--registry", required=True)
+    ap.add_argument("--ckpt", required=True)
+    ap.add_argument("--rec", required=True)
+    ap.add_argument("--report", required=True)
+    ap.add_argument("--num-epoch", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=4)
+    ap.add_argument("--publish-steps", type=int, default=4)
+    ap.add_argument("--checkpoint-period", type=int, default=2)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--write-shard", type=int, default=0,
+                    help="write an N-record shard to --rec first")
+    args = ap.parse_args(argv)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import loop as mxloop
+    from incubator_mxnet_tpu.checkpoint.manifest import atomic_write_json
+    from incubator_mxnet_tpu.resilience.guardian import \
+        TrainingDivergedError
+
+    if args.write_shard:
+        write_shard(args.rec, n=args.write_shard)
+    np.random.seed(5)
+    mx.random.seed(5)
+    it = RecordFloatIter(args.rec, batch_size=args.batch_size)
+    mod = _build_module()
+    registry = mxloop.ModelRegistry(args.registry)
+    pub = mxloop.CheckpointPublisher(registry, args.ckpt,
+                                     publish_steps=args.publish_steps)
+    report = {"completed": False, "diverged": None}
+    try:
+        pub.fit(mod, it, num_epoch=args.num_epoch, optimizer="sgd",
+                optimizer_params={"learning_rate": args.lr},
+                eval_metric="acc", initializer=mx.initializer.Xavier(),
+                checkpoint_period=args.checkpoint_period)
+        report["completed"] = True
+    except TrainingDivergedError as exc:
+        report["diverged"] = str(exc)
+    guardian = getattr(mod, "_guardian", None)
+    report.update(
+        guardian=guardian.stats() if guardian is not None else None,
+        publisher=pub.stats(),
+        corrupt_records=it.corrupt_records,
+        versions=[r["version"] for r in registry.versions()],
+        fences=[list(f) for f in registry.fences()],
+    )
+    it.close()
+    atomic_write_json(args.report, report)
+    print(json.dumps(report))
+    return 0 if (report["completed"] or report["diverged"]) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

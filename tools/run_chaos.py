@@ -54,8 +54,24 @@ must be replayed on the survivor (the prefill re-derives the lost KV
 state from the prompt) with zero losses and zero duplicate deliveries.
 The artifact is ``CHAOS_DECODE.json``.
 
+Loop mode (``--loop``) runs the CONTINUOUS TRAIN-TO-SERVE schedules: a
+real trainer process (tools/loop_trainer.py) publishing guardian-healthy
+checkpoints into a shared `ModelRegistry` while a 2-replica remote fleet
+promotes them through the `LoopController`'s canary gate under live
+traffic.  One schedule corrupts a training shard mid-loop
+(``io.corrupt_record`` payload damage + an injected loss spike: the
+guardian rolls back, the publisher fences the disowned window, and the
+fleet must NEVER serve a fenced or rejected version, lose zero admitted
+requests, compile nothing during swaps, and go live on the next clean
+version inside the freshness SLO); one publishes a healthy-stamped but
+weight-sabotaged checkpoint (the serving-side canary must reject it,
+swap the canary replica back, stamp it rejected — durably, never
+retried); one tears a publish mid-commit (the truncated manifest must
+be invisible and a clean re-publish must promote).  The artifact is
+``CHAOS_LOOP.json``.
+
 Usage: python tools/run_chaos.py [--quick] [--pod] [--serving] [--train]
-                                 [--decode] [--json] [--out PATH]
+                                 [--decode] [--loop] [--json] [--out PATH]
     --quick   bounded test selection (the run_tpu_parity.py stage)
     --pod     run the elastic pod schedules (writes CHAOS_POD.json)
     --serving run the multi-replica router schedules
@@ -64,6 +80,8 @@ Usage: python tools/run_chaos.py [--quick] [--pod] [--serving] [--train]
               (writes CHAOS_TRAIN.json)
     --decode  run the continuous-batching decode schedules
               (writes CHAOS_DECODE.json)
+    --loop    run the train-to-serve loop schedules
+              (writes CHAOS_LOOP.json)
     --json    print only the JSON artifact on stdout
     --out     also write the artifact to PATH (default CHAOS_REPORT.json,
               CHAOS_POD.json with --pod, CHAOS_SERVING.json with
@@ -529,7 +547,7 @@ def _export_mlp(tmp):
     return mod, prefix, env
 
 
-def _serving_fleet(tmp, n=3, buckets=(1, 2, 4)):
+def _serving_fleet(tmp, n=3, buckets=(1, 2, 4), health_deadline_s=3.0):
     """(router, replicas, model artifacts) — a spawned remote fleet
     warming from one shared program-cache dir."""
     import incubator_mxnet_tpu as mx
@@ -538,8 +556,8 @@ def _serving_fleet(tmp, n=3, buckets=(1, 2, 4)):
         prefix=prefix, epoch=0, data_shapes=[("data", (1, 16))],
         buckets=buckets, name="m", replica_id="w%d" % i, env=env)
         for i in range(n)]
-    router = mx.serving.ReplicaRouter(reps, health_interval_s=0.2,
-                                      health_deadline_s=3.0)
+    router = mx.serving.ReplicaRouter(
+        reps, health_interval_s=0.2, health_deadline_s=health_deadline_s)
     return router, reps, (mod, prefix)
 
 
@@ -1705,6 +1723,348 @@ def run_embedding(as_json=False, out_path=None):
     return 0 if artifact["all_passed"] else 1
 
 
+# -- train-to-serve loop schedules: the continuous-training hand-off ----------
+#
+# A REAL trainer process (tools/loop_trainer.py) publishes guardian-
+# healthy elastic checkpoints into a shared ModelRegistry while a
+# 2-replica remote fleet promotes them through the LoopController's
+# canary gate under live traffic.  The failure matrix: a corrupted
+# training shard + loss spike (guardian rollback -> registry fence; the
+# fleet never serves a fenced or rejected version, zero admitted
+# requests lost, zero swap compiles, next clean version inside the
+# freshness SLO), a healthy-stamped-but-poisoned publish (the serving-
+# side canary rejects it, swaps the canary replica back, stamps the
+# version rejected — durable, never retried), and a torn publish (the
+# truncated manifest is invisible to the watcher; the incumbent keeps
+# serving; a clean re-publish promotes).
+
+def _loop_elastic_ckpt(tmp, name, args, auxs, step, transform=None):
+    """Params exported as ONE guardian-healthy elastic checkpoint dir."""
+    import incubator_mxnet_tpu as mx
+    root = os.path.join(tmp, name)
+    arrays = {}
+    for k, v in args.items():
+        a = v.asnumpy()
+        arrays["arg:" + k] = transform(k, a) if transform else a
+    for k, v in auxs.items():
+        arrays["aux:" + k] = v.asnumpy()
+    mgr = mx.checkpoint.CheckpointManager(root, async_snapshots=False)
+    mgr.snapshot(arrays=arrays, step=step, epoch=0, nbatch=step,
+                 meta={"health": {"status": "healthy"}}, sync=True)
+    mgr.close()
+    return os.path.join(root, "ckpt-%010d" % step)
+
+
+def _loop_boot_labels(args, x):
+    """The boot model's own argmax on `x` — a holdout on which the
+    incumbent scores exactly 1.0, so a same-params candidate ties and a
+    head-negated (poisoned) one scores ~0."""
+    import numpy as np
+    w0 = args["fc0_weight"].asnumpy()
+    b0 = args["fc0_bias"].asnumpy()
+    wh = args["head_weight"].asnumpy()
+    bh = args["head_bias"].asnumpy()
+    h = np.tanh(x @ w0.T + b0)
+    return (h @ wh.T + bh).argmax(axis=1).astype(np.float32)
+
+
+def _loop_traffic(router, stop_evt, n_threads=3):
+    """Open-ended closed-loop traffic until `stop_evt`; returns
+    (threads, ok_counter, errors) — the caller starts and joins."""
+    import numpy as np
+    x = np.random.default_rng(9).standard_normal((2, 16)).astype(
+        np.float32)
+    oks, errors = [0], []
+    lock = threading.Lock()
+
+    def client():
+        while not stop_evt.is_set():
+            try:
+                f = router.submit({"data": x}, timeout_ms=30000)
+                f.result(60)
+                with lock:
+                    oks[0] += 1
+            except Exception as exc:   # a lost request is the FINDING
+                errors.append(repr(exc))
+
+    threads = [threading.Thread(target=client,
+                                name=f"mx-chaos-loop-client-{i}")
+               for i in range(n_threads)]
+    return threads, oks, errors
+
+
+def run_loop_schedule(name, tmp, quiet=False):
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import loop as mxloop
+    from incubator_mxnet_tpu.checkpoint import manifest as _ck_manifest
+    from incubator_mxnet_tpu.resilience import faults as _f
+    from incubator_mxnet_tpu.resilience.faults import TornWrite
+    t0 = time.time()
+    checks = {}
+    errs = []
+    # the loop schedules certify the canary gate, not eviction timing —
+    # a generous liveness deadline keeps a CPU-starved worker (trainer
+    # subprocess + fleet sharing one loaded box) from being falsely
+    # declared lost mid-canary
+    router, reps, (mod, prefix) = _serving_fleet(tmp, n=2,
+                                                 health_deadline_s=15.0)
+    args, auxs = mod.get_params()
+    boot_ck = _loop_elastic_ckpt(tmp, "boot", args, auxs, step=0)
+    reg = mxloop.ModelRegistry(os.path.join(tmp, "registry"))
+
+    def publish(ckpt, step):
+        return reg.publish(ckpt, step=step,
+                           health={"status": "healthy"},
+                           watermark={"step": step, "time": time.time()})
+
+    stop = threading.Event()
+    threads, oks, errors = _loop_traffic(router, stop)
+    try:
+        checks["spinup_zero_compiles"] = all(
+            r.ready_info.get("compiles") == 0 for r in reps[1:])
+        base = [r.stats() for r in reps]
+        for t in threads:
+            t.start()
+        if name == "poisoned-shard-loop":
+            # the real loop: trainer subprocess reads a record shard
+            # through MXRecordIO with a seeded payload corruption AND an
+            # injected loss spike; the guardian rolls back, the
+            # publisher fences the disowned window, and the serving
+            # side keeps promoting only clean versions
+            _f.configure("seed=70")   # driver side: trace only
+            sys.path.insert(0, os.path.join(REPO, "tools"))
+            import loop_trainer as _lt
+            ctl = mxloop.LoopController(
+                router, reg, _lt.holdout_batch(), canary_tol=1.0,
+                poll_interval_s=0.2, freshness_slo_s=120.0,
+                incumbent_checkpoint=boot_ck)
+            report_path = os.path.join(tmp, "trainer_report.json")
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       PYTHONPATH=REPO + os.pathsep
+                       + os.environ.get("PYTHONPATH", ""),
+                       MXNET_FAULTS=("seed=71;"
+                                     "io.corrupt_record:corrupt(at=40);"
+                                     "loss.spike:error(at=30)"),
+                       MXNET_GUARDIAN_INTERVAL="4",
+                       MXNET_GUARDIAN_SPIKE_WINDOW="4")
+            env.pop("MXNET_FAULTS_LOG", None)
+            proc = subprocess.Popen(
+                [sys.executable,
+                 os.path.join(REPO, "tools", "loop_trainer.py"),
+                 "--registry", reg.root,
+                 "--ckpt", os.path.join(tmp, "trainer-ck"),
+                 "--rec", os.path.join(tmp, "shard.rec"),
+                 "--report", report_path, "--write-shard", "96"],
+                cwd=REPO, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True)
+            promoted = []
+            rejected = []
+            deadline = time.time() + 300
+            quiet_polls = 0
+            try:
+                while time.time() < deadline:
+                    try:
+                        status = ctl.poll_once()
+                    except mxloop.CanaryRejectedError as exc:
+                        rejected.append(exc.version)
+                        continue
+                    if status.get("status") == "promoted":
+                        promoted.append(status)
+                        quiet_polls = 0
+                    elif proc.poll() is not None:
+                        quiet_polls += 1
+                        if quiet_polls >= 5:
+                            break
+                    time.sleep(0.25)
+            finally:
+                try:
+                    proc.communicate(timeout=60)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.communicate()
+            with open(report_path) as f:
+                report = json.load(f)
+            st = ctl.stats()
+            checks.update(
+                trainer_completed=bool(report.get("completed")),
+                corrupt_record_detected=(
+                    report.get("corrupt_records", 0) >= 1),
+                guardian_rolled_back=(
+                    (report.get("guardian") or {}).get("rollbacks", 0)
+                    >= 1),
+                registry_fenced=(len(report.get("fences") or ()) >= 1),
+                clean_versions_promoted=(len(promoted) >= 1),
+                poisoned_never_served=(
+                    not rejected and st["canary_rejections"] == 0
+                    and all(not reg.fenced(p["version"])
+                            and reg.rejected(p["version"]) is None
+                            for p in promoted)),
+                freshness_within_slo=(st.get("freshness_slo_met") == 1),
+                promoted_versions=[p["version"] for p in promoted],
+                fenced_windows=report.get("fences"))
+        elif name == "poisoned-publish-canary":
+            # a healthy-stamped checkpoint with sabotaged weights lands
+            # in the registry (poisoned data slipped past the trainer):
+            # the serving-side canary is the LAST line of defense
+            _f.configure("seed=72")
+            x = np.random.default_rng(7).standard_normal(
+                (4, 16)).astype(np.float32)
+            labels = _loop_boot_labels(args, x)
+            ctl = mxloop.LoopController(
+                router, reg, ({"data": x}, labels), canary_tol=0.05,
+                poll_interval_s=0.2, freshness_slo_s=120.0,
+                incumbent_checkpoint=boot_ck)
+            good_ck = _loop_elastic_ckpt(tmp, "good", args, auxs, 1)
+            poison_ck = _loop_elastic_ckpt(
+                tmp, "poison", args, auxs, 2,
+                transform=lambda k, a: -a if k == "head_weight" else a)
+            publish(good_ck, 1)
+            st1 = ctl.poll_once()
+            checks["clean_version_promoted"] = (
+                st1.get("status") == "promoted" and st1["version"] == 1)
+            publish(poison_ck, 2)
+            rejected_exc = None
+            try:
+                ctl.poll_once()
+            except mxloop.CanaryRejectedError as exc:
+                rejected_exc = exc
+            checks["canary_rejected_structured"] = (
+                rejected_exc is not None and rejected_exc.version == 2
+                and rejected_exc.canary_score
+                < rejected_exc.incumbent_score)
+            # the canary replica was swapped BACK: every replica still
+            # classifies the holdout exactly like the incumbent
+            outs = [r.submit({"data": x}, timeout_ms=30000).result(60)
+                    for r in reps]
+            checks["fleet_swapped_back"] = all(
+                bool((np.asarray(o[0]).argmax(axis=1) == labels).all())
+                for o in outs)
+            checks["rejection_stamp_durable"] = (
+                reg.rejected(2) is not None
+                and _ck_manifest.is_rejected(poison_ck)
+                and mxloop.ModelRegistry(
+                    reg.root).latest()["version"] == 1)
+            st2 = ctl.poll_once()
+            checks["never_retried"] = (
+                st2.get("status") == "idle"
+                and ctl.stats()["canary_rejections"] == 1)
+        elif name == "torn-publish":
+            # the publisher dies mid-commit: the truncated manifest
+            # must be invisible, the fleet keeps serving, and a clean
+            # re-publish of the same step promotes normally
+            x = np.random.default_rng(7).standard_normal(
+                (4, 16)).astype(np.float32)
+            labels = _loop_boot_labels(args, x)
+            ctl = mxloop.LoopController(
+                router, reg, ({"data": x}, labels), canary_tol=0.05,
+                poll_interval_s=0.2, freshness_slo_s=120.0,
+                incumbent_checkpoint=boot_ck)
+            good_ck = _loop_elastic_ckpt(tmp, "good", args, auxs, 1)
+            v2_ck = _loop_elastic_ckpt(tmp, "v2", args, auxs, 2)
+            publish(good_ck, 1)
+            checks["clean_version_promoted"] = (
+                ctl.poll_once().get("status") == "promoted")
+            _f.configure("seed=73;publish.commit:torn(at=1)")
+            torn_raised = False
+            try:
+                publish(v2_ck, 2)
+            except TornWrite:
+                torn_raised = True
+            _f.configure("seed=73")
+            torn_path = os.path.join(reg.root, "v-0000000002.json")
+            checks["torn_publish_raised"] = torn_raised
+            checks["torn_manifest_invisible"] = (
+                os.path.exists(torn_path)
+                and reg.latest()["version"] == 1
+                and ctl.poll_once().get("status") == "idle"
+                and reg.stats()["torn_manifests"] == 1)
+            out = router.predict({"data": x}, timeout_ms=30000)
+            checks["fleet_kept_serving"] = bool(
+                (np.asarray(out[0]).argmax(axis=1) == labels).all())
+            publish(v2_ck, 2)   # clean re-publish commits atomically
+            st2 = ctl.poll_once()
+            checks["clean_republish_promoted"] = (
+                st2.get("status") == "promoted" and st2["version"] == 2)
+        else:
+            raise ValueError("unknown loop schedule %r" % name)
+        stop.set()
+        for t in threads:
+            t.join(30)
+        after = [r.stats() for r in reps]
+        compiles = [
+            (s.get("cache") or {}).get("compiles", 0) -
+            (b.get("cache") or {}).get("compiles", 0)
+            for b, s in zip(base, after)]
+        checks.update(
+            zero_lost=(oks[0] > 0 and not errors),
+            zero_swap_compiles=all(c == 0 for c in compiles),
+            requests_served=oks[0])
+        errs = errors[:5] if errors else []
+    finally:
+        stop.set()
+        try:
+            router.shutdown(drain=False)
+        except Exception:
+            pass
+        for r in reps:
+            try:
+                r.kill()
+            except Exception:
+                pass
+        _f.clear()
+    bools = [v for v in checks.values() if isinstance(v, bool)]
+    result = {
+        "schedule": name,
+        "checks": checks,
+        "errors": errs,
+        "duration_s": round(time.time() - t0, 1),
+        "passed": bool(bools) and all(bools),
+    }
+    if not quiet:
+        print("chaos[loop/%s]: passed=%s checks=%s (%.1fs)" %
+              (name, result["passed"], checks, result["duration_s"]),
+              file=sys.stderr)
+    return result
+
+
+def run_loop(as_json=False, out_path=None):
+    runs = []
+    for name in ("poisoned-shard-loop", "poisoned-publish-canary",
+                 "torn-publish"):
+        # one retry on an ESCAPED exception only: on an oversubscribed
+        # box (this suite runs trainer + 2 workers + driver on shared
+        # cores) a starved worker can be declared lost mid-schedule —
+        # an infra artifact, not the invariant under test.  A schedule
+        # that RAN but failed its checks is never retried.
+        for attempt in (1, 2):
+            tmp = tempfile.mkdtemp(prefix="chaos-loop-%s-" % name)
+            try:
+                run = run_loop_schedule(name, tmp, quiet=as_json)
+            except Exception as exc:
+                run = {"schedule": name, "passed": False,
+                       "error": repr(exc)}
+            finally:
+                shutil.rmtree(tmp, ignore_errors=True)
+            run["attempt"] = attempt
+            if run.get("error") is None or attempt == 2:
+                break
+        runs.append(run)
+    artifact = {
+        "schedules": runs,
+        "all_passed": all(r["passed"] for r in runs),
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(artifact, f, indent=1)
+    if as_json:
+        print(json.dumps(artifact))
+    else:
+        print("chaos loop: %d schedule(s), all_passed=%s -> %s" %
+              (len(runs), artifact["all_passed"], out_path))
+    return 0 if artifact["all_passed"] else 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="run_chaos", description=__doc__)
     ap.add_argument("--quick", action="store_true")
@@ -1714,9 +2074,16 @@ def main(argv=None):
     ap.add_argument("--train", action="store_true")
     ap.add_argument("--decode", action="store_true")
     ap.add_argument("--embedding", action="store_true")
+    ap.add_argument("--loop", action="store_true")
     ap.add_argument("--json", action="store_true", dest="as_json")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
+    if args.loop:
+        out = args.out if args.out is not None \
+            else os.path.join(REPO, "CHAOS_LOOP.json")
+        sys.path.insert(0, REPO)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        return run_loop(as_json=args.as_json, out_path=out)
     if args.embedding:
         out = args.out if args.out is not None \
             else os.path.join(REPO, "CHAOS_EMBED.json")

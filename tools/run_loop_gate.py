@@ -1,0 +1,245 @@
+#!/usr/bin/env python
+"""LOOP_REPORT gate: one clean in-process train-to-serve loop.
+
+Runs the whole continuous loop in a single process with no injected
+faults — the "sunny day" counterpart of ``run_chaos.py --loop`` — and
+gates the invariants the loop subsystem promises when nothing goes
+wrong:
+
+* the publisher's cadence yields a stream of registry versions and the
+  controller promotes at least ``MIN_PROMOTIONS`` of them (canary →
+  rolling fleet swap) while training is still running;
+* zero canary rejections — a clean loop never trips the gate;
+* a traffic thread hammers the fleet throughout: zero admitted requests
+  lost across every swap;
+* zero new XLA programs after warmup — every promotion is a pure
+  weight swap (params are call arguments, never baked constants);
+* every promotion's ``loop.freshness_lag_s`` (data-shard watermark →
+  model live) is within the freshness SLO, and the gauge is visible in
+  the obs scrape plane.
+
+Writes ``LOOP_REPORT.json``; exit code 0 iff every gate holds.
+
+Usage::
+
+    python tools/run_loop_gate.py [--out LOOP_REPORT.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+MIN_PROMOTIONS = 3
+FRESHNESS_SLO_S = 120.0
+
+
+def _build(tmp):
+    """Module + 2-LocalReplica fleet booted from the module's own
+    initial parameters (a step-0 elastic checkpoint)."""
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import checkpoint as ckpt, sym
+    from incubator_mxnet_tpu.serving import LocalReplica, ReplicaRouter
+    from tools import loop_trainer as lt
+
+    np.random.seed(7)
+    mx.random.seed(7)
+    mod = lt._build_module()
+    mod.bind(data_shapes=[("data", (4, lt.N_FEAT))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.initializer.Xavier())
+    args, auxs = mod.get_params()
+    args = {k: np.asarray(v.asnumpy()) for k, v in args.items()}
+    auxs = {k: np.asarray(v.asnumpy()) for k, v in (auxs or {}).items()}
+
+    arrays = {"arg:" + k: v for k, v in args.items()}
+    arrays.update({"aux:" + k: v for k, v in auxs.items()})
+    mgr = ckpt.CheckpointManager(os.path.join(tmp, "boot"), keep_last=4,
+                                 async_snapshots=False)
+    mgr.snapshot(arrays=arrays, step=0, epoch=0, nbatch=0,
+                 meta={"health": {"status": "healthy"}}, sync=True)
+    mgr.close()
+    boot_ck = os.path.join(tmp, "boot", "ckpt-%010d" % 0)
+
+    net = sym.Variable("data")
+    net = sym.FullyConnected(net, num_hidden=64, name="fc0")
+    net = sym.Activation(net, act_type="tanh")
+    net = sym.FullyConnected(net, num_hidden=lt.N_CLASS, name="head")
+    net = sym.SoftmaxOutput(net, name="softmax")
+    models = [mx.serving.ServedModel(
+        net, {k: mx.nd.array(v) for k, v in args.items()},
+        {k: mx.nd.array(v) for k, v in auxs.items()},
+        data_shapes=[("data", (1, lt.N_FEAT))], buckets=(1, 2, 4),
+        ctx=mx.cpu(), name=f"m{i}") for i in range(2)]
+    reps = [LocalReplica(m, replica_id=f"w{i}")
+            for i, m in enumerate(models)]
+    router = ReplicaRouter(reps, name="loop-gate", health_interval_s=5.0)
+    return mod, router, models, boot_ck
+
+
+def _traffic(router, stop, counts):
+    import numpy as np
+    rng = np.random.default_rng(9)
+    x = (rng.standard_normal((2, 16)) * 0.1).astype(np.float32)
+    while not stop.is_set():
+        try:
+            router.submit({"data": x}, timeout_ms=30000).result(60)
+            counts["ok"] += 1
+        except Exception as exc:
+            counts["errors"].append(repr(exc))
+        time.sleep(0.002)
+
+
+def run(out_path, quiet=False):
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import loop as mxloop
+    from incubator_mxnet_tpu.checkpoint.manifest import atomic_write_json
+    from incubator_mxnet_tpu.loop import CanaryRejectedError
+    from incubator_mxnet_tpu.obs import metrics as obs_metrics
+    from tools import loop_trainer as lt
+
+    tmp = tempfile.mkdtemp(prefix="loop-gate-")
+    t0 = time.time()
+    try:
+        mod, router, models, boot_ck = _build(tmp)
+        reg = mxloop.ModelRegistry(os.path.join(tmp, "registry"))
+        pub = mxloop.CheckpointPublisher(
+            reg, os.path.join(tmp, "ckpt"), publish_steps=8)
+        ctl = mxloop.LoopController(
+            router, reg, lt.holdout_batch(), canary_tol=1.0,
+            poll_interval_s=0.1, freshness_slo_s=FRESHNESS_SLO_S,
+            incumbent_checkpoint=boot_ck)
+
+        # warm the request path before baselining program counts: the
+        # gate certifies SWAPS compile nothing, not that warmup is free
+        hold_x = lt.holdout_batch()[0]
+        for _ in range(3):
+            router.submit(hold_x, timeout_ms=30000).result(60)
+        programs0 = [m.program_count() for m in models]
+
+        counts = {"ok": 0, "errors": []}
+        stop = threading.Event()
+        threads = [threading.Thread(target=_traffic,
+                                    args=(router, stop, counts),
+                                    daemon=True) for _ in range(2)]
+        for t in threads:
+            t.start()
+
+        promoted, rejected = [], []
+
+        def gate_cb(param):
+            try:
+                res = ctl.poll_once()
+            except CanaryRejectedError as exc:
+                rejected.append(exc.version)
+                return
+            if res.get("status") == "promoted":
+                promoted.append(res)
+
+        # ~96 records / bs 4 -> 24 steps/epoch, 2 epochs = 48 gsteps;
+        # publish cadence 8 + checkpoint period 4 -> ~6 versions
+        rec = os.path.join(tmp, "shard.rec")
+        lt.write_shard(rec, n=96)
+        it = lt.RecordFloatIter(rec, batch_size=4)
+        try:
+            pub.fit(mod, it, num_epoch=2, optimizer="sgd",
+                    optimizer_params={"learning_rate": 0.05},
+                    eval_metric="acc",
+                    initializer=mx.initializer.Xavier(),
+                    checkpoint_period=4, batch_end_callback=gate_cb)
+        finally:
+            it.close()
+        # drain: promote whatever the trainer published after the last
+        # callback poll
+        for _ in range(10):
+            try:
+                res = ctl.poll_once()
+            except CanaryRejectedError as exc:
+                rejected.append(exc.version)
+                continue
+            if res.get("status") == "promoted":
+                promoted.append(res)
+            elif res.get("status") == "idle":
+                break
+
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        programs1 = [m.program_count() for m in models]
+
+        cstats = ctl.stats()
+        lags = [float(r["freshness_lag_s"]) for r in promoted]
+        snap = obs_metrics.registry().collect()
+        gates = {
+            "promotions_reached": cstats.get("promotions", 0)
+            >= MIN_PROMOTIONS,
+            "zero_rejections": cstats.get("canary_rejections", 0) == 0
+            and not rejected,
+            "zero_lost_requests": counts["ok"] > 0
+            and not counts["errors"],
+            "zero_swap_compiles": programs0 == programs1,
+            "freshness_within_slo": bool(lags)
+            and max(lags) <= FRESHNESS_SLO_S
+            and cstats.get("freshness_slo_met") == 1,
+            "freshness_gauge_scraped": "loop.freshness_lag_s" in snap,
+        }
+        report = {
+            "gates": gates,
+            "all_passed": all(gates.values()),
+            "promotions": [int(r["version"]) for r in promoted],
+            "max_freshness_lag_s": max(lags) if lags else None,
+            "freshness_slo_s": FRESHNESS_SLO_S,
+            "requests_served": counts["ok"],
+            "request_errors": counts["errors"][:5],
+            "programs_per_replica": programs1,
+            "controller": cstats,
+            "publisher": pub.stats(),
+            "registry": reg.stats(),
+            "duration_s": round(time.time() - t0, 1),
+        }
+    finally:
+        try:
+            router.shutdown(drain=False)
+        except Exception:
+            pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    if out_path:
+        atomic_write_json(out_path, report)
+    if not quiet:
+        print("loop gate: all_passed=%s gates=%s promotions=%s "
+              "lag=%.2fs served=%d (%.1fs) -> %s"
+              % (report["all_passed"], report["gates"],
+                 report["promotions"],
+                 report["max_freshness_lag_s"] or -1.0,
+                 report["requests_served"], report["duration_s"],
+                 out_path or "<stdout>"))
+        if not out_path:
+            print(json.dumps(report, indent=1, sort_keys=True))
+    return 0 if report["all_passed"] else 1
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="run_loop_gate",
+                                 description=__doc__)
+    ap.add_argument("--out", default=os.path.join(REPO,
+                                                  "LOOP_REPORT.json"))
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args(argv)
+    return run(args.out, quiet=args.quiet)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

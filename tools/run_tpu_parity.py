@@ -481,6 +481,51 @@ def chaos_embed_stage():
         return {"error": f"chaos embedding stage failed: {exc!r}"}
 
 
+def loop_stage():
+    """Continuous train-to-serve loop stage, two halves:
+
+    * ``run_chaos.py --loop`` — a REAL trainer process whose shard is
+      corrupted mid-loop: the fleet must never serve the poisoned
+      model (guardian rollback → registry fence → canary gate), zero
+      admitted requests lost, next clean version within the freshness
+      SLO (CHAOS_LOOP artifact);
+    * ``run_loop_gate.py`` — one clean in-process loop gating the
+      sunny-day invariants: >=3 canary promotions while training runs,
+      zero rejections, zero lost requests, zero post-warmup XLA
+      programs across every swap, ``loop.freshness_lag_s`` within SLO
+      and visible in the obs scrape plane (LOOP_REPORT artifact)."""
+    out = {}
+    cmd = [sys.executable, os.path.join(REPO, "tools", "run_chaos.py"),
+           "--loop", "--json", "--out", ""]
+    try:
+        r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                           timeout=1800)
+        chaos = json.loads(r.stdout)
+        chaos["rc"] = r.returncode
+        out["chaos"] = chaos
+    except Exception as exc:
+        out["chaos"] = {"error": f"chaos loop stage failed: {exc!r}"}
+    cmd = [sys.executable, os.path.join(REPO, "tools", "run_loop_gate.py"),
+           "--out", os.path.join(REPO, "LOOP_REPORT.json")]
+    try:
+        r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                           timeout=900)
+        with open(os.path.join(REPO, "LOOP_REPORT.json")) as f:
+            gate = json.load(f)
+        out["gate"] = {"rc": r.returncode,
+                       "all_passed": gate.get("all_passed"),
+                       "gates": gate.get("gates"),
+                       "promotions": gate.get("promotions"),
+                       "max_freshness_lag_s":
+                           gate.get("max_freshness_lag_s")}
+    except Exception as exc:
+        out["gate"] = {"error": f"loop gate failed: {exc!r}"}
+    out["all_passed"] = bool(
+        out.get("chaos", {}).get("all_passed")
+        and out.get("gate", {}).get("all_passed"))
+    return out
+
+
 def coldstart_stage():
     """Cold-start stage: the warmup CLI's built-in probe, run cold then
     warm in fresh subprocesses (tools/warmup.py coldstart_probe) — the
@@ -550,6 +595,7 @@ def main():
         "chaos_train": chaos_train_stage(),
         "chaos_decode": chaos_decode_stage(),
         "chaos_embed": chaos_embed_stage(),
+        "loop": loop_stage(),
         "llm": llm_stage(),
         "coldstart": coldstart_stage(),
         "scaling": scaling_stage(),
