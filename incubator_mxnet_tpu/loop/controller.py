@@ -20,14 +20,23 @@ fleet, runs every candidate through a CANARY —
 Structured failure handling, never tear-down:
 
 * `SwapInProgressError` from the router (another swap mid-flight) →
-  back off, retry the same version on the next poll;
+  back off, retry the same version on the next poll; if it is the
+  canary ROLLBACK that collides with an external roll, the restore is
+  deferred and retried at the next poll instead of destroying the
+  replica;
 * a replica LOST (or transport wedged) mid-canary/mid-promote → the
   router's swap contract keeps the fleet serving (each request is
   single-version); the controller counts a ``swap_failure``, returns a
   structured ``swap-failed`` status, and retries the whole canary on
-  the next poll — never crashes the watch loop;
+  the next poll — never crashes the watch loop.  A promote that aborts
+  AFTER the canary passed is resumed directly on the next poll (the
+  verdict stands; re-canarying against a partially-rolled fleet could
+  compare the candidate against itself);
 * `RegistryUnavailableError` (registry directory vanished mid-poll) →
   count it, keep serving the incumbent;
+* a failure scoring the INCUMBENT (before anything was swapped) is an
+  eval problem, not a swap problem: counted under ``eval_failures``,
+  returned as an ``eval-failed`` status, candidate retried next poll;
 * a canary-eval failure (``canary.eval`` fault site, inference error,
   timeout) fails CLOSED: the candidate is treated as scoring -inf and
   rejected — a model that cannot be scored is never promoted.
@@ -42,6 +51,10 @@ from __future__ import annotations
 import logging
 import threading
 import time
+
+# on Python <= 3.10 this is NOT the builtin TimeoutError: a hung
+# Future.result would otherwise escape every fail-closed handler below
+from concurrent.futures import TimeoutError as _FutTimeout
 
 import numpy as _np
 
@@ -113,6 +126,15 @@ class LoopController:
             if freshness_slo_s is None else freshness_slo_s)
         self.eval_timeout_ms = int(eval_timeout_ms)
         self._live = None            # registry record of the live version
+        # (version, incumbent_score, canary_score) of a candidate whose
+        # canary PASSED but whose fleet-wide promote roll aborted — the
+        # next poll resumes the roll instead of re-canarying (some
+        # replicas already serve the candidate, so a fresh canary pick
+        # could compare the candidate against itself)
+        self._vetted = None
+        # (rid, checkpoint) of a canary rollback deferred because an
+        # external swap held the lock — retried first thing next poll
+        self._pending_restore = None
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = None
@@ -137,6 +159,13 @@ class LoopController:
         self._polls += 1
         sp = _trace.start_span("loop.poll", cat="loop")
         try:
+            if self._pending_restore is not None:
+                # a canary rollback that lost the swap lock last poll:
+                # finish it before looking at anything new — the replica
+                # is still serving the rejected weights
+                rid, restore_ckpt = self._pending_restore
+                self._pending_restore = None
+                self._restore_canary(rid, incumbent_ckpt=restore_ckpt)
             try:
                 cand = self.registry.latest()
             except RegistryUnavailableError as e:
@@ -160,7 +189,8 @@ class LoopController:
                         "candidate": cand["version"]}
             except CanaryRejectedError:
                 raise
-            except (ReplicaLostError, TimeoutError, MXNetError) as e:
+            except (ReplicaLostError, TimeoutError, _FutTimeout,
+                    MXNetError) as e:
                 # a replica died (or the transport wedged) mid-swap.
                 # The router's swap contract already guarantees the
                 # fleet keeps serving — each request is single-version,
@@ -180,17 +210,38 @@ class LoopController:
 
     def _canary_and_promote(self, cand):
         version, ckpt = cand["version"], cand["checkpoint"]
+        if self._vetted is not None and self._vetted[0] == version:
+            # this version already PASSED its canary; the promote roll
+            # aborted partway, so some replicas may already serve it — a
+            # fresh canary pick could score the candidate as its own
+            # "incumbent".  The verdict stands: resume the roll.
+            _, inc, can = self._vetted
+            _LOG.info("loop: resuming aborted promote of version %d",
+                      version)
+            return self._promote(cand, inc, can)
         sp = _trace.start_span("loop.canary", cat="loop", version=version)
         try:
             rid, replica = self._pick_canary()
-            incumbent_score = self._score_replica(replica, version,
-                                                  phase="incumbent")
+            try:
+                incumbent_score = self._score_replica(replica, version,
+                                                      phase="incumbent")
+            except (MXNetError, ReplicaLostError, TimeoutError,
+                    _FutTimeout) as e:
+                # nothing was swapped yet: this is an eval problem, not
+                # a swap problem — count it as such, retry next poll
+                self._eval_failures += 1
+                _LOG.error("loop: incumbent eval before canary of "
+                           "version %d failed (%s) — will retry next "
+                           "poll", version, e)
+                return {"status": "eval-failed", "phase": "incumbent",
+                        "candidate": version, "error": str(e)}
             self.router.swap_one(rid, checkpoint_dir=ckpt,
                                  version=version)
             try:
                 canary_score = self._score_replica(replica, version,
                                                    phase="canary")
-            except (MXNetError, ReplicaLostError, TimeoutError) as e:
+            except (MXNetError, ReplicaLostError, TimeoutError,
+                    _FutTimeout) as e:
                 # fail CLOSED: an unscorable candidate is a rejected one
                 self._eval_failures += 1
                 _LOG.error("loop: canary eval of version %d failed (%s)",
@@ -201,6 +252,10 @@ class LoopController:
         finally:
             sp.end()
         if ok:
+            # record the verdict BEFORE rolling: if swap_weights aborts
+            # partway, the next poll resumes the promote instead of
+            # canarying against a partially-rolled fleet
+            self._vetted = (version, incumbent_score, canary_score)
             return self._promote(cand, incumbent_score, canary_score)
         return self._reject(cand, rid, incumbent_score, canary_score)
 
@@ -212,6 +267,7 @@ class LoopController:
             self.router.swap_weights(checkpoint_dir=ckpt, version=version)
         finally:
             sp.end()
+        self._vetted = None
         self._live = cand
         self._promotions += 1
         lag = self._measure_freshness(cand)
@@ -236,15 +292,24 @@ class LoopController:
             _LOG.error("loop: could not stamp version %d rejected: %s",
                        version, e)
         # stamp the checkpoint itself too, so trainer-side resume and
-        # latest_healthy() skip it even without reading the registry
-        try:
-            from ..checkpoint import manifest as _manifest
-            _manifest.stamp_rejected(cand["checkpoint"], reason="canary",
-                                     incumbent_score=incumbent_score,
-                                     canary_score=canary_score)
-        except (OSError, MXNetError) as e:
-            _LOG.warning("loop: could not stamp checkpoint of version "
-                         "%d rejected: %s", version, e)
+        # latest_healthy() skip it even without reading the registry.
+        # With publish(pin=True) the record's "checkpoint" is the
+        # registry-owned blobs/ copy — the trainer resumes from its own
+        # ckpt-* directory, so the SOURCE path must carry the stamp too
+        from ..checkpoint import manifest as _manifest
+        stamped = set()
+        for path in (cand.get("checkpoint"),
+                     cand.get("source_checkpoint")):
+            if not path or path in stamped:
+                continue
+            stamped.add(path)
+            try:
+                _manifest.stamp_rejected(path, reason="canary",
+                                         incumbent_score=incumbent_score,
+                                         canary_score=canary_score)
+            except (OSError, MXNetError) as e:
+                _LOG.warning("loop: could not stamp checkpoint %s of "
+                             "version %d rejected: %s", path, version, e)
         self._rejections += 1
         raise CanaryRejectedError(version, incumbent_score, canary_score,
                                   tol=self.canary_tol)
@@ -262,6 +327,16 @@ class LoopController:
                 _LOG.error("loop: no incumbent checkpoint to restore "
                            "canary replica '%s' — declaring it lost", rid)
                 self.router.declare_lost(rid)
+        except SwapInProgressError as e:
+            # an external roll holds the swap lock: the replica is
+            # healthy, just serving the rejected weights one poll longer
+            # — defer the restore and retry it first thing next poll
+            # instead of destroying capacity
+            self._swap_busy += 1
+            self._pending_restore = (rid, incumbent_ckpt)
+            _LOG.warning("loop: restore of canary replica '%s' blocked "
+                         "by in-flight swap (%s) — will retry next poll",
+                         rid, e.version)
         except (MXNetError, ReplicaLostError) as e:
             _LOG.error("loop: could not restore canary replica '%s' — "
                        "declaring it lost: %s", rid, e)
@@ -283,7 +358,16 @@ class LoopController:
         _faults.fire("canary.eval", version=version, phase=phase)
         fut = replica.submit(dict(self.holdout_inputs),
                              timeout_ms=self.eval_timeout_ms)
-        outputs = fut.result(timeout=self.eval_timeout_ms / 1000.0 + 5.0)
+        try:
+            outputs = fut.result(
+                timeout=self.eval_timeout_ms / 1000.0 + 5.0)
+        except _FutTimeout as e:
+            # translate at the source: pre-3.11 this is not the builtin
+            # TimeoutError, and a hung eval must hit the fail-closed
+            # handlers, not escape them
+            raise MXNetError(
+                f"loop: holdout eval of version {version} ({phase}) "
+                f"timed out after {self.eval_timeout_ms} ms") from e
         return float(self.score_fn(outputs, self.holdout_labels))
 
     # ------------------------------------------------------- freshness
@@ -324,7 +408,8 @@ class LoopController:
                 self.poll_once()
             except CanaryRejectedError as e:
                 _LOG.error("loop: %s", e)
-            except (MXNetError, ReplicaLostError) as e:
+            except (MXNetError, ReplicaLostError, TimeoutError,
+                    _FutTimeout) as e:
                 _LOG.error("loop: poll failed: %s", e)
             self._stop.wait(self.poll_interval_s)
 

@@ -109,6 +109,7 @@ class ModelRegistry:
         knows the publish did not commit.
         """
         self._require_root()
+        source = str(checkpoint)
         if pin:
             checkpoint = self._pin_checkpoint(checkpoint, step)
         rec = {
@@ -116,6 +117,11 @@ class ModelRegistry:
             "version": int(step),
             "step": int(step),
             "checkpoint": str(checkpoint),
+            # the trainer-side directory the pin was taken from: a
+            # canary rejection must stamp THAT path too, or resume /
+            # replica boot scanning the trainer's checkpoint_dir (not
+            # the registry blobs/) would never see the verdict
+            "source_checkpoint": source,
             "health": dict(health or {}),
             "watermark": dict(watermark or {}),
             "score": score,

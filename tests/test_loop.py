@@ -585,6 +585,168 @@ def test_controller_background_thread_promotes(tmp_path):
         router.shutdown()
 
 
+def test_hung_canary_eval_fails_closed(tmp_path):
+    """A canary eval that HANGS raises concurrent.futures.TimeoutError
+    (pre-3.11 NOT the builtin TimeoutError) — it must still hit the
+    fail-closed path: reject the candidate, restore the canary replica,
+    never let the exception escape the handlers."""
+    import concurrent.futures
+    ck_root, reg, router, ctrl, boot = _loop_rig(tmp_path)
+    try:
+        _publish(reg, _write_ckpt(ck_root, IDENT, 2), 2)
+        rid = ctrl._pick_canary()[0]
+        rep = router.replica(rid)
+        real_submit, calls = rep.submit, []
+
+        class _Hung:
+            def result(self, timeout=None):
+                raise concurrent.futures.TimeoutError()
+
+        def submit(*a, **kw):
+            calls.append(1)
+            if len(calls) == 2:        # hit 2 = the CANDIDATE eval
+                return _Hung()
+            return real_submit(*a, **kw)
+
+        rep.submit = submit
+        with pytest.raises(CanaryRejectedError) as ei:
+            ctrl.poll_once()
+        assert ei.value.canary_score == float("-inf")
+        assert ctrl.stats()["eval_failures"] == 1
+        assert reg.rejected(2) is not None
+        # the canary replica was RESTORED, not abandoned on the
+        # unvetted candidate or declared lost
+        assert router.stats()["replicas_lost"] == 0
+        rep.submit = real_submit
+        out = router.predict({"data": IDENT}, timeout_ms=10000)
+        first = out[0] if isinstance(out, (list, tuple)) else out
+        first = np.asarray(first.asnumpy() if hasattr(first, "asnumpy")
+                           else first)
+        assert (first.argmax(axis=-1) == np.arange(4)).all()
+    finally:
+        router.shutdown()
+
+
+def test_incumbent_eval_failure_is_eval_failed_not_swap_failed(tmp_path):
+    """A fault while scoring the INCUMBENT (before any swap) is an eval
+    failure with its own status — not a swap_failure — and the candidate
+    stays eligible for the next poll."""
+    ck_root, reg, router, ctrl, boot = _loop_rig(tmp_path)
+    try:
+        _publish(reg, _write_ckpt(ck_root, IDENT, 2), 2)
+        faults.configure("seed=7;canary.eval:error(at=1)")  # incumbent hit
+        res = ctrl.poll_once()
+        assert res["status"] == "eval-failed"
+        assert res["phase"] == "incumbent" and res["candidate"] == 2
+        assert ctrl.stats()["eval_failures"] == 1
+        assert ctrl.stats()["swap_failures"] == 0
+        assert ctrl.stats()["canary_rejections"] == 0
+        assert reg.rejected(2) is None     # no canary verdict was reached
+        # fault exhausted: the retry canaries and promotes
+        assert ctrl.poll_once()["status"] == "promoted"
+    finally:
+        router.shutdown()
+
+
+def test_restore_backs_off_when_swap_lock_held(tmp_path):
+    """A canary rollback that collides with an external in-flight swap
+    must NOT declare the replica lost — the restore is deferred and
+    retried on the next poll."""
+    ck_root, reg, router, ctrl, boot = _loop_rig(tmp_path)
+    try:
+        _publish(reg, _write_ckpt(ck_root, IDENT, 2), 2)
+        assert ctrl.poll_once()["status"] == "promoted"
+        _publish(reg, _write_ckpt(ck_root, -IDENT, 3), 3)
+        real_swap_one, state = router.swap_one, {"n": 0}
+
+        def swap_one(*a, **kw):
+            state["n"] += 1
+            if state["n"] == 2:        # call 2 = the restore swap-back
+                raise SwapInProgressError(router.name, "operator-roll")
+            return real_swap_one(*a, **kw)
+
+        router.swap_one = swap_one
+        with pytest.raises(CanaryRejectedError):
+            ctrl.poll_once()
+        assert router.stats()["replicas_lost"] == 0   # capacity kept
+        assert ctrl._pending_restore is not None
+        assert ctrl.stats()["swap_busy"] == 1
+        # next poll finishes the restore first, then sees only the
+        # already-rejected version -> idle
+        assert ctrl.poll_once()["status"] == "idle"
+        assert ctrl._pending_restore is None
+        assert state["n"] == 3
+        out = router.predict({"data": IDENT}, timeout_ms=10000)
+        first = out[0] if isinstance(out, (list, tuple)) else out
+        first = np.asarray(first.asnumpy() if hasattr(first, "asnumpy")
+                           else first)
+        assert (first.argmax(axis=-1) == np.arange(4)).all()
+    finally:
+        router.shutdown()
+
+
+def test_aborted_promote_resumes_without_recanary(tmp_path):
+    """After the canary PASSED, a promote roll that aborts partway
+    leaves some replicas on the candidate; the next poll must resume the
+    roll on the standing verdict — not re-canary against a partially
+    rolled fleet, where the pick could score the candidate as its own
+    incumbent."""
+    ck_root, reg, router, ctrl, boot = _loop_rig(tmp_path)
+    try:
+        _publish(reg, _write_ckpt(ck_root, IDENT, 2), 2)
+        scored = []
+        real_score = ctrl._score_replica
+
+        def counting_score(*a, **kw):
+            scored.append(1)
+            return real_score(*a, **kw)
+
+        ctrl._score_replica = counting_score
+        rep1 = router.replica("r1")
+        real_swap, hits = rep1.swap, []
+
+        def failing_swap(*a, **kw):
+            if not hits:
+                hits.append(1)
+                raise MXNetError("transient swap fault")
+            return real_swap(*a, **kw)
+
+        rep1.swap = failing_swap
+        res = ctrl.poll_once()
+        assert res["status"] == "swap-failed" and res["candidate"] == 2
+        assert len(scored) == 2            # incumbent + canary evals ran
+        assert ctrl.stats()["live_version"] == -1
+        # the retry resumes the promote directly: no third/fourth eval
+        res = ctrl.poll_once()
+        assert res["status"] == "promoted" and res["version"] == 2
+        assert res["canary_score"] == pytest.approx(1.0)
+        assert len(scored) == 2
+        assert ctrl.stats()["live_version"] == 2
+    finally:
+        router.shutdown()
+
+
+def test_rejection_stamps_source_checkpoint_through_pin(tmp_path):
+    """publish(pin=True) hands watchers the registry-owned blobs/ copy;
+    a canary rejection must stamp the trainer's ORIGINAL ckpt-* dir too,
+    so resume / replica boot skip it without ever reading the registry."""
+    ck_root, reg, router, ctrl, boot = _loop_rig(tmp_path)
+    try:
+        poisoned = _write_ckpt(ck_root, -IDENT, 2)
+        rec = reg.publish(poisoned, step=2,
+                          health={"status": "healthy"}, pin=True)
+        assert rec["checkpoint"] != str(poisoned)     # the pinned copy
+        assert rec["source_checkpoint"] == str(poisoned)
+        with pytest.raises(CanaryRejectedError):
+            ctrl.poll_once()
+        assert ckpt.is_rejected(rec["checkpoint"])    # registry blob
+        assert ckpt.is_rejected(str(poisoned))        # trainer-side dir
+        # trainer-side selection skips it with no registry in sight
+        assert ckpt.latest_healthy(str(ck_root)) == boot
+    finally:
+        router.shutdown()
+
+
 # ---------------------------------------------------------------------------
 # knobs + lint
 # ---------------------------------------------------------------------------
